@@ -1,0 +1,27 @@
+"""avida-tpu: a TPU-native digital-evolution framework.
+
+A ground-up reimplementation of the capabilities of Avida (reference:
+fortunalab/avida) designed for TPUs: the entire population is stepped in
+lockstep by a jit-compiled SIMD bytecode interpreter (JAX/XLA), with genomes,
+registers, heads, stacks, phenotypes, the world grid and resources resident in
+HBM as structure-of-arrays tensors.  The reference's organism-at-a-time
+scheduler (cPopulation::ProcessStep, avida-core/source/main/cPopulation.cc:5703)
+collapses into per-update execution budgets realised as masked micro-steps.
+
+Layer map (mirrors SURVEY.md §1, re-architected):
+  config/    -- host-side parsers for avida.cfg / instset / .org /
+                environment.cfg / events.cfg (ref: cAvidaConfig, cInstSet,
+                cEnvironment::Load, cEventList)
+  core/      -- population state pytrees + PRNG discipline
+  models/    -- virtual hardware definitions (heads CPU, ...) as semantic
+                instruction tables (ref: source/cpu/cHardware*)
+  ops/       -- the jitted compute path: SIMD interpreter, scheduler,
+                tasks/reactions, birth engine, the update step
+  parallel/  -- device mesh, sharded update, migration collectives
+                (ref: cMultiProcessWorld -> shard_map + collectives)
+  utils/     -- .dat output writers, .spop checkpointing, stats
+"""
+
+__version__ = "0.1.0"
+
+from avida_tpu.world import World  # noqa: E402,F401

@@ -1,0 +1,236 @@
+"""Environment (task/reaction/resource) configuration.
+
+Parses the reference `environment.cfg` DSL (ref cEnvironment::Load,
+avida-core/source/main/cEnvironment.cc:1213; REACTION lines via LoadReaction
+cc:757 and LoadReactionProcess cc:142; RESOURCE via LoadResource cc:474) into
+a vectorization-friendly `Environment`:
+
+ - every supported task is a *set of 8-bit logic IDs* (the truth-table
+   encoding computed by cTaskLib::SetupTests, cTaskLib.cc:369-448), so task
+   evaluation on device is one `logic_id in set` membership test;
+ - reactions carry process (value/type) + requisite (count window) data
+   mirrored from cReactionProcess / cReactionRequisite.
+
+Only logic-family tasks are device-evaluated today; the full 215-entry task
+library (cTaskLib.cc:87+) grows here as more families are vectorized.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+# Logic-ID membership sets, transcribed from the cited checks in cTaskLib.cc
+# (Task_Not cc:511, Task_Nand cc:518, Task_And cc:525, Task_OrNot cc:532,
+#  Task_Or cc:541, Task_AndNot cc:548, Task_Nor cc:557, Task_Xor cc:564,
+#  Task_Equ cc:571, Task_Echo cc:452).
+LOGIC_TASKS = {
+    "not": (15, 51, 85),
+    "nand": (63, 95, 119),
+    "and": (136, 160, 192),
+    "orn": (175, 187, 207, 221, 243, 245),
+    "or": (238, 250, 252),
+    "andn": (10, 12, 34, 48, 68, 80),
+    "nor": (3, 5, 17),
+    "xor": (60, 90, 102),
+    "equ": (153, 165, 195),
+    "echo": (170, 204, 240),
+    # 1-input identity tasks treated through logic ids as well
+    "true": (255,),
+    "false": (0,),
+}
+for _name in list(LOGIC_TASKS):
+    LOGIC_TASKS[_name + "_dup"] = LOGIC_TASKS[_name]
+
+PROCTYPE_ADD, PROCTYPE_MULT, PROCTYPE_POW, PROCTYPE_LIN = 0, 1, 2, 3
+_PROC_TYPES = {"add": PROCTYPE_ADD, "mult": PROCTYPE_MULT, "pow": PROCTYPE_POW,
+               "lin": PROCTYPE_LIN}
+
+
+@dataclass
+class Process:
+    value: float = 1.0
+    type: int = PROCTYPE_ADD
+    resource: str | None = None     # None = infinite resource
+    max_number: float = 1.0
+    min_number: float = 0.0
+    max_fraction: float = 1.0
+    depletable: bool = True
+
+
+@dataclass
+class Requisite:
+    min_task_count: int = 0
+    max_task_count: int = 2**30
+    min_reaction_count: int = 0
+    max_reaction_count: int = 2**30
+    reactions: list = field(default_factory=list)     # required prior reactions
+    noreactions: list = field(default_factory=list)   # forbidden prior reactions
+    divide_only: bool = False
+
+
+@dataclass
+class Reaction:
+    name: str
+    task: str
+    processes: list
+    requisites: list
+
+
+@dataclass
+class Resource:
+    name: str
+    inflow: float = 0.0
+    outflow: float = 0.0
+    initial: float = 0.0
+    geometry: str = "global"
+
+
+@dataclass
+class Environment:
+    reactions: list = field(default_factory=list)
+    resources: list = field(default_factory=list)
+    input_size: int = 3
+    output_size: int = 1
+
+    @property
+    def num_reactions(self) -> int:
+        return len(self.reactions)
+
+    def task_names(self):
+        return [r.task for r in self.reactions]
+
+    def reaction_names(self):
+        return [r.name for r in self.reactions]
+
+    def device_tables(self):
+        """Build numpy tables for the jitted task-evaluation kernel.
+
+        Returns dict with:
+          task_logic_mask: bool[NR, 256] -- logic-id membership per reaction's task
+          proc_value/proc_type: per-reaction first-process params
+          max_task_count/min_task_count: requisite windows
+          req_reaction_mask/noreq_reaction_mask: bool[NR, NR] prior-reaction gates
+        """
+        nr = self.num_reactions
+        mask = np.zeros((nr, 256), bool)
+        value = np.zeros(nr, np.float64)
+        ptype = np.zeros(nr, np.int32)
+        max_tc = np.full(nr, 2**30, np.int64)
+        min_tc = np.zeros(nr, np.int64)
+        max_rc = np.full(nr, 2**30, np.int64)
+        min_rc = np.zeros(nr, np.int64)
+        req_mask = np.zeros((nr, nr), bool)
+        noreq_mask = np.zeros((nr, nr), bool)
+        name_to_idx = {r.name: i for i, r in enumerate(self.reactions)}
+        for i, r in enumerate(self.reactions):
+            if r.task not in LOGIC_TASKS:
+                raise ValueError(
+                    f"task {r.task!r} is not in the vectorized logic task set yet")
+            mask[i, list(LOGIC_TASKS[r.task])] = True
+            if r.processes:
+                value[i] = r.processes[0].value
+                ptype[i] = r.processes[0].type
+            for q in r.requisites:
+                max_tc[i] = min(max_tc[i], q.max_task_count)
+                min_tc[i] = max(min_tc[i], q.min_task_count)
+                max_rc[i] = min(max_rc[i], q.max_reaction_count)
+                min_rc[i] = max(min_rc[i], q.min_reaction_count)
+                for rn in q.reactions:
+                    req_mask[i, name_to_idx[rn]] = True
+                for rn in q.noreactions:
+                    noreq_mask[i, name_to_idx[rn]] = True
+        return {
+            "task_logic_mask": mask, "proc_value": value, "proc_type": ptype,
+            "max_task_count": max_tc, "min_task_count": min_tc,
+            "max_reaction_count": max_rc, "min_reaction_count": min_rc,
+            "req_reaction_mask": req_mask, "noreq_reaction_mask": noreq_mask,
+        }
+
+
+def _parse_colon_kv(token: str):
+    parts = token.split(":")
+    return parts[0], parts[1:]
+
+
+def load_environment(path: str) -> Environment:
+    env = Environment()
+    with open(path) as f:
+        for raw in f:
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            tokens = line.split()
+            kind = tokens[0].upper()
+            if kind == "REACTION":
+                name, task = tokens[1], tokens[2]
+                processes, requisites = [], []
+                for tok in tokens[3:]:
+                    head, kvs = _parse_colon_kv(tok)
+                    kv = {}
+                    for item in kvs:
+                        if "=" in item:
+                            k, v = item.split("=", 1)
+                            kv[k] = v
+                    if head == "process":
+                        processes.append(Process(
+                            value=float(kv.get("value", 1.0)),
+                            type=_PROC_TYPES[kv.get("type", "add")],
+                            resource=kv.get("resource"),
+                            max_number=float(kv.get("max", 1.0)),
+                            min_number=float(kv.get("min", 0.0)),
+                            max_fraction=float(kv.get("frac", 1.0)),
+                            depletable=bool(int(kv.get("depletable", 1))),
+                        ))
+                    elif head == "requisite":
+                        q = Requisite()
+                        if "max_count" in kv:
+                            q.max_task_count = int(kv["max_count"])
+                        if "min_count" in kv:
+                            q.min_task_count = int(kv["min_count"])
+                        if "max_reaction_count" in kv:
+                            q.max_reaction_count = int(kv["max_reaction_count"])
+                        if "min_reaction_count" in kv:
+                            q.min_reaction_count = int(kv["min_reaction_count"])
+                        if "reaction" in kv:
+                            q.reactions.append(kv["reaction"])
+                        if "noreaction" in kv:
+                            q.noreactions.append(kv["noreaction"])
+                        if "divide_only" in kv:
+                            q.divide_only = bool(int(kv["divide_only"]))
+                        requisites.append(q)
+                if not processes:
+                    processes.append(Process())
+                env.reactions.append(Reaction(name, task, processes, requisites))
+            elif kind == "RESOURCE":
+                for spec in tokens[1:]:
+                    rname, kvs = _parse_colon_kv(spec)
+                    kv = {}
+                    for item in kvs:
+                        if "=" in item:
+                            k, v = item.split("=", 1)
+                            kv[k] = v
+                    env.resources.append(Resource(
+                        name=rname,
+                        inflow=float(kv.get("inflow", 0.0)),
+                        outflow=float(kv.get("outflow", 0.0)),
+                        initial=float(kv.get("initial", 0.0)),
+                    ))
+            # GRADIENT_RESOURCE / CELL / GRID -- planned (spatial resources)
+    return env
+
+
+def default_logic9_environment() -> Environment:
+    """The stock logic-9 environment (ref support/config/environment.cfg:15-23)."""
+    env = Environment()
+    spec = [("NOT", "not", 1.0), ("NAND", "nand", 1.0), ("AND", "and", 2.0),
+            ("ORN", "orn", 2.0), ("OR", "or", 3.0), ("ANDN", "andn", 3.0),
+            ("NOR", "nor", 4.0), ("XOR", "xor", 4.0), ("EQU", "equ", 5.0)]
+    for name, task, val in spec:
+        env.reactions.append(Reaction(
+            name, task,
+            [Process(value=val, type=PROCTYPE_POW)],
+            [Requisite(max_task_count=1)],
+        ))
+    return env

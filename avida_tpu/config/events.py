@@ -1,0 +1,88 @@
+"""Events (experiment timeline) configuration.
+
+Parses the reference `events.cfg` DSL (ref cEventList::LoadEventFile +
+AddEventFileFormat, avida-core/source/main/cEventList.h:63,106):
+
+    [trigger] [start[:interval[:stop]]] [action] [args...]
+
+Triggers: `u`/`update`, `g`/`generation`, `i`/`immediate`.  Start may be
+`begin`; stop may be `end`.  Actions are dispatched by the host driver
+(avida_tpu/world.py) against the action registry in avida_tpu/utils/actions.py
+(ref: 418-action library, avida-core/source/actions/).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+TRIGGER_UPDATE = "update"
+TRIGGER_GENERATION = "generation"
+TRIGGER_IMMEDIATE = "immediate"
+
+_TRIGGERS = {"u": TRIGGER_UPDATE, "update": TRIGGER_UPDATE,
+             "g": TRIGGER_GENERATION, "generation": TRIGGER_GENERATION,
+             "i": TRIGGER_IMMEDIATE, "immediate": TRIGGER_IMMEDIATE}
+
+END = float("inf")
+
+
+@dataclass
+class Event:
+    trigger: str
+    start: float
+    interval: float     # 0 = fire once
+    stop: float
+    action: str
+    args: list
+
+    def fires_at(self, t: float) -> bool:
+        if t < self.start or t > self.stop:
+            return False
+        if self.interval <= 0:
+            return t == self.start
+        k = (t - self.start) / self.interval
+        return abs(k - round(k)) < 1e-9
+
+
+def _parse_timing(token: str):
+    parts = token.split(":")
+    def num(s):
+        if s == "begin":
+            return 0.0
+        if s == "end":
+            return END
+        return float(s)
+    start = num(parts[0])
+    interval = num(parts[1]) if len(parts) > 1 else 0.0
+    stop = num(parts[2]) if len(parts) > 2 else (END if len(parts) > 1 else start)
+    return start, interval, stop
+
+
+def parse_event_line(line: str) -> Event | None:
+    line = line.split("#", 1)[0].strip()
+    if not line:
+        return None
+    tokens = line.split()
+    if tokens[0] in _TRIGGERS:
+        trigger = _TRIGGERS[tokens[0]]
+        tokens = tokens[1:]
+    else:
+        trigger = TRIGGER_IMMEDIATE
+    # timing token is optional for immediate events
+    start, interval, stop = 0.0, 0.0, 0.0
+    if tokens and (tokens[0][0].isdigit() or tokens[0].split(":")[0] in ("begin", "end")):
+        start, interval, stop = _parse_timing(tokens[0])
+        tokens = tokens[1:]
+    if not tokens:
+        return None
+    return Event(trigger, start, interval, stop, tokens[0], tokens[1:])
+
+
+def load_events(path: str) -> list:
+    events = []
+    with open(path) as f:
+        for raw in f:
+            ev = parse_event_line(raw)
+            if ev is not None:
+                events.append(ev)
+    return events

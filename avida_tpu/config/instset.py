@@ -1,0 +1,125 @@
+"""Instruction-set file loader.
+
+Parses the reference's instset format (ref cHardwareManager::LoadInstSets,
+avida-core/source/cpu/cHardwareManager.cc:58-147):
+
+    INSTSET name:hw_type=N[:stack_size=S][:uops_per_cycle=U]
+    INST inst-name [redundancy=..][:cost=..][:ft_cost=..][:prob_fail=..]...
+
+Per-instruction parameters mirror cInstSet columns
+(cHardwareManager.cc:222-230): redundancy (mutation weight), cost, ft_cost,
+energy_cost, prob_fail, addl_time_cost, res_cost.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class InstSet:
+    name: str
+    hw_type: int
+    inst_names: list
+    redundancy: np.ndarray      # mutation weight per opcode
+    cost: np.ndarray
+    ft_cost: np.ndarray
+    energy_cost: np.ndarray
+    prob_fail: np.ndarray
+    addl_time_cost: np.ndarray
+    params: dict = field(default_factory=dict)
+
+    @property
+    def num_insts(self) -> int:
+        return len(self.inst_names)
+
+    def opcode(self, name: str) -> int:
+        return self.inst_names.index(name)
+
+    def mutation_weights(self) -> np.ndarray:
+        """Normalized redundancy weights for random-instruction draws
+        (ref cInstSet::GetRandomInst)."""
+        w = self.redundancy.astype(np.float64)
+        total = w.sum()
+        if total <= 0:
+            raise ValueError("instruction set has no positive redundancy")
+        return w / total
+
+
+def _parse_kv(parts):
+    out = {}
+    for p in parts:
+        if "=" in p:
+            k, v = p.split("=", 1)
+            try:
+                out[k] = float(v) if "." in v else int(v)
+            except ValueError:
+                out[k] = v
+    return out
+
+
+def load_instset(path: str) -> InstSet:
+    name = "default"
+    hw_type = 0
+    params = {}
+    names, red, cost, ftc, ec, pf, atc = [], [], [], [], [], [], []
+    with open(path) as f:
+        for raw in f:
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            tokens = line.split()
+            if tokens[0] == "INSTSET":
+                spec = tokens[1].split(":")
+                name = spec[0]
+                kv = _parse_kv(spec[1:])
+                hw_type = int(kv.pop("hw_type", 0))
+                params.update(kv)
+            elif tokens[0] == "INST":
+                spec = tokens[1].split(":")
+                names.append(spec[0])
+                kv = _parse_kv(spec[1:])
+                red.append(kv.get("redundancy", 1))
+                cost.append(kv.get("cost", 0))
+                ftc.append(kv.get("ft_cost", 0))
+                ec.append(kv.get("energy_cost", 0))
+                pf.append(kv.get("prob_fail", 0.0))
+                atc.append(kv.get("addl_time_cost", 0))
+    if not names:
+        raise ValueError(f"no INST lines found in {path}")
+    return InstSet(
+        name=name, hw_type=hw_type, inst_names=names,
+        redundancy=np.asarray(red, np.float64),
+        cost=np.asarray(cost, np.int32),
+        ft_cost=np.asarray(ftc, np.int32),
+        energy_cost=np.asarray(ec, np.float64),
+        prob_fail=np.asarray(pf, np.float64),
+        addl_time_cost=np.asarray(atc, np.int32),
+        params=params,
+    )
+
+
+_HEADS_DEFAULT_NAMES = [
+    "nop-A", "nop-B", "nop-C",
+    "if-n-equ", "if-less", "if-label",
+    "mov-head", "jmp-head", "get-head", "set-flow",
+    "shift-r", "shift-l", "inc", "dec", "push", "pop", "swap-stk", "swap",
+    "add", "sub", "nand",
+    "h-copy", "h-alloc", "h-divide",
+    "IO", "h-search",
+]
+
+
+def default_instset() -> InstSet:
+    """The stock heads_default set (ref support/config/instset-heads.cfg)."""
+    n = len(_HEADS_DEFAULT_NAMES)
+    ones = np.ones(n)
+    zeros = np.zeros(n)
+    return InstSet(
+        name="heads_default", hw_type=0, inst_names=list(_HEADS_DEFAULT_NAMES),
+        redundancy=ones.copy(), cost=zeros.astype(np.int32),
+        ft_cost=zeros.astype(np.int32), energy_cost=zeros.copy(),
+        prob_fail=zeros.copy(), addl_time_cost=zeros.astype(np.int32),
+    )

@@ -1,0 +1,28 @@
+"""Organism (.org) file loader.
+
+The reference format is one instruction name per line with `#` comments
+(ref support/config/default-heads.org; loaded via cInstSet name lookup).
+Returns an int8 opcode array under the given instruction set.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from avida_tpu.config.instset import InstSet
+
+
+def load_organism(path: str, instset: InstSet) -> np.ndarray:
+    ops = []
+    name_to_op = {n: i for i, n in enumerate(instset.inst_names)}
+    with open(path) as f:
+        for raw in f:
+            line = raw.split("#", 1)[0].strip()
+            if not line or line.startswith("#"):
+                continue
+            if line not in name_to_op:
+                raise ValueError(f"unknown instruction {line!r} in {path}")
+            ops.append(name_to_op[line])
+    if not ops:
+        raise ValueError(f"no instructions found in {path}")
+    return np.asarray(ops, np.int8)

@@ -1,0 +1,240 @@
+"""Typed configuration system for avida-tpu.
+
+Re-expresses the reference's macro-reflected flag system (cAvidaConfig,
+avida-core/source/main/cAvidaConfig.h:71-854 -- 428 vars in ~40 groups) as a
+Python dataclass with the same variable names, defaults and `avida.cfg` file
+format, so reference config files load unmodified.  Command-line `-set NAME
+VALUE` overrides mirror Avida::Util::ProcessCmdLineArgs
+(avida-core/source/util/CmdLine.cc:205).
+
+Only a subset of variables is interpreted by the engine today; unknown
+variables found in a config file are retained in `extras` (and warn once) so
+that round-tripping and forward-compat both work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+from dataclasses import dataclass, field, fields
+
+
+def _parse_scalar(text: str):
+    text = text.strip()
+    try:
+        return int(text)
+    except ValueError:
+        pass
+    try:
+        return float(text)
+    except ValueError:
+        pass
+    return text
+
+
+@dataclass
+class AvidaConfig:
+    # --- General group (cAvidaConfig.h:283+) ---
+    VERBOSITY: int = 1
+    RANDOM_SEED: int = -1
+    SPECULATIVE: int = 1            # subsumed by lockstep batching on TPU
+    POPULATION_CAP: int = 0
+    POP_CAP_ELDEST: int = 0
+
+    # --- World/topology ---
+    WORLD_X: int = 60
+    WORLD_Y: int = 60
+    WORLD_GEOMETRY: int = 2         # 1=bounded grid, 2=torus (nGeometry.h:30-37)
+
+    # --- File paths ---
+    DATA_DIR: str = "data"
+    EVENT_FILE: str = "events.cfg"
+    ANALYZE_FILE: str = "analyze.cfg"
+    ENVIRONMENT_FILE: str = "environment.cfg"
+
+    # --- Mutation rates (cAvidaConfig.h mutation group) ---
+    COPY_MUT_PROB: float = 0.0075
+    COPY_INS_PROB: float = 0.0
+    COPY_DEL_PROB: float = 0.0
+    COPY_UNIFORM_PROB: float = 0.0
+    COPY_SLIP_PROB: float = 0.0
+    POINT_MUT_PROB: float = 0.0
+    POINT_INS_PROB: float = 0.0
+    POINT_DEL_PROB: float = 0.0
+    DIV_MUT_PROB: float = 0.0
+    DIV_INS_PROB: float = 0.0
+    DIV_DEL_PROB: float = 0.0
+    DIV_SLIP_PROB: float = 0.0
+    DIVIDE_MUT_PROB: float = 0.0
+    DIVIDE_INS_PROB: float = 0.05
+    DIVIDE_DEL_PROB: float = 0.05
+    DIVIDE_UNIFORM_PROB: float = 0.0
+    DIVIDE_SLIP_PROB: float = 0.0
+    INJECT_INS_PROB: float = 0.0
+    INJECT_DEL_PROB: float = 0.0
+    INJECT_MUT_PROB: float = 0.0
+    PARENT_MUT_PROB: float = 0.0
+    SLIP_FILL_MODE: int = 0
+    MUT_RATE_SOURCE: int = 1
+
+    # --- Birth / divide ---
+    DIVIDE_FAILURE_RESETS: int = 0
+    BIRTH_METHOD: int = 0           # 0=random in neighborhood (Definitions.h:67-82)
+    PREFER_EMPTY: int = 1
+    ALLOW_PARENT: int = 1
+    DEATH_PROB: float = 0.0
+    DEATH_METHOD: int = 2           # 2=die at genome_length*AGE_LIMIT insts
+    AGE_LIMIT: int = 20
+    AGE_DEVIATION: int = 0
+    JUV_PERIOD: int = 0
+    ALLOC_METHOD: int = 0           # 0=fill with default inst (op 0)
+    DIVIDE_METHOD: int = 1          # 1=SPLIT: parent reset (2 offspring)
+    EPIGENETIC_METHOD: int = 0
+    GENERATION_INC_METHOD: int = 1  # both parent+child
+    RESET_INPUTS_ON_DIVIDE: int = 0
+    INHERIT_MERIT: int = 1
+    INHERIT_MULTITHREAD: int = 0
+
+    # --- Divide restrictions ---
+    OFFSPRING_SIZE_RANGE: float = 2.0
+    MIN_COPIED_LINES: float = 0.5
+    MIN_EXE_LINES: float = 0.5
+    MIN_GENOME_SIZE: int = 0
+    MAX_GENOME_SIZE: int = 0
+    MIN_CYCLES: int = 0
+    REQUIRE_ALLOCATE: int = 1
+    REQUIRED_TASK: int = -1
+    REQUIRED_REACTION: int = -1
+    REQUIRE_SINGLE_REACTION: int = 0
+    REQUIRED_BONUS: float = 0.0
+    REQUIRE_EXACT_COPY: int = 0
+
+    # --- Recombination (sex) ---
+    RECOMBINATION_PROB: float = 1.0
+    MAX_BIRTH_WAIT_TIME: int = -1
+    MODULE_NUM: int = 0
+    CONT_REC_REGS: int = 1
+    CORESPOND_REC_REGS: int = 1
+    TWO_FOLD_COST_SEX: int = 0
+    SAME_LENGTH_SEX: int = 0
+
+    # --- Reversion/sterilization ---
+    REVERT_FATAL: float = 0.0
+    REVERT_DETRIMENTAL: float = 0.0
+    REVERT_NEUTRAL: float = 0.0
+    REVERT_BENEFICIAL: float = 0.0
+    STERILIZE_FATAL: float = 0.0
+    STERILIZE_DETRIMENTAL: float = 0.0
+    STERILIZE_NEUTRAL: float = 0.0
+    STERILIZE_BENEFICIAL: float = 0.0
+    STERILIZE_UNSTABLE: int = 0
+
+    # --- Time slicing (cAvidaConfig.h:544-561) ---
+    AVE_TIME_SLICE: int = 30
+    SLICING_METHOD: int = 1         # 0=const, 1=prob∝merit, 2=integrated
+    BASE_MERIT_METHOD: int = 4      # 4=min(full, copied, executed)
+    BASE_CONST_MERIT: int = 100
+    DEFAULT_BONUS: float = 1.0
+    MERIT_DEFAULT_BONUS: float = 0.0
+    MERIT_INC_APPLY_IMMEDIATE: int = 0
+    MAX_CPU_THREADS: int = 1
+    THREAD_SLICING_METHOD: int = 0
+    NO_CPU_CYCLE_TIME: int = 0
+    MAX_LABEL_EXE_SIZE: int = 1
+
+    # --- Hardware ---
+    HARDWARE_TYPE: int = 0
+    INST_SET: str = "-"
+    INSTSET: str = "-"              # alias used by some configs
+
+    # --- Test CPU ---
+    TEST_CPU_TIME_MOD: int = 20
+
+    # --- Demes ---
+    NUM_DEMES: int = 1
+    DEMES_USE_GERMLINE: int = 0
+    DEMES_COMPETITION_STYLE: int = 0
+    DEMES_TOURNAMENT_SIZE: int = 0
+
+    # --- Energy model (off by default) ---
+    ENERGY_ENABLED: int = 0
+
+    # --- Parasites ---
+    INJECT_METHOD: int = 0
+    INFECTION_MECHANISM: int = 0
+    PARASITE_VIRULENCE: float = -1.0
+    PARASITE_MEM_SPACES: int = 1
+
+    # ---- TPU-build specific knobs (no reference equivalent) ----
+    # Hard cap on the per-organism memory buffer (the reference's
+    # MAX_GENOME_LENGTH analogue, but this one sizes HBM tensors).
+    TPU_MAX_MEMORY: int = 384
+    # Safety cap on lockstep micro-steps per update (0 = uncapped: run to the
+    # max sampled budget).  Uncapped matches reference scheduling semantics.
+    TPU_MAX_STEPS_PER_UPDATE: int = 0
+    # float dtype for merit/bonus math ("float32" is plenty: max bonus 2^25).
+    TPU_FLOAT_DTYPE: str = "float32"
+
+    extras: dict = field(default_factory=dict)
+
+    _FIELD_NAMES = None  # class-level cache
+
+    @classmethod
+    def field_names(cls):
+        if cls._FIELD_NAMES is None:
+            cls._FIELD_NAMES = {f.name for f in fields(cls) if f.name != "extras"}
+        return cls._FIELD_NAMES
+
+    def set(self, name: str, value):
+        """Apply one NAME VALUE pair (file line or -set override)."""
+        if name in self.field_names():
+            cur = getattr(self, name)
+            if isinstance(cur, str):
+                setattr(self, name, str(value))
+            elif isinstance(cur, float):
+                setattr(self, name, float(value))
+            else:
+                setattr(self, name, int(float(value)))
+        else:
+            self.extras[name] = value
+
+    def get(self, name: str, default=None):
+        if name in self.field_names():
+            return getattr(self, name)
+        return self.extras.get(name, default)
+
+    def copy(self) -> "AvidaConfig":
+        c = dataclasses.replace(self)
+        c.extras = dict(self.extras)
+        return c
+
+
+def load_avida_cfg(path: str, overrides=None) -> AvidaConfig:
+    """Parse an avida.cfg file (ref format: cAvidaConfig::Load, cAvidaConfig.cc:64).
+
+    Lines are `NAME VALUE  # comment`.  `overrides` is a list of (name, value)
+    applied after the file, mirroring `-set NAME VALUE`.
+    """
+    cfg = AvidaConfig()
+    seen_unknown = set()
+    with open(path) as f:
+        for raw in f:
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split(None, 1)
+            if len(parts) != 2:
+                continue
+            name, value = parts[0], _parse_scalar(parts[1])
+            if name == "VERSION_ID":
+                continue
+            if name not in AvidaConfig.field_names() and name not in seen_unknown:
+                seen_unknown.add(name)
+            cfg.set(name, value)
+    if seen_unknown:
+        warnings.warn(
+            "avida.cfg variables not yet interpreted by avida-tpu (kept in "
+            f"extras): {sorted(seen_unknown)}", stacklevel=2)
+    for name, value in (overrides or []):
+        cfg.set(name, _parse_scalar(str(value)))
+    return cfg

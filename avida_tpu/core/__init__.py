@@ -1,0 +1,3 @@
+from avida_tpu.core.state import PopulationState, WorldParams, init_population
+
+__all__ = ["PopulationState", "WorldParams", "init_population"]

@@ -1,0 +1,287 @@
+"""Population state: structure-of-arrays tensors for the whole world.
+
+This is the TPU-native replacement for the reference's object graph
+(cPopulation -> cPopulationCell -> cOrganism -> {cHardwareCPU, cPhenotype};
+see SURVEY.md §7 state layout).  One array slot per grid cell (the reference
+is also cell-capacity-bounded: one organism per cell, cPopulation.cc:323), so
+placement is a scatter and the `alive` mask defines occupancy.
+
+All fields are batched over N = WORLD_X * WORLD_Y.  Organism-level fields
+mirror cHardwareCPU state (cHardwareCPU.h:61-152) and cPhenotype bookkeeping
+(cPhenotype.h:97-216).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from avida_tpu.models import heads as hw
+
+
+class WorldParams(struct.PyTreeNode):
+    """Static (hashable) parameters baked into the jitted update step.
+
+    Everything here is a Python scalar / tuple, marked as pytree metadata, so
+    a config change triggers recompilation (acceptable: configs are per-run).
+    """
+    # world shape
+    world_x: int = struct.field(pytree_node=False, default=60)
+    world_y: int = struct.field(pytree_node=False, default=60)
+    geometry: int = struct.field(pytree_node=False, default=2)  # 1=grid, 2=torus
+    # memory / genome caps
+    max_memory: int = struct.field(pytree_node=False, default=384)
+    min_genome_len: int = struct.field(pytree_node=False, default=8)
+    # instruction set (semantic tables as tuples for hashability)
+    num_insts: int = struct.field(pytree_node=False, default=26)
+    sem: tuple = struct.field(pytree_node=False, default=())
+    mod_kind: tuple = struct.field(pytree_node=False, default=())
+    default_op: tuple = struct.field(pytree_node=False, default=())
+    is_nop: tuple = struct.field(pytree_node=False, default=())
+    nop_mod: tuple = struct.field(pytree_node=False, default=())
+    # mutation rates
+    copy_mut_prob: float = struct.field(pytree_node=False, default=0.0075)
+    copy_ins_prob: float = struct.field(pytree_node=False, default=0.0)
+    copy_del_prob: float = struct.field(pytree_node=False, default=0.0)
+    divide_mut_prob: float = struct.field(pytree_node=False, default=0.0)
+    divide_ins_prob: float = struct.field(pytree_node=False, default=0.05)
+    divide_del_prob: float = struct.field(pytree_node=False, default=0.05)
+    div_mut_prob: float = struct.field(pytree_node=False, default=0.0)   # per-site
+    point_mut_prob: float = struct.field(pytree_node=False, default=0.0)
+    # divide restrictions
+    offspring_size_range: float = struct.field(pytree_node=False, default=2.0)
+    min_copied_lines: float = struct.field(pytree_node=False, default=0.5)
+    min_exe_lines: float = struct.field(pytree_node=False, default=0.5)
+    require_allocate: bool = struct.field(pytree_node=False, default=True)
+    # scheduling
+    ave_time_slice: int = struct.field(pytree_node=False, default=30)
+    slicing_method: int = struct.field(pytree_node=False, default=1)
+    base_merit_method: int = struct.field(pytree_node=False, default=4)
+    base_const_merit: int = struct.field(pytree_node=False, default=100)
+    default_bonus: float = struct.field(pytree_node=False, default=1.0)
+    inherit_merit: bool = struct.field(pytree_node=False, default=True)
+    max_steps_per_update: int = struct.field(pytree_node=False, default=0)
+    # death
+    death_method: int = struct.field(pytree_node=False, default=2)
+    age_limit: int = struct.field(pytree_node=False, default=20)
+    # birth
+    birth_method: int = struct.field(pytree_node=False, default=0)
+    prefer_empty: bool = struct.field(pytree_node=False, default=True)
+    allow_parent: bool = struct.field(pytree_node=False, default=True)
+    divide_method: int = struct.field(pytree_node=False, default=1)
+    generation_inc_method: int = struct.field(pytree_node=False, default=1)
+    # environment (task/reaction tables, as tuples of tuples)
+    num_reactions: int = struct.field(pytree_node=False, default=9)
+    task_logic_mask: tuple = struct.field(pytree_node=False, default=())
+    proc_value: tuple = struct.field(pytree_node=False, default=())
+    proc_type: tuple = struct.field(pytree_node=False, default=())
+    max_task_count: tuple = struct.field(pytree_node=False, default=())
+    min_task_count: tuple = struct.field(pytree_node=False, default=())
+    req_reaction_mask: tuple = struct.field(pytree_node=False, default=())
+    noreq_reaction_mask: tuple = struct.field(pytree_node=False, default=())
+
+    @property
+    def num_cells(self) -> int:
+        return self.world_x * self.world_y
+
+
+def make_world_params(cfg, instset, environment) -> WorldParams:
+    """Build WorldParams from parsed config objects (host side)."""
+    tables = instset_tables(instset)
+    env_tables = environment.device_tables()
+
+    def tt(a):
+        return tuple(map(tuple, a)) if a.ndim == 2 else tuple(a.tolist())
+
+    return WorldParams(
+        world_x=cfg.WORLD_X, world_y=cfg.WORLD_Y, geometry=cfg.WORLD_GEOMETRY,
+        max_memory=cfg.TPU_MAX_MEMORY,
+        min_genome_len=8,
+        num_insts=tables["num_insts"],
+        sem=tuple(tables["sem"].tolist()),
+        mod_kind=tuple(tables["mod_kind"].tolist()),
+        default_op=tuple(tables["default_op"].tolist()),
+        is_nop=tuple(tables["is_nop"].tolist()),
+        nop_mod=tuple(tables["nop_mod"].tolist()),
+        copy_mut_prob=cfg.COPY_MUT_PROB,
+        copy_ins_prob=cfg.COPY_INS_PROB,
+        copy_del_prob=cfg.COPY_DEL_PROB,
+        divide_mut_prob=cfg.DIVIDE_MUT_PROB,
+        divide_ins_prob=cfg.DIVIDE_INS_PROB,
+        divide_del_prob=cfg.DIVIDE_DEL_PROB,
+        div_mut_prob=cfg.DIV_MUT_PROB,
+        point_mut_prob=cfg.POINT_MUT_PROB,
+        offspring_size_range=cfg.OFFSPRING_SIZE_RANGE,
+        min_copied_lines=cfg.MIN_COPIED_LINES,
+        min_exe_lines=cfg.MIN_EXE_LINES,
+        require_allocate=bool(cfg.REQUIRE_ALLOCATE),
+        ave_time_slice=cfg.AVE_TIME_SLICE,
+        slicing_method=cfg.SLICING_METHOD,
+        base_merit_method=cfg.BASE_MERIT_METHOD,
+        base_const_merit=cfg.BASE_CONST_MERIT,
+        default_bonus=cfg.DEFAULT_BONUS,
+        inherit_merit=bool(cfg.INHERIT_MERIT),
+        max_steps_per_update=cfg.TPU_MAX_STEPS_PER_UPDATE,
+        death_method=cfg.DEATH_METHOD,
+        age_limit=cfg.AGE_LIMIT,
+        birth_method=cfg.BIRTH_METHOD,
+        prefer_empty=bool(cfg.PREFER_EMPTY),
+        allow_parent=bool(cfg.ALLOW_PARENT),
+        divide_method=cfg.DIVIDE_METHOD,
+        generation_inc_method=cfg.GENERATION_INC_METHOD,
+        num_reactions=len(environment.reactions),
+        task_logic_mask=tt(env_tables["task_logic_mask"]),
+        proc_value=tuple(env_tables["proc_value"].tolist()),
+        proc_type=tuple(env_tables["proc_type"].tolist()),
+        max_task_count=tuple(env_tables["max_task_count"].tolist()),
+        min_task_count=tuple(env_tables["min_task_count"].tolist()),
+        req_reaction_mask=tt(env_tables["req_reaction_mask"]),
+        noreq_reaction_mask=tt(env_tables["noreq_reaction_mask"]),
+    )
+
+
+def instset_tables(instset):
+    from avida_tpu.models.registry import get_hardware
+    mod = get_hardware(instset.hw_type)["module"]
+    return mod.build_semantic_tables(instset.inst_names)
+
+
+class PopulationState(struct.PyTreeNode):
+    """All per-organism (= per-cell) device state.  Shapes given for N cells,
+    L = max_memory, R = num reactions."""
+
+    # --- virtual hardware (ref cHardwareCPU.h:61-152) ---
+    mem: jax.Array            # int8[N, L]   memory tape (genome + allocation)
+    mem_len: jax.Array        # int32[N]     current memory size
+    flag_exec: jax.Array      # bool[N, L]   per-site executed flag (cCPUMemory)
+    flag_copied: jax.Array    # bool[N, L]   per-site copied flag
+    regs: jax.Array           # int32[N, 3]  AX BX CX
+    heads: jax.Array          # int32[N, 4]  IP READ WRITE FLOW
+    stacks: jax.Array         # int32[N, 2, 10]
+    sp: jax.Array             # int32[N, 2]  stack pointers
+    active_stack: jax.Array   # int32[N]
+    read_label: jax.Array     # int8[N, 10]  nops most recently copied
+    read_label_len: jax.Array  # int32[N]
+    mal_active: jax.Array     # bool[N]      allocate active (REQUIRE_ALLOCATE)
+
+    # --- organism / world binding ---
+    alive: jax.Array          # bool[N]
+    genome: jax.Array         # int8[N, L]   birth genome (genotype identity)
+    genome_len: jax.Array     # int32[N]
+    inputs: jax.Array         # int32[N, 3]  cell input stream (cEnvironment::SetupInputs)
+    input_ptr: jax.Array      # int32[N]
+    input_buf: jax.Array      # int32[N, 3]  last 3 inputs, [0]=most recent
+    input_buf_n: jax.Array    # int32[N]
+    output_buf: jax.Array     # int32[N]     last output (output size 1)
+
+    # --- phenotype (ref cPhenotype.h:97-216) ---
+    merit: jax.Array          # f32[N]       scheduling weight
+    cur_bonus: jax.Array      # f32[N]
+    cur_task_count: jax.Array     # int32[N, R]
+    cur_reaction_count: jax.Array  # int32[N, R]
+    last_task_count: jax.Array    # int32[N, R]
+    time_used: jax.Array      # int32[N]
+    cpu_cycles: jax.Array     # int32[N]
+    gestation_start: jax.Array  # int32[N]
+    gestation_time: jax.Array   # int32[N]  last gestation
+    fitness: jax.Array        # f32[N]      last fitness
+    last_bonus: jax.Array     # f32[N]
+    last_merit_base: jax.Array  # f32[N]
+    executed_size: jax.Array  # int32[N]
+    copied_size: jax.Array    # int32[N]
+    child_copied_size: jax.Array  # int32[N]
+    generation: jax.Array     # int32[N]
+    max_executed: jax.Array   # int32[N]    death threshold (DEATH_METHOD)
+    num_divides: jax.Array    # int32[N]
+
+    # --- pending birth (flushed by the birth engine each update) ---
+    divide_pending: jax.Array  # bool[N]
+    off_mem: jax.Array        # int8[N, L]
+    off_len: jax.Array        # int32[N]
+    off_copied_size: jax.Array  # int32[N]
+
+    # --- systematics hooks ---
+    genotype_id: jax.Array    # int32[N]    host-assigned genotype ids (-1 unknown)
+    parent_id: jax.Array      # int32[N]    parent cell index at birth (-1 seed)
+    birth_update: jax.Array   # int32[N]
+
+    # --- per-update accounting ---
+    insts_executed: jax.Array  # int32[N]  lifetime instructions executed
+
+
+def zeros_population(n: int, L: int, R: int) -> PopulationState:
+    i32 = partial(jnp.zeros, dtype=jnp.int32)
+    f32 = partial(jnp.zeros, dtype=jnp.float32)
+    return PopulationState(
+        mem=jnp.zeros((n, L), jnp.int8), mem_len=i32(n),
+        flag_exec=jnp.zeros((n, L), bool), flag_copied=jnp.zeros((n, L), bool),
+        regs=i32((n, 3)), heads=i32((n, 4)),
+        stacks=i32((n, 2, 10)), sp=i32((n, 2)), active_stack=i32(n),
+        read_label=jnp.zeros((n, 10), jnp.int8), read_label_len=i32(n),
+        mal_active=jnp.zeros(n, bool),
+        alive=jnp.zeros(n, bool),
+        genome=jnp.zeros((n, L), jnp.int8), genome_len=i32(n),
+        inputs=i32((n, 3)), input_ptr=i32(n),
+        input_buf=i32((n, 3)), input_buf_n=i32(n), output_buf=i32(n),
+        merit=f32(n), cur_bonus=f32(n),
+        cur_task_count=i32((n, R)), cur_reaction_count=i32((n, R)),
+        last_task_count=i32((n, R)),
+        time_used=i32(n), cpu_cycles=i32(n),
+        gestation_start=i32(n), gestation_time=i32(n),
+        fitness=f32(n), last_bonus=f32(n), last_merit_base=f32(n),
+        executed_size=i32(n), copied_size=i32(n), child_copied_size=i32(n),
+        generation=i32(n), max_executed=i32(n), num_divides=i32(n),
+        divide_pending=jnp.zeros(n, bool),
+        off_mem=jnp.zeros((n, L), jnp.int8), off_len=i32(n),
+        off_copied_size=i32(n),
+        genotype_id=jnp.full(n, -1, jnp.int32), parent_id=jnp.full(n, -1, jnp.int32),
+        birth_update=i32(n),
+        insts_executed=i32(n),
+    )
+
+
+def make_cell_inputs(key: jax.Array, n: int) -> jax.Array:
+    """Patterned random inputs: top 8 bits 0x0F/0x33/0x55, low 24 random
+    (ref cEnvironment::SetupInputs, cEnvironment.cc:1268-1276)."""
+    low = jax.random.randint(key, (n, 3), 0, 1 << 24, dtype=jnp.int32)
+    tops = jnp.array([15 << 24, 51 << 24, 85 << 24], jnp.int32)
+    return tops[None, :] + low
+
+
+def init_population(params: WorldParams, seed_genome: np.ndarray,
+                    key: jax.Array, inject_cell: int | None = None
+                    ) -> PopulationState:
+    """World with a single injected ancestor (ref ActivateOrganism +
+    cPhenotype::SetupInject, cPhenotype.cc:599: merit = genome length,
+    copied = executed = length)."""
+    n, L, R = params.num_cells, params.max_memory, params.num_reactions
+    st = zeros_population(n, L, R)
+    k_inputs, key = jax.random.split(key)
+    st = st.replace(inputs=make_cell_inputs(k_inputs, n))
+    if inject_cell is None:
+        inject_cell = n // 2  # reference injects cell 0; center is equivalent on a torus
+    g = np.zeros(L, np.int8)
+    glen = len(seed_genome)
+    if glen > L:
+        raise ValueError(f"seed genome length {glen} exceeds max_memory {L}")
+    g[:glen] = seed_genome
+    c = inject_cell
+    st = st.replace(
+        mem=st.mem.at[c].set(jnp.asarray(g)),
+        genome=st.genome.at[c].set(jnp.asarray(g)),
+        mem_len=st.mem_len.at[c].set(glen),
+        genome_len=st.genome_len.at[c].set(glen),
+        alive=st.alive.at[c].set(True),
+        merit=st.merit.at[c].set(float(glen)),
+        cur_bonus=st.cur_bonus.at[c].set(params.default_bonus),
+        executed_size=st.executed_size.at[c].set(glen),
+        copied_size=st.copied_size.at[c].set(glen),
+        max_executed=st.max_executed.at[c].set(
+            params.age_limit * glen if params.death_method == 2
+            else (params.age_limit if params.death_method == 1 else 2**30)),
+    )
+    return st

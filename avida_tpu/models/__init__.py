@@ -1,0 +1,3 @@
+from avida_tpu.models.registry import get_hardware, HARDWARE_REGISTRY
+
+__all__ = ["get_hardware", "HARDWARE_REGISTRY"]

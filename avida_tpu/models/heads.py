@@ -1,0 +1,151 @@
+"""The heads-based virtual CPU (reference HARDWARE_TYPE 0).
+
+Defines the *semantic instruction table* for the classic heads hardware
+(ref: cHardwareCPU, avida-core/source/cpu/cHardwareCPU.cc:79-560 -- the
+static instruction library; execution semantics re-derived per-instruction
+from the cited implementations, then re-expressed as batched tensor ops in
+avida_tpu/ops/interpreter.py).
+
+Architecture state per organism (ref cHardwareCPU.h:61-152):
+  3 registers (AX, BX, CX), 4 heads (IP, READ, WRITE, FLOW), two 10-deep
+  cyclic stacks (one active), a read-label buffer, memory with per-site
+  executed/copied flags.
+
+Instead of a 563-way function-pointer dispatch per instruction
+(cHardwareCPU.cc:1079), each instruction is assigned a *semantic opcode* and
+per-opcode metadata (operand kind, default operand, IP-advance class) that the
+SIMD interpreter uses to execute the whole population in lockstep.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+# Registers (ref cHardwareCPU.h REG_AX/BX/CX)
+REG_AX, REG_BX, REG_CX = 0, 1, 2
+NUM_REGISTERS = 3
+# Heads (ref nHardware.h:32)
+HEAD_IP, HEAD_READ, HEAD_WRITE, HEAD_FLOW = 0, 1, 2, 3
+NUM_HEADS = 4
+NUM_NOPS = 3
+STACK_SIZE = 10          # ref nHardware.h:34
+MAX_LABEL_SIZE = 10      # ref cCodeLabel MAX_LENGTH
+
+# Operand-modifier kinds: what a trailing nop modifies (ref
+# FindModifiedRegister / FindModifiedHead / ReadLabel, cHardwareCPU.cc:1622+)
+MOD_NONE, MOD_REG, MOD_HEAD, MOD_LABEL = 0, 1, 2, 3
+
+# Semantic opcodes.  These are interpreter-internal; genome opcodes map to
+# them through the loaded instruction set (cInstSet equivalent).
+(
+    SEM_NOP_A, SEM_NOP_B, SEM_NOP_C,
+    SEM_IF_N_EQU, SEM_IF_LESS, SEM_IF_LABEL,
+    SEM_MOV_HEAD, SEM_JMP_HEAD, SEM_GET_HEAD, SEM_SET_FLOW,
+    SEM_SHIFT_R, SEM_SHIFT_L, SEM_INC, SEM_DEC,
+    SEM_PUSH, SEM_POP, SEM_SWAP_STK, SEM_SWAP,
+    SEM_ADD, SEM_SUB, SEM_NAND,
+    SEM_H_COPY, SEM_H_ALLOC, SEM_H_DIVIDE,
+    SEM_IO, SEM_H_SEARCH,
+) = range(26)
+
+NUM_SEMANTIC_OPS = 26
+
+
+@dataclass(frozen=True)
+class InstSpec:
+    name: str
+    sem: int
+    mod_kind: int        # MOD_NONE / MOD_REG / MOD_HEAD / MOD_LABEL
+    default_operand: int  # register or head index (meaning depends on kind)
+    doc: str = ""
+
+
+# The canonical heads_default set (ref support/config/instset-heads.cfg).
+# Default operands follow the cited implementations:
+#   if-n-equ/if-less/shift/inc/dec/push/pop/swap/add/sub/nand/IO -> ?BX?
+#   set-flow -> ?CX?; mov-head/jmp-head/get-head -> ?IP?
+INSTRUCTIONS = {
+    "nop-A": InstSpec("nop-A", SEM_NOP_A, MOD_NONE, 0, "no-op; modifies neighbors"),
+    "nop-B": InstSpec("nop-B", SEM_NOP_B, MOD_NONE, 0),
+    "nop-C": InstSpec("nop-C", SEM_NOP_C, MOD_NONE, 0),
+    "if-n-equ": InstSpec("if-n-equ", SEM_IF_N_EQU, MOD_REG, REG_BX,
+                         "exec next iff ?BX? != reg-next (cHardwareCPU.cc:2190)"),
+    "if-less": InstSpec("if-less", SEM_IF_LESS, MOD_REG, REG_BX,
+                        "exec next iff ?BX? < reg-next (cHardwareCPU.cc:2235)"),
+    "if-label": InstSpec("if-label", SEM_IF_LABEL, MOD_LABEL, 0,
+                         "exec next iff complement label was just copied (cc:6914)"),
+    "mov-head": InstSpec("mov-head", SEM_MOV_HEAD, MOD_HEAD, HEAD_IP,
+                         "?IP? <- FLOW (cc:6809)"),
+    "jmp-head": InstSpec("jmp-head", SEM_JMP_HEAD, MOD_HEAD, HEAD_IP,
+                         "?IP? += CX (cc:6859)"),
+    "get-head": InstSpec("get-head", SEM_GET_HEAD, MOD_HEAD, HEAD_IP,
+                         "CX <- pos(?IP?) (cc:6907)"),
+    "set-flow": InstSpec("set-flow", SEM_SET_FLOW, MOD_REG, REG_CX,
+                         "FLOW <- ?CX? (cc:7270)"),
+    "shift-r": InstSpec("shift-r", SEM_SHIFT_R, MOD_REG, REG_BX),
+    "shift-l": InstSpec("shift-l", SEM_SHIFT_L, MOD_REG, REG_BX),
+    "inc": InstSpec("inc", SEM_INC, MOD_REG, REG_BX),
+    "dec": InstSpec("dec", SEM_DEC, MOD_REG, REG_BX),
+    "push": InstSpec("push", SEM_PUSH, MOD_REG, REG_BX),
+    "pop": InstSpec("pop", SEM_POP, MOD_REG, REG_BX),
+    "swap-stk": InstSpec("swap-stk", SEM_SWAP_STK, MOD_NONE, 0),
+    "swap": InstSpec("swap", SEM_SWAP, MOD_REG, REG_BX,
+                     "swap ?BX? with reg-next (cc:2742)"),
+    "add": InstSpec("add", SEM_ADD, MOD_REG, REG_BX,
+                    "?BX? <- BX+CX (cc:2959)"),
+    "sub": InstSpec("sub", SEM_SUB, MOD_REG, REG_BX),
+    "nand": InstSpec("nand", SEM_NAND, MOD_REG, REG_BX,
+                     "?BX? <- ~(BX&CX) (cc:3018)"),
+    "h-copy": InstSpec("h-copy", SEM_H_COPY, MOD_NONE, 0,
+                       "copy READ->WRITE w/ copy-mut; advance both (cc:7130)"),
+    "h-alloc": InstSpec("h-alloc", SEM_H_ALLOC, MOD_NONE, 0,
+                        "extend memory by OFFSPRING_SIZE_RANGE*len; AX<-old len (cc:3294)"),
+    "h-divide": InstSpec("h-divide", SEM_H_DIVIDE, MOD_NONE, 0,
+                         "divide at READ..WRITE (cc:6961,1775)"),
+    "IO": InstSpec("IO", SEM_IO, MOD_REG, REG_BX,
+                   "output ?BX?, check tasks, input next (cc:4188)"),
+    "h-search": InstSpec("h-search", SEM_H_SEARCH, MOD_LABEL, 0,
+                         "FLOW <- after complement label; BX=dist, CX=size (cc:7245)"),
+}
+
+# Aliases found in reference instset files / organisms.
+ALIASES = {
+    "nop-a": "nop-A", "nop-b": "nop-B", "nop-c": "nop-C",
+    "nop-x": "nop-A",  # placeholder; nop-X is a true no-op in extended sets
+    "io": "IO",
+}
+
+
+def build_semantic_tables(inst_names):
+    """Map a loaded instruction set (opcode -> name) to interpreter tables.
+
+    Returns a dict of numpy arrays indexed by *genome opcode*:
+      sem[op]         semantic opcode
+      mod_kind[op]    operand modifier kind
+      default_op[op]  default operand (reg or head index)
+      is_nop[op]      True for nop-A/B/C
+      nop_mod[op]     register/head index a nop maps to (0 for non-nops)
+    """
+    n = len(inst_names)
+    sem = np.zeros(n, np.int32)
+    mod_kind = np.zeros(n, np.int32)
+    default_op = np.zeros(n, np.int32)
+    is_nop = np.zeros(n, bool)
+    nop_mod = np.zeros(n, np.int32)
+    for op, name in enumerate(inst_names):
+        key = ALIASES.get(name, name)
+        if key not in INSTRUCTIONS:
+            raise ValueError(f"heads hardware does not implement instruction {name!r}")
+        spec = INSTRUCTIONS[key]
+        sem[op] = spec.sem
+        mod_kind[op] = spec.mod_kind
+        default_op[op] = spec.default_operand
+        if spec.sem in (SEM_NOP_A, SEM_NOP_B, SEM_NOP_C):
+            is_nop[op] = True
+            nop_mod[op] = spec.sem  # nop-A=0, nop-B=1, nop-C=2
+    return {
+        "sem": sem, "mod_kind": mod_kind, "default_op": default_op,
+        "is_nop": is_nop, "nop_mod": nop_mod, "num_insts": n,
+    }

@@ -1,0 +1,24 @@
+"""Hardware factory: HARDWARE_TYPE -> semantic-table builder.
+
+TPU-native equivalent of the cHardwareManager factory
+(avida-core/source/cpu/cHardwareManager.cc:123-147, switch over 5 hardware
+types).  Each entry maps a hardware type id to a module exposing
+`build_semantic_tables(inst_names)` plus its default instruction-set file
+name.  New hardware (transsmt, experimental, ...) registers here.
+"""
+
+from avida_tpu.models import heads
+
+HARDWARE_REGISTRY = {
+    0: {"name": "heads", "module": heads, "default_instset": "instset-heads.cfg"},
+    # 1: transsmt (host-parasite stack machine) -- planned
+    # 2: experimental, 3: bcr, 4: gp8 -- planned
+}
+
+
+def get_hardware(hw_type: int):
+    if hw_type not in HARDWARE_REGISTRY:
+        raise ValueError(
+            f"HARDWARE_TYPE {hw_type} not supported yet "
+            f"(available: {sorted(HARDWARE_REGISTRY)})")
+    return HARDWARE_REGISTRY[hw_type]

@@ -1,0 +1,147 @@
+"""Birth engine: placement of pending offspring as a batched scatter.
+
+Replaces the reference's immediate in-update birth path
+(cPopulation::ActivateOffspring cc:621 -> PositionOffspring cc:5185 ->
+ActivateOrganism cc:1320) with an end-of-update flush: every organism with a
+pending offspring picks a target cell (BIRTH_METHOD 0: random neighbor;
+PREFER_EMPTY; ALLOW_PARENT), conflicts resolve deterministically (lowest
+parent index wins; losers stay pending and retry next update -- a documented
+lockstep semantic, SURVEY.md §7 step 5), and all winners scatter their
+offspring state in one shot.
+
+Offspring phenotype initialization mirrors cPhenotype::SetupOffspring
+(cPhenotype.cc:349): merit inherited from the parent's post-DivideReset
+merit, copied size from child_copied_size, last_* stats from the parent.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def neighbor_table(world_x: int, world_y: int, geometry: int) -> np.ndarray:
+    """Static [N, 8] neighbor cell ids (ref cPopulation::SetupCellGrid
+    cc:323 + cTopology.h wiring; geometry 1=bounded grid, 2=torus).
+
+    For bounded grids, out-of-world neighbors are replaced by the cell itself
+    (self-loops never win placement over real neighbors when empty cells are
+    preferred; matches the reference's shorter connection lists closely
+    enough for the lockstep engine)."""
+    n = world_x * world_y
+    out = np.zeros((n, 8), np.int32)
+    offs = [(-1, -1), (-1, 0), (-1, 1), (0, -1), (0, 1), (1, -1), (1, 0), (1, 1)]
+    for y in range(world_y):
+        for x in range(world_x):
+            c = y * world_x + x
+            for k, (dy, dx) in enumerate(offs):
+                ny, nx = y + dy, x + dx
+                if geometry == 2:  # torus
+                    ny %= world_y
+                    nx %= world_x
+                    out[c, k] = ny * world_x + nx
+                else:              # bounded grid
+                    if 0 <= ny < world_y and 0 <= nx < world_x:
+                        out[c, k] = ny * world_x + nx
+                    else:
+                        out[c, k] = c
+    return out
+
+
+def flush_births(params, st, key, neighbors, update_no):
+    """Place pending offspring.  neighbors: int32[N, 8] static table."""
+    n, L = st.mem.shape
+    rows = jnp.arange(n)
+    pending = st.divide_pending
+
+    # ---- target selection (PositionOffspring, cc:5185; BIRTH_METHOD 0) ----
+    cand = neighbors                                  # [N, 8]
+    if params.allow_parent:
+        cand = jnp.concatenate([cand, rows[:, None]], axis=1)   # [N, 9]
+    ncand = cand.shape[1]
+    occupied = st.alive[cand]                         # [N, C]
+    u = jax.random.uniform(key, (n, ncand))
+    score = u
+    if params.prefer_empty:
+        score = score + jnp.where(~occupied, 10.0, 0.0)
+    choice = jnp.argmax(score, axis=1)
+    target = cand[rows, choice]                       # [N]
+
+    # ---- conflict resolution: lowest parent index claims the cell ----
+    # claim[j] = min index of a pending parent targeting cell j (BIG if none).
+    # Every claimed cell receives exactly one birth, from parent claim[j];
+    # this turns placement into a clean per-cell *gather* with no scatter
+    # conflicts.
+    BIG = jnp.int32(2**30)
+    claim = jnp.full(n, BIG, jnp.int32)
+    claim = claim.at[jnp.where(pending, target, rows)].min(
+        jnp.where(pending, rows, BIG))
+    births = claim < BIG                   # bool[N]: cell receives a newborn
+    parent_idx = jnp.clip(claim, 0, n - 1)  # int[N]: who fathered it
+    won = pending & (claim[target] == rows)
+
+    # zero/fresh fields for the newborn
+    off_mem = st.off_mem
+    off_len = st.off_len
+    k_inputs, _ = jax.random.split(key)
+    low = jax.random.randint(k_inputs, (n, 3), 0, 1 << 24, dtype=jnp.int32)
+    tops = jnp.array([15 << 24, 51 << 24, 85 << 24], jnp.int32)
+    fresh_inputs = tops[None, :] + low
+
+    max_exec = jnp.where(
+        params.death_method == 2, params.age_limit * off_len,
+        jnp.where(params.death_method == 1, params.age_limit, 2**30))
+
+    updates = {
+        "mem": off_mem, "mem_len": off_len,
+        "genome": off_mem, "genome_len": off_len,
+        "flag_exec": jnp.zeros((n, L), bool), "flag_copied": jnp.zeros((n, L), bool),
+        "regs": jnp.zeros((n, 3), jnp.int32), "heads": jnp.zeros((n, 4), jnp.int32),
+        "stacks": jnp.zeros((n, 2, 10), jnp.int32), "sp": jnp.zeros((n, 2), jnp.int32),
+        "active_stack": jnp.zeros(n, jnp.int32),
+        "read_label": jnp.zeros((n, 10), jnp.int8),
+        "read_label_len": jnp.zeros(n, jnp.int32),
+        "mal_active": jnp.zeros(n, bool),
+        "alive": jnp.ones(n, bool),
+        "inputs": fresh_inputs, "input_ptr": jnp.zeros(n, jnp.int32),
+        "input_buf": jnp.zeros((n, 3), jnp.int32),
+        "input_buf_n": jnp.zeros(n, jnp.int32),
+        "output_buf": jnp.zeros(n, jnp.int32),
+        "merit": st.merit,                       # parent post-DivideReset merit
+        "cur_bonus": jnp.full(n, params.default_bonus, st.cur_bonus.dtype),
+        "cur_task_count": jnp.zeros_like(st.cur_task_count),
+        "cur_reaction_count": jnp.zeros_like(st.cur_reaction_count),
+        "last_task_count": st.last_task_count,   # inherited expectation
+        "time_used": jnp.zeros(n, jnp.int32), "cpu_cycles": jnp.zeros(n, jnp.int32),
+        "gestation_start": jnp.zeros(n, jnp.int32),
+        "gestation_time": st.gestation_time,     # parent's (SetupOffspring)
+        "fitness": st.fitness, "last_bonus": st.last_bonus,
+        "last_merit_base": st.last_merit_base,
+        "executed_size": st.executed_size,
+        "copied_size": st.child_copied_size,
+        "child_copied_size": jnp.zeros(n, jnp.int32),
+        "generation": st.generation,             # parent already incremented
+        "max_executed": max_exec,
+        "num_divides": jnp.zeros(n, jnp.int32),
+        "divide_pending": jnp.zeros(n, bool),
+        "off_mem": jnp.zeros((n, L), jnp.int8), "off_len": jnp.zeros(n, jnp.int32),
+        "off_copied_size": jnp.zeros(n, jnp.int32),
+        "genotype_id": jnp.full(n, -1, jnp.int32),
+        "parent_id": rows.astype(jnp.int32),
+        "birth_update": jnp.full(n, update_no, jnp.int32),
+        "insts_executed": jnp.zeros(n, jnp.int32),
+    }
+
+    new_fields = {}
+    for name, src in updates.items():
+        dst = getattr(st, name)
+        mask = births.reshape((n,) + (1,) * (src.ndim - 1))
+        new_fields[name] = jnp.where(mask, src[parent_idx], dst)
+
+    st = st.replace(**new_fields)
+    # winners' pending flags clear (losers retry next update); a parent cell
+    # overwritten by a newborn is already governed by the newborn state
+    cleared = jnp.where(won, False, st.divide_pending)
+    st = st.replace(divide_pending=cleared)
+    return st

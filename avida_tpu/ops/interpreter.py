@@ -1,0 +1,431 @@
+"""The SIMD lockstep interpreter: one instruction for every organism at once.
+
+This replaces the reference's per-organism inner hot loop
+(cHardwareCPU::SingleProcess, avida-core/source/cpu/cHardwareCPU.cc:908-1060,
+and its 563-way function-pointer dispatch at cc:1079) with *instruction-class
+batching*: every semantic opcode's effect is computed as masked batched tensor
+ops over the whole population, then merged.  There is no per-organism control
+flow -- organisms at different opcodes are different lanes of the same tensor
+program, which is what makes the design map onto the TPU's vector units and
+lets XLA fuse the whole step into a few kernels.
+
+Per-instruction semantics are re-derived from the cited reference
+implementations (see avida_tpu/models/heads.py docstrings for the map).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from avida_tpu.models.heads import (
+    MOD_HEAD, MOD_LABEL, MOD_NONE, MOD_REG,
+    SEM_ADD, SEM_DEC, SEM_GET_HEAD, SEM_H_ALLOC, SEM_H_COPY, SEM_H_DIVIDE,
+    SEM_H_SEARCH, SEM_IF_LABEL, SEM_IF_LESS, SEM_IF_N_EQU, SEM_INC, SEM_IO,
+    SEM_JMP_HEAD, SEM_MOV_HEAD, SEM_NAND, SEM_POP, SEM_PUSH, SEM_SET_FLOW,
+    SEM_SHIFT_L, SEM_SHIFT_R, SEM_SUB, SEM_SWAP, SEM_SWAP_STK,
+    HEAD_IP, HEAD_READ, HEAD_WRITE, HEAD_FLOW, MAX_LABEL_SIZE,
+)
+from avida_tpu.ops import tasks as tasks_ops
+
+
+def _adjust(pos, mlen):
+    """Head adjustment (ref cHeadCPU::fullAdjust, cHeadCPU.cc:28): negative
+    positions clamp to 0, positions beyond memory wrap modulo memory size."""
+    mlen = jnp.maximum(mlen, 1)
+    return jnp.where(pos < 0, 0, pos % mlen)
+
+
+def micro_step(params, st, key, exec_mask):
+    """Execute one CPU cycle for every organism where exec_mask is set.
+
+    Equivalent to one pass of the reference hot loop (Avida2Driver.cc:111-116)
+    over every scheduled organism simultaneously.  Returns the new state.
+    """
+    n, L = st.mem.shape
+    rows = jnp.arange(n)
+    cols = jnp.arange(L)
+
+    # instruction-set tables (trace-time constants)
+    sem_t = jnp.asarray(params.sem, jnp.int32)
+    mod_kind_t = jnp.asarray(params.mod_kind, jnp.int32)
+    default_op_t = jnp.asarray(params.default_op, jnp.int32)
+    is_nop_t = jnp.asarray(params.is_nop, bool)
+    nop_mod_t = jnp.asarray(params.nop_mod, jnp.int32)
+    num_insts = params.num_insts
+
+    mlen = jnp.maximum(st.mem_len, 1)
+    ip = _adjust(st.heads[:, HEAD_IP], mlen)
+    cur_op = st.mem[rows, ip].astype(jnp.int32)
+    cur_op = jnp.clip(cur_op, 0, num_insts - 1)
+    sem = jnp.where(exec_mask, sem_t[cur_op], -1)
+
+    def is_op(s):
+        return sem == s
+
+    # ---- operand resolution (FindModifiedRegister/Head, cc:1622,1663) ----
+    next_pos = _adjust(ip + 1, mlen)
+    next_op = jnp.clip(st.mem[rows, next_pos].astype(jnp.int32), 0, num_insts - 1)
+    next_is_nop = is_nop_t[next_op]
+    mod_kind = jnp.where(exec_mask, mod_kind_t[cur_op], MOD_NONE)
+    wants_mod = (mod_kind == MOD_REG) | (mod_kind == MOD_HEAD)
+    has_mod = wants_mod & next_is_nop
+    operand = jnp.where(has_mod, nop_mod_t[next_op], default_op_t[cur_op])
+    consumed = has_mod.astype(jnp.int32)
+
+    # ---- label read (ReadLabel, cc:1484: nop run after IP, max 10) ----
+    has_label = mod_kind == MOD_LABEL
+    loff = jnp.arange(MAX_LABEL_SIZE, dtype=jnp.int32)
+    lab_pos = _adjust(ip[:, None] + 1 + loff[None, :], mlen[:, None])  # [N,10]
+    lab_ops = jnp.clip(st.mem[rows[:, None], lab_pos].astype(jnp.int32),
+                       0, num_insts - 1)
+    lab_isnop = is_nop_t[lab_ops]
+    lab_run = jnp.cumprod(lab_isnop.astype(jnp.int32), axis=1)
+    label_len = jnp.where(has_label, lab_run.sum(axis=1), 0)
+    label = nop_mod_t[lab_ops]                                          # [N,10]
+    consumed = jnp.where(has_label, label_len, consumed)
+
+    # ---- executed flags (SetFlagExecuted in SingleProcess + helpers) ----
+    flag_exec = st.flag_exec
+    flag_exec = flag_exec.at[rows, ip].set(flag_exec[rows, ip] | exec_mask)
+    nop_exec = has_mod  # the consumed modifier nop is marked executed
+    flag_exec = flag_exec.at[rows, next_pos].set(flag_exec[rows, next_pos] | nop_exec)
+    # first label nop marked (MAX_LABEL_EXE_SIZE=1, cAvidaConfig default)
+    lab0 = lab_pos[:, 0]
+    lab0_exec = has_label & (label_len > 0)
+    flag_exec = flag_exec.at[rows, lab0].set(flag_exec[rows, lab0] | lab0_exec)
+
+    # ---- register reads (pre-update values) ----
+    regs0 = st.regs
+    val = regs0[rows, operand]          # ?reg? for MOD_REG ops
+    next_reg = (operand + 1) % 3
+    val2 = regs0[rows, next_reg]
+    bx = regs0[:, 1]
+    cx = regs0[:, 2]
+
+    # ---- PRNG draws for this step ----
+    k_mut, k_in1, k_ins, k_del, k_mpos, k_ipos, k_dpos, k_iinst = \
+        jax.random.split(key, 8)
+    u_copy_mut = jax.random.uniform(k_mut, (n,))
+    rand_inst = jax.random.randint(k_in1, (n,), 0, num_insts, dtype=jnp.int32)
+
+    # ---- stacks (cCPUStack.h:59-77: push decrements sp, pop reads+zeros) ----
+    a = st.active_stack
+    spa = st.sp[rows, a]
+    push_m = is_op(SEM_PUSH)
+    pop_m = is_op(SEM_POP)
+    sp_push = (spa + 9) % 10
+    pop_val = st.stacks[rows, a, spa]
+    stacks = st.stacks
+    stacks = stacks.at[rows, a, sp_push].set(
+        jnp.where(push_m, val, stacks[rows, a, sp_push]))
+    stacks = stacks.at[rows, a, spa].set(
+        jnp.where(pop_m, 0, stacks[rows, a, spa]))
+    new_spa = jnp.where(push_m, sp_push, jnp.where(pop_m, (spa + 1) % 10, spa))
+    sp = st.sp.at[rows, a].set(new_spa)
+    active_stack = jnp.where(is_op(SEM_SWAP_STK), 1 - a, a)
+
+    # ---- h-search (cc:7245: complement label, find-forward from origin) ----
+    lbl_c = (label + 1) % 3             # complement rotation (Rotate(1,3))
+    srch = is_op(SEM_H_SEARCH)
+    # match[o, q] = complement label occurs at memory offset q
+    match = jnp.ones((n, L), bool)
+    for k in range(MAX_LABEL_SIZE):
+        pk = jnp.minimum(cols[None, :] + k, L - 1)
+        opk = jnp.clip(st.mem[rows[:, None], pk].astype(jnp.int32), 0, num_insts - 1)
+        mk = is_nop_t[opk] & (nop_mod_t[opk] == lbl_c[:, k:k + 1])
+        match = match & jnp.where(k < label_len[:, None], mk, True)
+    match = match & ((cols[None, :] + label_len[:, None]) <= mlen[:, None])
+    match = match & (label_len[:, None] > 0)
+    found = match.any(axis=1)
+    q_found = jnp.argmax(match, axis=1)
+    ip_after_label = _adjust(ip + label_len, mlen)   # IP sits on last label nop
+    search_head = jnp.where(found, q_found + label_len - 1, ip_after_label)
+    search_bx = search_head - ip_after_label
+    search_cx = label_len
+    new_flow_srch = _adjust(search_head + 1, mlen)
+
+    # ---- if-label (cc:6914: complement label vs recently-copied label) ----
+    rl_match = (st.read_label_len == label_len)
+    for k in range(MAX_LABEL_SIZE):
+        rl_match = rl_match & jnp.where(
+            k < label_len,
+            st.read_label[:, k].astype(jnp.int32) == lbl_c[:, k], True)
+
+    # ---- conditionals: extra IP advance when condition fails ----
+    skip = jnp.zeros(n, bool)
+    skip = jnp.where(is_op(SEM_IF_N_EQU), val == val2, skip)
+    skip = jnp.where(is_op(SEM_IF_LESS), val >= val2, skip)
+    skip = jnp.where(is_op(SEM_IF_LABEL), ~rl_match, skip)
+
+    # ---- h-alloc (Inst_MaxAlloc cc:3294 + Allocate_Main cc:1707) ----
+    alloc_m0 = is_op(SEM_H_ALLOC)
+    old_len = mlen
+    alloc_size = jnp.minimum(
+        (params.offspring_size_range * old_len.astype(jnp.float32)).astype(jnp.int32),
+        L - old_len)
+    alloc_ok = (alloc_size >= 1)
+    if params.require_allocate:
+        alloc_ok = alloc_ok & ~st.mal_active
+    alloc_ok = alloc_ok & (old_len <= (alloc_size.astype(jnp.float32)
+                                       * params.offspring_size_range).astype(jnp.int32))
+    alloc_m = alloc_m0 & alloc_ok
+    new_len_alloc = old_len + alloc_size
+    # ALLOC_METHOD 0: fill with default instruction (op 0)
+    fill_zone = (cols[None, :] >= old_len[:, None]) & (cols[None, :] < new_len_alloc[:, None])
+    mem = jnp.where((alloc_m[:, None] & fill_zone), jnp.int8(0), st.mem)
+    mem_len = jnp.where(alloc_m, new_len_alloc, st.mem_len)
+    mal_active = st.mal_active | alloc_m
+
+    # ---- h-copy (cc:7130: read->write with copy mutation, advance both) ----
+    copy_m = is_op(SEM_H_COPY)
+    rp = _adjust(st.heads[:, HEAD_READ], mlen)
+    wp = _adjust(st.heads[:, HEAD_WRITE], mlen)
+    read_inst = jnp.clip(mem[rows, rp].astype(jnp.int32), 0, num_insts - 1)
+    do_mut = copy_m & (u_copy_mut < params.copy_mut_prob)
+    written = jnp.where(do_mut, rand_inst, read_inst)
+    mem = mem.at[rows, wp].set(
+        jnp.where(copy_m, written.astype(jnp.int8), mem[rows, wp]))
+    flag_copied = st.flag_copied
+    flag_copied = flag_copied.at[rows, wp].set(flag_copied[rows, wp] | copy_m)
+    # read-label tracking uses the PRE-mutation instruction (ReadInst cc:1459)
+    ri_nop = is_nop_t[read_inst] & copy_m
+    ri_clear = (~is_nop_t[read_inst]) & copy_m
+    rl_len = st.read_label_len
+    can_append = ri_nop & (rl_len < MAX_LABEL_SIZE)
+    read_label = st.read_label.at[rows, jnp.clip(rl_len, 0, MAX_LABEL_SIZE - 1)].set(
+        jnp.where(can_append, nop_mod_t[read_inst].astype(jnp.int8),
+                  st.read_label[rows, jnp.clip(rl_len, 0, MAX_LABEL_SIZE - 1)]))
+    read_label_len = jnp.where(ri_clear, 0,
+                               jnp.where(can_append, rl_len + 1, rl_len))
+
+    # ---- h-divide (Inst_HeadDivide cc:6961 -> Divide_Main cc:1775) ----
+    div_try = is_op(SEM_H_DIVIDE)
+    div_point = rp
+    child_end = jnp.where(wp == 0, mlen, wp)
+    child_size = child_end - div_point
+    parent_size = div_point
+    gsize = st.genome_len
+    fsize = gsize.astype(jnp.float32)
+    min_sz = jnp.maximum(params.min_genome_len,
+                         (fsize / params.offspring_size_range).astype(jnp.int32))
+    max_sz = jnp.minimum(L, (fsize * params.offspring_size_range).astype(jnp.int32))
+    exec_count = (flag_exec & (cols[None, :] < parent_size[:, None])).sum(axis=1)
+    copy_zone = ((cols[None, :] >= parent_size[:, None]) &
+                 (cols[None, :] < (parent_size + child_size)[:, None]))
+    copied_count = (flag_copied & copy_zone).sum(axis=1)
+    viable = ((child_size >= min_sz) & (child_size <= max_sz) &
+              (parent_size >= min_sz) & (parent_size <= max_sz) &
+              (exec_count >= (parent_size.astype(jnp.float32)
+                              * params.min_exe_lines).astype(jnp.int32)) &
+              (copied_count >= (child_size.astype(jnp.float32)
+                                * params.min_copied_lines).astype(jnp.int32)) &
+              ~st.divide_pending)   # lockstep: one pending birth per organism
+    div_m = div_try & viable
+
+    # offspring genome extraction: off[q] = mem[div_point + q], q < child_size
+    src = jnp.minimum(div_point[:, None] + cols[None, :], L - 1)
+    off_raw = mem[rows[:, None], src]
+    off_mask = cols[None, :] < child_size[:, None]
+    off = jnp.where(off_mask, off_raw, jnp.int8(0))
+    off_len = child_size
+
+    # divide mutations (Divide_DoMutations, cHardwareBase.cc:296: point sub,
+    # then single insertion, then single deletion; stock rates 0/0.05/0.05)
+    u_mut = jax.random.uniform(k_ins, (n, 3))
+    r_inst2 = jax.random.randint(k_iinst, (n, 2), 0, num_insts, dtype=jnp.int32)
+    # point substitution
+    if params.divide_mut_prob > 0:
+        mpos = jax.random.randint(k_mpos, (n,), 0, jnp.maximum(off_len, 1))
+        do_sub = div_m & (u_mut[:, 0] < params.divide_mut_prob) & (off_len > 0)
+        off = off.at[rows, jnp.clip(mpos, 0, L - 1)].set(
+            jnp.where(do_sub, r_inst2[:, 0].astype(jnp.int8),
+                      off[rows, jnp.clip(mpos, 0, L - 1)]))
+    # single insertion
+    if params.divide_ins_prob > 0:
+        ipos = jax.random.randint(k_ipos, (n,), 0, jnp.maximum(off_len, 1) + 1)
+        do_ins = div_m & (u_mut[:, 1] < params.divide_ins_prob) & (off_len + 1 <= max_sz)
+        shifted = jnp.where(cols[None, :] > ipos[:, None],
+                            off[rows[:, None], jnp.maximum(cols[None, :] - 1, 0)],
+                            off)
+        inserted = shifted.at[rows, jnp.clip(ipos, 0, L - 1)].set(
+            r_inst2[:, 1].astype(jnp.int8))
+        off = jnp.where(do_ins[:, None], inserted, off)
+        off_len = jnp.where(do_ins, off_len + 1, off_len)
+    # single deletion
+    if params.divide_del_prob > 0:
+        dpos = jax.random.randint(k_dpos, (n,), 0, jnp.maximum(off_len, 1))
+        do_del = div_m & (u_mut[:, 2] < params.divide_del_prob) & (off_len - 1 >= params.min_genome_len)
+        deleted = jnp.where(cols[None, :] >= dpos[:, None],
+                            off[rows[:, None], jnp.minimum(cols[None, :] + 1, L - 1)],
+                            off)
+        deleted = jnp.where(cols[None, :] >= (off_len - 1)[:, None], jnp.int8(0), deleted)
+        off = jnp.where(do_del[:, None], deleted, off)
+        off_len = jnp.where(do_del, off_len - 1, off_len)
+
+    # ---- IO + task evaluation (Inst_TaskIO cc:4188; SURVEY §3.4) ----
+    io_m = is_op(SEM_IO)
+    env_tables = tasks_ops.env_tables_to_device(params)
+    logic_id = tasks_ops.compute_logic_id(st.input_buf, st.input_buf_n, val)
+    new_bonus, new_tc, new_rc, _ = tasks_ops.apply_reactions(
+        env_tables, io_m, logic_id, st.cur_bonus,
+        st.cur_task_count, st.cur_reaction_count)
+    value_in = st.inputs[rows, st.input_ptr % 3]
+    input_ptr = jnp.where(io_m, st.input_ptr + 1, st.input_ptr)
+    input_buf = jnp.where(io_m[:, None],
+                          jnp.stack([value_in, st.input_buf[:, 0],
+                                     st.input_buf[:, 1]], axis=1),
+                          st.input_buf)
+    input_buf_n = jnp.where(io_m, jnp.minimum(st.input_buf_n + 1, 3),
+                            st.input_buf_n)
+    output_buf = jnp.where(io_m, val, st.output_buf)
+    cur_bonus = jnp.where(io_m, new_bonus, st.cur_bonus)
+    cur_task_count = jnp.where(io_m[:, None], new_tc, st.cur_task_count)
+    cur_reaction_count = jnp.where(io_m[:, None], new_rc, st.cur_reaction_count)
+
+    # ---- register writes ----
+    res = val
+    wrote = jnp.zeros(n, bool)
+    for s, v in ((SEM_SHIFT_R, val >> 1), (SEM_SHIFT_L, val << 1),
+                 (SEM_INC, val + 1), (SEM_DEC, val - 1),
+                 (SEM_ADD, bx + cx), (SEM_SUB, bx - cx),
+                 (SEM_NAND, ~(bx & cx)), (SEM_POP, pop_val),
+                 (SEM_IO, value_in), (SEM_SWAP, val2)):
+        res = jnp.where(is_op(s), v, res)
+        wrote = wrote | is_op(s)
+
+    def setreg(regs, idx, v, m):
+        return regs.at[rows, idx].set(jnp.where(m, v, regs[rows, idx]))
+
+    regs = setreg(regs0, operand, res, wrote)
+    regs = setreg(regs, next_reg, val, is_op(SEM_SWAP))
+    # get-head: CX <- pos of ?head? (cc:6907).  When the selected head is IP
+    # itself, its position reflects the consumed modifier nop (FindModifiedHead
+    # advances IP onto the nop before the head is read).
+    hsel0 = jnp.where(mod_kind == MOD_HEAD, operand, HEAD_IP)
+    eff_head_pos = jnp.where(hsel0 == HEAD_IP,
+                             _adjust(ip + consumed, mlen),
+                             _adjust(st.heads[rows, hsel0], mlen))
+    regs = setreg(regs, 2, eff_head_pos, is_op(SEM_GET_HEAD))
+    regs = setreg(regs, 0, old_len, alloc_m)            # h-alloc: AX <- old size
+    regs = setreg(regs, 1, search_bx, srch)             # h-search: BX dist
+    regs = setreg(regs, 2, search_cx, srch)             # h-search: CX size
+    # divide (DIVIDE_METHOD 1): hardware reset -> registers cleared
+    regs = jnp.where(div_m[:, None], 0, regs)
+
+    # ---- head writes ----
+    heads = st.heads
+    mov_m = is_op(SEM_MOV_HEAD)
+    jmp_m = is_op(SEM_JMP_HEAD)
+    hsel = hsel0
+    hpos = eff_head_pos
+    flow0 = _adjust(heads[:, HEAD_FLOW], mlen)
+    new_hpos = jnp.where(mov_m, flow0, _adjust(hpos + cx, mlen))
+    heads = heads.at[rows, hsel].set(
+        jnp.where(mov_m | jmp_m, new_hpos, heads[rows, hsel]))
+    setflow_m = is_op(SEM_SET_FLOW)
+    heads = heads.at[:, HEAD_FLOW].set(
+        jnp.where(setflow_m, _adjust(val, mlen),
+                  jnp.where(srch, new_flow_srch, heads[:, HEAD_FLOW])))
+    # h-copy advances READ/WRITE (with eager wrap, cHeadCPU.h:78)
+    heads = heads.at[:, HEAD_READ].set(
+        jnp.where(copy_m, _adjust(rp + 1, mlen), heads[:, HEAD_READ]))
+    heads = heads.at[:, HEAD_WRITE].set(
+        jnp.where(copy_m, _adjust(wp + 1, mlen), heads[:, HEAD_WRITE]))
+
+    # ---- IP advance ----
+    # mov-head targeting IP suppresses the end-of-cycle advance (cc:6809);
+    # a successful divide resets the CPU (DIVIDE_METHOD 1 -> IP=0).
+    mov_ip = mov_m & (hsel == HEAD_IP)
+    jmp_ip = jmp_m & (hsel == HEAD_IP)
+    ip_seq = _adjust(ip + consumed + skip.astype(jnp.int32) + 1, mlen)
+    # jmp-head on IP: jump from the post-modifier position, then advance
+    jmp_tgt = _adjust(_adjust(ip + consumed + cx, mlen) + 1, mlen)
+    ip_new = jnp.where(jmp_ip, jmp_tgt, ip_seq)
+    ip_new = jnp.where(mov_ip, flow0, ip_new)
+    ip_new = jnp.where(div_m, 0, ip_new)
+    ip_new = jnp.where(exec_mask, ip_new, st.heads[:, HEAD_IP])
+    heads = heads.at[:, HEAD_IP].set(ip_new)
+
+    # ---- divide: parent reset + pending offspring ----
+    mem_len = jnp.where(div_m, div_point, mem_len)
+    flag_exec = jnp.where(div_m[:, None], False, flag_exec)
+    flag_copied = jnp.where(div_m[:, None], False, flag_copied)
+    heads = jnp.where(div_m[:, None], 0, heads)
+    stacks = jnp.where(div_m[:, None, None], 0, stacks)
+    sp = jnp.where(div_m[:, None], 0, sp)
+    active_stack = jnp.where(div_m, 0, active_stack)
+    read_label_len = jnp.where(div_m, 0, read_label_len)
+    mal_active = jnp.where(div_m, False, mal_active)
+
+    # phenotype DivideReset (cPhenotype.cc:824): merit from size & bonus
+    merit_base = _calc_size_merit(params, gsize, st.copied_size, exec_count)
+    fdt = st.merit.dtype
+    new_merit = merit_base.astype(fdt) * cur_bonus if params.inherit_merit \
+        else merit_base.astype(fdt)
+    gestation = st.time_used + 1 - st.gestation_start  # +1: this cycle counts
+    new_fitness = new_merit / jnp.maximum(gestation, 1).astype(fdt)
+
+    merit = jnp.where(div_m, new_merit, st.merit)
+    fitness = jnp.where(div_m, new_fitness, st.fitness)
+    gestation_time = jnp.where(div_m, gestation, st.gestation_time)
+    last_bonus = jnp.where(div_m, cur_bonus, st.last_bonus)
+    last_merit_base = jnp.where(div_m, merit_base.astype(fdt), st.last_merit_base)
+    last_task_count = jnp.where(div_m[:, None], cur_task_count, st.last_task_count)
+    executed_size = jnp.where(div_m, exec_count, st.executed_size)
+    child_copied_size = jnp.where(div_m, copied_count, st.child_copied_size)
+    cur_bonus = jnp.where(div_m, params.default_bonus, cur_bonus)
+    cur_task_count = jnp.where(div_m[:, None], 0, cur_task_count)
+    cur_reaction_count = jnp.where(div_m[:, None], 0, cur_reaction_count)
+    generation = jnp.where(div_m, st.generation + 1, st.generation)
+    num_divides = jnp.where(div_m, st.num_divides + 1, st.num_divides)
+
+    # ---- time accounting + death (SingleProcess tail, cc:1047-1051) ----
+    time_used = st.time_used + exec_mask.astype(jnp.int32)
+    cpu_cycles = st.cpu_cycles + exec_mask.astype(jnp.int32)
+    gestation_start = jnp.where(div_m, time_used, st.gestation_start)
+    died = exec_mask & (st.max_executed > 0) & (time_used >= st.max_executed)
+    alive = st.alive & ~died
+    insts_executed = st.insts_executed + exec_mask.astype(jnp.int32)
+
+    return st.replace(
+        mem=mem, mem_len=mem_len, flag_exec=flag_exec, flag_copied=flag_copied,
+        regs=regs, heads=heads, stacks=stacks, sp=sp, active_stack=active_stack,
+        read_label=read_label, read_label_len=read_label_len,
+        mal_active=mal_active, alive=alive,
+        input_ptr=input_ptr, input_buf=input_buf, input_buf_n=input_buf_n,
+        output_buf=output_buf,
+        merit=merit, cur_bonus=cur_bonus,
+        cur_task_count=cur_task_count, cur_reaction_count=cur_reaction_count,
+        last_task_count=last_task_count,
+        time_used=time_used, cpu_cycles=cpu_cycles,
+        gestation_start=gestation_start, gestation_time=gestation_time,
+        fitness=fitness, last_bonus=last_bonus, last_merit_base=last_merit_base,
+        executed_size=executed_size, child_copied_size=child_copied_size,
+        generation=generation, num_divides=num_divides,
+        divide_pending=st.divide_pending | div_m,
+        off_mem=jnp.where(div_m[:, None], off, st.off_mem),
+        off_len=jnp.where(div_m, off_len, st.off_len),
+        off_copied_size=jnp.where(div_m, copied_count, st.off_copied_size),
+        insts_executed=insts_executed,
+    )
+
+
+def _calc_size_merit(params, genome_len, copied_size, executed_size):
+    """cPhenotype::CalcSizeMerit (cPhenotype.cc, BASE_MERIT_METHOD switch)."""
+    m = params.base_merit_method
+    if m == 0:
+        return jnp.full_like(genome_len, params.base_const_merit).astype(jnp.float32)
+    if m == 1:
+        return copied_size.astype(jnp.float32)
+    if m == 2:
+        return executed_size.astype(jnp.float32)
+    if m == 3:
+        return genome_len.astype(jnp.float32)
+    if m == 4:
+        return jnp.minimum(jnp.minimum(genome_len, copied_size),
+                           executed_size).astype(jnp.float32)
+    if m == 5:
+        least = jnp.minimum(jnp.minimum(genome_len, copied_size), executed_size)
+        return jnp.sqrt(least.astype(jnp.float32))
+    raise NotImplementedError(f"BASE_MERIT_METHOD {m}")

@@ -1,0 +1,57 @@
+"""Merit-proportional CPU-cycle allocation, lockstep style.
+
+The reference serializes organisms through Apto schedulers
+(cPopulation::BuildTimeSlicer, cPopulation.cc:7326; SLICING_METHOD semantics
+at cAvidaConfig.h:545).  On TPU the stream of `Next()` picks collapses into a
+per-update *instruction budget* per organism (SURVEY.md §7 step 3):
+
+  method 0 (CONSTANT):     k_i = AVE_TIME_SLICE for every living organism
+  method 1 (PROBABILISTIC):k_i ~ Binomial(UD_size, merit_i / sum(merit))
+                           (independent binomials approximate the reference's
+                           multinomial; documented deviation, statistically
+                           equivalent at population scale)
+  method 2 (INTEGRATED):   deterministic stride scheduling: k_i =
+                           floor(c_i) counts of the merit-proportional share
+                           with largest-remainder rounding
+
+UD_size = AVE_TIME_SLICE * num_organisms (cWorld::CalculateUpdateSize,
+cWorld.cc:247).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def compute_budgets(params, st, key):
+    """Returns int32[N] per-organism instruction budgets for one update."""
+    alive = st.alive
+    num_orgs = alive.sum()
+    ud_size = params.ave_time_slice * num_orgs
+
+    if params.slicing_method == 0:
+        return jnp.where(alive, params.ave_time_slice, 0).astype(jnp.int32)
+
+    merit = jnp.where(alive, jnp.maximum(st.merit, 0.0), 0.0)
+    total = merit.sum()
+    # all-zero merit degenerates to constant slicing (reference merit >= 1)
+    p = jnp.where(total > 0, merit / jnp.maximum(total, 1e-30), 0.0)
+
+    if params.slicing_method == 1:
+        k = jax.random.binomial(key, ud_size.astype(jnp.float32), p)
+        k = jnp.where(alive, k, 0).astype(jnp.int32)
+        return k
+
+    if params.slicing_method == 2:
+        share = p * ud_size.astype(p.dtype)
+        base = jnp.floor(share)
+        frac = share - base
+        remainder = (ud_size - base.sum()).astype(jnp.int32)
+        # largest-remainder rounding: hand out leftover cycles by frac rank
+        order = jnp.argsort(-frac)
+        rank = jnp.zeros_like(order).at[order].set(jnp.arange(order.shape[0]))
+        k = base.astype(jnp.int32) + (rank < remainder).astype(jnp.int32)
+        return jnp.where(alive, k, 0)
+
+    raise NotImplementedError(f"SLICING_METHOD {params.slicing_method}")

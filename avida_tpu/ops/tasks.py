@@ -1,0 +1,120 @@
+"""Vectorized task evaluation: logic IDs and reaction rewards.
+
+TPU-native re-expression of the IO hot path (SURVEY.md §3.4):
+cOrganism::DoOutput -> cPhenotype::TestOutput -> cEnvironment::TestOutput
+(cEnvironment.cc:1314) -> cTaskLib::SetupTests (cTaskLib.cc:369, the logic-ID
+truth-table scan) -> TestRequisites (cc:1408) -> DoProcesses bonus math
+(cc:1610,1731-1758).
+
+The whole pipeline is batched over the population: one [N,32,8] truth-table
+reduction computes every organism's logic ID, then reaction triggering,
+requisite windows and pow/add/mult bonus application are masked tensor ops.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+PROCTYPE_ADD, PROCTYPE_MULT, PROCTYPE_POW, PROCTYPE_LIN = 0, 1, 2, 3
+
+
+def compute_logic_id(input_buf, input_buf_n, output):
+    """Batched cTaskLib::SetupTests (cTaskLib.cc:369-448).
+
+    input_buf: int32[N,3] most-recent-first; input_buf_n: int32[N];
+    output: int32[N].  Returns int32[N] logic id in [0,255], or -1 if the
+    output is not a consistent pure function of the inputs.
+    """
+    n_in = input_buf_n
+    i0 = jnp.where(n_in > 0, input_buf[:, 0], 0)
+    i1 = jnp.where(n_in > 1, input_buf[:, 1], 0)
+    i2 = jnp.where(n_in > 2, input_buf[:, 2], 0)
+
+    j = jnp.arange(32, dtype=jnp.int32)
+    b0 = (i0[:, None] >> j[None, :]) & 1          # [N,32]
+    b1 = (i1[:, None] >> j[None, :]) & 1
+    b2 = (i2[:, None] >> j[None, :]) & 1
+    pos = b0 + 2 * b1 + 4 * b2                    # logic position per bit
+    ob = (output[:, None] >> j[None, :]) & 1
+
+    combos = jnp.arange(8, dtype=jnp.int32)
+    onehot = (pos[:, :, None] == combos[None, None, :])          # [N,32,8]
+    cnt = onehot.sum(axis=1)                                     # [N,8]
+    ones = (onehot & (ob[:, :, None] == 1)).sum(axis=1)          # [N,8]
+    consistent = (ones == 0) | (ones == cnt)
+    func_ok = consistent.all(axis=1)
+
+    lo = (ones > 0).astype(jnp.int32)             # defined where cnt>0
+    # Fill rules for missing inputs (cTaskLib.cc:419-433): absent inputs are
+    # zero, so combos with those bits set never occur; duplicate from below.
+    def fill(lo, c_to, c_from, cond):
+        return lo.at[:, c_to].set(jnp.where(cond, lo[:, c_from], lo[:, c_to]))
+    lo = fill(lo, 1, 0, n_in < 1)
+    lo = fill(lo, 2, 0, n_in < 2)
+    lo = fill(lo, 3, 1, n_in < 2)
+    for c in range(4):
+        lo = fill(lo, 4 + c, c, n_in < 3)
+
+    logic = (lo << combos[None, :]).sum(axis=1)
+    return jnp.where(func_ok, logic, -1)
+
+
+def apply_reactions(env_tables, io_mask, logic_id, cur_bonus,
+                    cur_task_count, cur_reaction_count):
+    """Trigger reactions for organisms performing IO this step.
+
+    env_tables: dict of jnp arrays built from Environment.device_tables().
+    Returns (new_bonus, new_task_count, new_reaction_count, any_reward[N]).
+
+    Mirrors cEnvironment::TestOutput's reaction loop (cEnvironment.cc:1332-
+    1404): each reaction fires if its task's logic-id set contains logic_id
+    and its requisite windows pass; rewards apply pow/add/mult to the bonus
+    (cc:1743-1758).  Stock logic-9 uses requisite max_count=1 so only the
+    first performance per gestation is rewarded.
+    """
+    mask = env_tables["task_logic_mask"]          # bool[R,256]
+    value = env_tables["proc_value"]              # f[R]
+    ptype = env_tables["proc_type"]               # i[R]
+    max_tc = env_tables["max_task_count"]
+    min_tc = env_tables["min_task_count"]
+    req = env_tables["req_reaction_mask"]         # bool[R,R]
+    noreq = env_tables["noreq_reaction_mask"]
+
+    lid = jnp.clip(logic_id, 0, 255)
+    valid = (logic_id >= 0) & io_mask             # [N]
+    performed = mask[:, lid].T & valid[:, None]   # [N,R] task performed now
+
+    # Requisite windows evaluated against pre-event counts (cc:1408-1470)
+    in_window = ((cur_task_count >= min_tc[None, :]) &
+                 (cur_task_count < max_tc[None, :]))
+    rc_zero = (cur_reaction_count == 0)           # [N,R]
+    req_ok = ~jnp.any(req[None, :, :] & rc_zero[:, None, :], axis=2)
+    noreq_ok = ~jnp.any(noreq[None, :, :] & ~rc_zero[:, None, :], axis=2)
+
+    rewarded = performed & in_window & req_ok & noreq_ok
+
+    fval = value[None, :].astype(cur_bonus.dtype)
+    pow_mult = jnp.where(rewarded & (ptype[None, :] == PROCTYPE_POW),
+                         jnp.exp2(fval), 1.0).prod(axis=1)
+    mult_mult = jnp.where(rewarded & (ptype[None, :] == PROCTYPE_MULT),
+                          fval, 1.0).prod(axis=1)
+    add_sum = jnp.where(rewarded & (ptype[None, :] == PROCTYPE_ADD),
+                        fval, 0.0).sum(axis=1)
+
+    new_bonus = cur_bonus * pow_mult * mult_mult + add_sum
+    new_task_count = cur_task_count + performed.astype(jnp.int32)
+    new_reaction_count = cur_reaction_count + rewarded.astype(jnp.int32)
+    return new_bonus, new_task_count, new_reaction_count, rewarded.any(axis=1)
+
+
+def env_tables_to_device(params):
+    """Materialize the WorldParams env tuples as jnp arrays (traced constants)."""
+    return {
+        "task_logic_mask": jnp.asarray(params.task_logic_mask, bool),
+        "proc_value": jnp.asarray(params.proc_value, jnp.float32),
+        "proc_type": jnp.asarray(params.proc_type, jnp.int32),
+        "max_task_count": jnp.asarray(params.max_task_count, jnp.int32),
+        "min_task_count": jnp.asarray(params.min_task_count, jnp.int32),
+        "req_reaction_mask": jnp.asarray(params.req_reaction_mask, bool),
+        "noreq_reaction_mask": jnp.asarray(params.noreq_reaction_mask, bool),
+    }

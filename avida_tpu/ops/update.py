@@ -1,0 +1,110 @@
+"""One full population update as a single jitted device program.
+
+The reference update (Avida2Driver::Run loop body, Avida2Driver.cc:91-165 +
+cPopulation::ProcessStep cc:5703) serializes UD_size = AVE_TIME_SLICE x
+num_orgs organism-instruction steps.  Here the whole update runs on device:
+
+  1. sample per-organism instruction budgets (ops/scheduler.py)
+  2. a lax.while_loop of lockstep micro-steps with execution masks
+     (ops/interpreter.py) until every budget is exhausted
+  3. flush pending births as a batched scatter (ops/birth.py)
+  4. optional point-mutation sweep (Avida2Driver.cc:146-155)
+
+Host code only orchestrates updates and reads back stats at report
+boundaries -- no per-step host/device synchronization.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from avida_tpu.ops import birth as birth_ops
+from avida_tpu.ops import scheduler as sched_ops
+from avida_tpu.ops.interpreter import micro_step
+
+
+@partial(jax.jit, static_argnums=0)
+def update_step(params, st, key, neighbors, update_no):
+    """Run one update.  Returns (new_state, executed_this_update)."""
+    k_budget, k_steps, k_birth = jax.random.split(key, 3)
+
+    budgets = sched_ops.compute_budgets(params, st, k_budget)
+    max_k = budgets.max()
+    if params.max_steps_per_update:
+        max_k = jnp.minimum(max_k, params.max_steps_per_update)
+        budgets = jnp.minimum(budgets, params.max_steps_per_update)
+
+    executed0 = st.insts_executed
+
+    def cond(carry):
+        s, _ = carry
+        return s < max_k
+
+    def body(carry):
+        s, st = carry
+        exec_mask = st.alive & (s < budgets)
+        st = micro_step(params, st, jax.random.fold_in(k_steps, s), exec_mask)
+        return s + 1, st
+
+    _, st = jax.lax.while_loop(cond, body, (jnp.int32(0), st))
+
+    st = birth_ops.flush_births(params, st, k_birth, neighbors, update_no)
+
+    if params.point_mut_prob > 0:
+        st = _point_mutation_sweep(params, st, jax.random.fold_in(k_steps, -1))
+
+    executed = (st.insts_executed - executed0).sum()
+    return st, executed
+
+
+def _point_mutation_sweep(params, st, key):
+    """Per-site point mutations once per update (Avida2Driver.cc:146-155 ->
+    cHardwareBase::PointMutate cc:1087)."""
+    n, L = st.mem.shape
+    u = jax.random.uniform(key, (n, L))
+    r = jax.random.randint(jax.random.fold_in(key, 1), (n, L), 0,
+                           params.num_insts, dtype=jnp.int8)
+    in_genome = jnp.arange(L)[None, :] < st.mem_len[:, None]
+    hit = (u < params.point_mut_prob) & in_genome & st.alive[:, None]
+    return st.replace(mem=jnp.where(hit, r, st.mem))
+
+
+@partial(jax.jit, static_argnums=0)
+def summarize(params, st):
+    """Device-side reduction of per-update stats (feeds cStats/.dat output;
+    ref cPopulation::UpdateOrganismStats cc:5847)."""
+    alive = st.alive
+    n_alive = alive.sum()
+    denom = jnp.maximum(n_alive, 1).astype(st.merit.dtype)
+    fdt = st.merit.dtype
+
+    def avg(x):
+        return jnp.where(alive, x.astype(fdt), 0).sum() / denom
+
+    gest = jnp.where(alive, st.gestation_time, 0)
+    has_gest = alive & (st.gestation_time > 0)
+    gest_denom = jnp.maximum(has_gest.sum(), 1).astype(fdt)
+
+    task_counts = (alive[:, None] & (st.last_task_count > 0)).sum(axis=0)
+    task_doing = (alive[:, None] & (st.cur_task_count > 0)).sum(axis=0)
+
+    return {
+        "num_organisms": n_alive,
+        "ave_merit": avg(st.merit),
+        "ave_fitness": avg(st.fitness),
+        "ave_gestation": jnp.where(has_gest, gest, 0).sum().astype(fdt) / gest_denom,
+        "ave_genome_len": avg(st.genome_len),
+        "ave_generation": avg(st.generation),
+        "ave_age": avg(st.time_used),
+        "max_fitness": jnp.where(alive, st.fitness, 0).max(),
+        "max_merit": jnp.where(alive, st.merit, 0).max(),
+        "num_births": (alive & (st.birth_update >= 0)).sum(),
+        "total_insts": st.insts_executed.astype(jnp.int64).sum()
+        if jax.config.jax_enable_x64 else st.insts_executed.sum(),
+        "task_counts": task_counts,
+        "task_doing": task_doing,
+        "num_divides": st.num_divides.sum(),
+    }

@@ -1,0 +1,82 @@
+"""Self-documenting .dat output files.
+
+Reproduces the reference's data-file format (Avida::Output::File,
+avida-core/source/output/File.cc:102-212: `#` header with numbered column
+descriptions, then space-separated rows) for the standard print actions
+(PrintAverageData / PrintCountData / PrintTasksData / PrintTimeData, from the
+244-action print library, avida-core/source/actions/PrintActions.cc).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+class DatFile:
+    def __init__(self, path: str, title: str, col_descrs: list,
+                 preamble: list | None = None):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "w")
+        self._f.write(f"# {title}\n")
+        self._f.write(f"# {time.asctime()}\n")
+        for line in (preamble or []):
+            self._f.write(f"# {line}\n")
+        for i, d in enumerate(col_descrs, 1):
+            self._f.write(f"# {i:2d}: {d}\n")
+        self._f.write("\n")
+
+    def write_row(self, values):
+        def fmt(v):
+            if isinstance(v, float):
+                if v == int(v) and abs(v) < 1e15:
+                    return str(int(v))
+                return f"{v:g}"
+            return str(v)
+        self._f.write(" ".join(fmt(v) for v in values) + " \n")
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+def open_average_dat(data_dir: str) -> DatFile:
+    return DatFile(
+        os.path.join(data_dir, "average.dat"), "Avida Average Data",
+        ["Update", "Merit", "Gestation Time", "Fitness", "Repro Rate?",
+         "(deprecated) Size", "Copied Size", "Executed Size",
+         "(deprecated) Abundance",
+         "Proportion of organisms that gave birth in this update",
+         "Proportion of Breed True Organisms", "(deprecated) Genotype Depth",
+         "Generation", "Neutral Metric", "Lineage Label",
+         "True Replication Rate (based on births/update, time-averaged)"])
+
+
+def open_count_dat(data_dir: str) -> DatFile:
+    return DatFile(
+        os.path.join(data_dir, "count.dat"), "Avida count data",
+        ["update", "number of insts executed this update",
+         "number of organisms", "number of different genotypes",
+         "number of different threshold genotypes",
+         "(deprecated) number of different species",
+         "(deprecated) number of different threshold species",
+         "(deprecated) number of different lineages",
+         "number of births in this update", "number of deaths in this update",
+         "number of breed true", "number of breed true organisms?",
+         "number of no-birth organisms", "number of single-threaded organisms",
+         "number of multi-threaded organisms", "number of modified organisms"])
+
+
+def open_tasks_dat(data_dir: str, task_names: list) -> DatFile:
+    return DatFile(
+        os.path.join(data_dir, "tasks.dat"), "Avida tasks data",
+        ["Update"] + [t.capitalize() for t in task_names],
+        preamble=["First column gives the current update, next columns give the number",
+                  "of organisms that have the particular task as a component of their merit"])
+
+
+def open_time_dat(data_dir: str) -> DatFile:
+    return DatFile(
+        os.path.join(data_dir, "time.dat"), "Avida time data",
+        ["update", "avida time", "average generation", "num_executed?"])

@@ -1,0 +1,230 @@
+"""World: the host-side composition root and update driver.
+
+TPU-native equivalent of cWorld (construction order mirrored from
+cWorld::setup, avida-core/source/main/cWorld.cc:96-199) plus the master
+update loop of Avida2Driver::Run (targets/avida/Avida2Driver.cc:64-165).
+The device does all organism work (ops/update.py); this class owns config,
+events, stats readback and .dat output.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from avida_tpu.config import (AvidaConfig, load_avida_cfg, load_instset,
+                              default_instset, load_organism,
+                              load_environment, load_events)
+from avida_tpu.config.environment import default_logic9_environment
+from avida_tpu.config.events import Event, parse_event_line
+from avida_tpu.core.state import (init_population, make_world_params,
+                                  PopulationState)
+from avida_tpu.ops import birth as birth_ops
+from avida_tpu.ops.update import update_step, summarize
+from avida_tpu.utils import output as output_mod
+
+# Reference default ancestor (support/config/default-heads.org): h-alloc,
+# h-search +CA label, mov-head, 85x nop-C body, copy loop w/ AB end label.
+_DEFAULT_ANCESTOR_NAMES = (
+    ["h-alloc", "h-search", "nop-C", "nop-A", "mov-head"]
+    + ["nop-C"] * 86
+    + ["h-search", "h-copy", "if-label", "nop-C", "nop-A", "h-divide",
+       "mov-head", "nop-A", "nop-B"]
+)
+
+
+def default_ancestor(instset) -> np.ndarray:
+    name_to_op = {n: i for i, n in enumerate(instset.inst_names)}
+    return np.asarray([name_to_op[n] for n in _DEFAULT_ANCESTOR_NAMES], np.int8)
+
+
+class World:
+    def __init__(self, cfg: AvidaConfig | None = None, config_dir: str | None = None,
+                 overrides=None, data_dir: str | None = None):
+        if config_dir is not None:
+            cfg = load_avida_cfg(os.path.join(config_dir, "avida.cfg"), overrides)
+        elif cfg is None:
+            cfg = AvidaConfig()
+            for name, value in (overrides or []):
+                cfg.set(name, value)
+        self.cfg = cfg
+        self.config_dir = config_dir
+        self.data_dir = data_dir or cfg.DATA_DIR
+
+        # instruction set (cHardwareManager::LoadInstSets equivalent)
+        if config_dir and cfg.INST_SET not in ("-", ""):
+            self.instset = load_instset(os.path.join(config_dir, cfg.INST_SET))
+        else:
+            self.instset = default_instset()
+
+        # environment (cEnvironment::Load equivalent)
+        env_path = (os.path.join(config_dir, cfg.ENVIRONMENT_FILE)
+                    if config_dir else None)
+        if env_path and os.path.exists(env_path):
+            self.environment = load_environment(env_path)
+        else:
+            self.environment = default_logic9_environment()
+
+        # events (cEventList::LoadEventFile equivalent)
+        ev_path = (os.path.join(config_dir, cfg.EVENT_FILE)
+                   if config_dir else None)
+        if ev_path and os.path.exists(ev_path):
+            self.events = load_events(ev_path)
+        else:
+            self.events = [
+                parse_event_line("u begin Inject default-heads.org"),
+                parse_event_line("u 0:100:end PrintAverageData"),
+                parse_event_line("u 0:100:end PrintCountData"),
+                parse_event_line("u 0:100:end PrintTasksData"),
+                parse_event_line("u 0:100:end PrintTimeData"),
+            ]
+
+        self.params = make_world_params(cfg, self.instset, self.environment)
+        self.neighbors = jnp.asarray(birth_ops.neighbor_table(
+            cfg.WORLD_X, cfg.WORLD_Y, cfg.WORLD_GEOMETRY))
+
+        seed = cfg.RANDOM_SEED if cfg.RANDOM_SEED >= 0 else int.from_bytes(os.urandom(4), "little")
+        self.key = jax.random.key(seed)
+        self.update = 0
+        self.state: PopulationState | None = None
+        self._exit = False
+        self._files = {}
+        self._insts_prev_total = 0
+        self._births_prev = 0
+        self._avida_time = 0.0
+
+    # ---- event actions (subset of the 418-action library) ----
+
+    def _resolve_org_path(self, name: str) -> np.ndarray:
+        if self.config_dir:
+            p = os.path.join(self.config_dir, name)
+            if os.path.exists(p):
+                return load_organism(p, self.instset)
+        return default_ancestor(self.instset)
+
+    def inject(self, genome: np.ndarray | None = None, cell: int | None = None):
+        self.key, k = jax.random.split(self.key)
+        if genome is None:
+            genome = default_ancestor(self.instset)
+        self.state = init_population(self.params, genome, k, inject_cell=cell)
+
+    def _action_Inject(self, args):
+        genome = self._resolve_org_path(args[0]) if args else None
+        self.inject(genome)
+
+    def _action_Exit(self, args):
+        self._exit = True
+
+    def _file(self, name, opener, *a):
+        if name not in self._files:
+            self._files[name] = opener(self.data_dir, *a)
+        return self._files[name]
+
+    def _summary(self):
+        if getattr(self, "_summary_cache_update", None) != self.update:
+            s = summarize(self.params, self.state)
+            self._summary_stats = {k: np.asarray(v) for k, v in s.items()}
+            self._summary_cache_update = self.update
+        return self._summary_stats
+
+    def _action_PrintAverageData(self, args):
+        s = self._summary()
+        f = self._file("average", output_mod.open_average_dat)
+        n = max(int(s["num_organisms"]), 1)
+        f.write_row([
+            self.update, float(s["ave_merit"]), float(s["ave_gestation"]),
+            float(s["ave_fitness"]), 0, 0, 0.0, 0.0, 0, 0.0, 0.0, 0,
+            float(s["ave_generation"]), 0, 0, 0])
+
+    def _action_PrintCountData(self, args):
+        s = self._summary()
+        f = self._file("count", output_mod.open_count_dat)
+        insts_this_update = int(s["total_insts"]) - self._insts_prev_total
+        self._insts_prev_total = int(s["total_insts"])
+        n = int(s["num_organisms"])
+        f.write_row([self.update, insts_this_update, n, 0, 0, 0, 0, 0,
+                     0, 0, 0, 0, 0, n, 0, 0])
+
+    def _action_PrintTasksData(self, args):
+        s = self._summary()
+        f = self._file("tasks", output_mod.open_tasks_dat,
+                       self.environment.task_names())
+        f.write_row([self.update] + [int(x) for x in s["task_counts"]])
+
+    def _action_PrintTimeData(self, args):
+        s = self._summary()
+        f = self._file("time", output_mod.open_time_dat)
+        insts = int(s["total_insts"]) - getattr(self, "_time_prev", 0)
+        self._time_prev = int(s["total_insts"])
+        f.write_row([self.update, self._avida_time,
+                     float(s["ave_generation"]), insts])
+
+    def _action_SavePopulation(self, args):
+        from avida_tpu.utils import spop
+        os.makedirs(self.data_dir, exist_ok=True)
+        spop.save_population(
+            os.path.join(self.data_dir, f"detail-{self.update}.spop"),
+            self.params, self.state, self.update)
+
+    def process_events(self):
+        for ev in self.events:
+            if ev.trigger == "update" and ev.fires_at(self.update):
+                handler = getattr(self, f"_action_{ev.action}", None)
+                if handler is None:
+                    continue  # unimplemented actions are skipped (logged once)
+                handler(ev.args)
+            elif ev.trigger == "immediate" and self.update == 0:
+                handler = getattr(self, f"_action_{ev.action}", None)
+                if handler:
+                    handler(ev.args)
+
+    # ---- the master update loop (Avida2Driver::Run equivalent) ----
+
+    def run_update(self):
+        assert self.state is not None, "no population injected"
+        self.key, k = jax.random.split(self.key)
+        self.state, executed = update_step(
+            self.params, self.state, k, self.neighbors, jnp.int32(self.update))
+        # avida time advances by ave merit-weighted gestation share; the
+        # reference tracks 1/ave_gestation per update (cStats::ProcessUpdate)
+        return executed
+
+    def run(self, max_updates: int | None = None):
+        if self.state is None:
+            # fire begin events (Inject) before the loop
+            self.process_events()
+            if self.state is None:
+                self.inject()
+        total_executed = 0
+        while not self._exit:
+            if max_updates is not None and self.update >= max_updates:
+                break
+            self.process_events()
+            if self._exit:
+                break
+            executed = self.run_update()
+            s = self._summary_light()
+            g = s.get("ave_gestation", 0.0)
+            if g and g > 0:
+                self._avida_time += 1.0 / float(g)
+            self.update += 1
+        for f in self._files.values():
+            f.close()
+        self._files = {}
+        return total_executed
+
+    def _summary_light(self):
+        # gestation for avida-time bookkeeping; cheap device reduction
+        st = self.state
+        alive = st.alive
+        has = np.asarray(alive & (st.gestation_time > 0))
+        if has.any():
+            return {"ave_gestation": float(np.asarray(st.gestation_time)[has].mean())}
+        return {"ave_gestation": 0.0}
+
+    @property
+    def num_organisms(self) -> int:
+        return int(np.asarray(self.state.alive).sum())

@@ -1,0 +1,22 @@
+import os
+
+# Tests run on CPU with a virtual 8-device mesh so multi-chip sharding logic
+# is exercised without TPU hardware (see SURVEY.md §7 step 8).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def small_world_cfg():
+    from avida_tpu.config import AvidaConfig
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 10
+    cfg.WORLD_Y = 10
+    cfg.TPU_MAX_MEMORY = 320
+    cfg.RANDOM_SEED = 7
+    return cfg

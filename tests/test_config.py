@@ -1,0 +1,101 @@
+"""Config subsystem tests (parsers for avida.cfg / instset / .org /
+environment.cfg / events.cfg -- SURVEY.md §5 config DSLs)."""
+
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from avida_tpu.config import (AvidaConfig, load_avida_cfg, load_instset,
+                              default_instset, load_organism,
+                              load_environment, load_events)
+from avida_tpu.config.environment import default_logic9_environment
+from avida_tpu.config.events import parse_event_line
+
+REF = "/root/reference/avida-core/support/config"
+
+
+def test_defaults_match_reference():
+    cfg = AvidaConfig()
+    assert cfg.AVE_TIME_SLICE == 30
+    assert cfg.SLICING_METHOD == 1
+    assert cfg.COPY_MUT_PROB == 0.0075
+    assert cfg.DIVIDE_INS_PROB == 0.05
+    assert cfg.BASE_MERIT_METHOD == 4
+    assert cfg.WORLD_X == 60 and cfg.WORLD_GEOMETRY == 2
+
+
+def test_load_avida_cfg(tmp_path):
+    p = tmp_path / "avida.cfg"
+    p.write_text(textwrap.dedent("""
+        WORLD_X 30   # width
+        WORLD_Y 20
+        COPY_MUT_PROB 0.01
+        RANDOM_SEED 42
+        SOME_FUTURE_VAR xyz
+    """))
+    with pytest.warns(UserWarning):
+        cfg = load_avida_cfg(str(p), overrides=[("WORLD_Y", "25")])
+    assert cfg.WORLD_X == 30
+    assert cfg.WORLD_Y == 25          # -set override wins
+    assert cfg.COPY_MUT_PROB == 0.01
+    assert cfg.extras["SOME_FUTURE_VAR"] == "xyz"
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_load_reference_instset():
+    iset = load_instset(os.path.join(REF, "instset-heads.cfg"))
+    assert iset.name == "heads_default"
+    assert iset.hw_type == 0
+    assert iset.num_insts == 26
+    assert iset.inst_names[:3] == ["nop-A", "nop-B", "nop-C"]
+    assert iset.inst_names == default_instset().inst_names
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_load_reference_organism():
+    iset = default_instset()
+    ops = load_organism(os.path.join(REF, "default-heads.org"), iset)
+    assert len(ops) == 100
+    assert iset.inst_names[ops[0]] == "h-alloc"
+    assert iset.inst_names[ops[-1]] == "nop-B"
+    # matches the built-in ancestor
+    from avida_tpu.world import default_ancestor
+    np.testing.assert_array_equal(ops, default_ancestor(iset))
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_load_reference_environment():
+    env = load_environment(os.path.join(REF, "environment.cfg"))
+    assert env.reaction_names() == ["NOT", "NAND", "AND", "ORN", "OR",
+                                    "ANDN", "NOR", "XOR", "EQU"]
+    t = env.device_tables()
+    assert t["task_logic_mask"][0, 15]          # NOT includes logic id 15
+    assert t["task_logic_mask"][8, 153]         # EQU includes 153
+    assert list(t["max_task_count"]) == [1] * 9
+    np.testing.assert_allclose(t["proc_value"],
+                               [1, 1, 2, 2, 3, 3, 4, 4, 5])
+    builtin = default_logic9_environment().device_tables()
+    np.testing.assert_array_equal(t["task_logic_mask"], builtin["task_logic_mask"])
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_load_reference_events():
+    evs = load_events(os.path.join(REF, "events.cfg"))
+    actions = [e.action for e in evs]
+    assert "Inject" in actions and "Exit" in actions
+    inj = evs[actions.index("Inject")]
+    assert inj.args == ["default-heads.org"]
+    exit_ev = evs[actions.index("Exit")]
+    assert exit_ev.start == 100000
+
+
+def test_event_timing():
+    ev = parse_event_line("u 0:100:end PrintAverageData")
+    assert ev.fires_at(0) and ev.fires_at(100) and ev.fires_at(5000)
+    assert not ev.fires_at(50)
+    once = parse_event_line("u 100000 Exit")
+    assert once.fires_at(100000) and not once.fires_at(100001)
+    begin = parse_event_line("u begin Inject foo.org")
+    assert begin.fires_at(0) and not begin.fires_at(1)

@@ -1,0 +1,129 @@
+"""Interpreter oracle tests.
+
+The strongest single check available: the default ancestor
+(support/config/default-heads.org) must self-replicate exactly, and its
+life-history numbers must match the reference's golden outputs
+(tests/heads_default_100u/expected/data/average.dat row 0: merit 97,
+gestation 389, copied size 100, executed size 97 -- the reference computes
+these by running the very same program through cHardwareCPU).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from avida_tpu.config import AvidaConfig, default_instset
+from avida_tpu.config.environment import default_logic9_environment
+from avida_tpu.core.state import init_population, make_world_params
+from avida_tpu.ops.interpreter import micro_step
+from avida_tpu.world import default_ancestor
+
+
+def make_single_org(cfg_updates=None):
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 1
+    cfg.WORLD_Y = 1
+    cfg.TPU_MAX_MEMORY = 320
+    # no mutations for exact-replication checks
+    cfg.COPY_MUT_PROB = 0.0
+    cfg.DIVIDE_INS_PROB = 0.0
+    cfg.DIVIDE_DEL_PROB = 0.0
+    for k, v in (cfg_updates or {}).items():
+        setattr(cfg, k, v)
+    iset = default_instset()
+    env = default_logic9_environment()
+    params = make_world_params(cfg, iset, env)
+    genome = default_ancestor(iset)
+    st = init_population(params, genome, jax.random.key(0), inject_cell=0)
+    return params, st, genome
+
+
+def run_until_divide(params, st, max_cycles=1000):
+    mask = jnp.ones(1, bool)
+    step = jax.jit(lambda s, k: micro_step(params, s, k, mask))
+    key = jax.random.key(1)
+    for cycle in range(max_cycles):
+        key, k = jax.random.split(key)
+        st = step(st, k)
+        if bool(st.divide_pending[0]):
+            return st, cycle + 1
+    raise AssertionError("ancestor never divided")
+
+
+def test_ancestor_first_steps():
+    params, st, genome = make_single_org()
+    mask = jnp.ones(1, bool)
+    key = jax.random.key(1)
+    step = jax.jit(lambda s, k: micro_step(params, s, k, mask))
+
+    # cycle 1: h-alloc extends memory 100 -> 300, AX = 100
+    st = step(st, key)
+    assert int(st.mem_len[0]) == 300
+    assert int(st.regs[0, 0]) == 100
+    assert bool(st.mal_active[0])
+    # allocated region filled with default instruction (op 0)
+    np.testing.assert_array_equal(np.asarray(st.mem[0, 100:300]), 0)
+
+    # cycle 2: h-search with label CA -> complement AB found at genome end;
+    # FLOW lands on first line of allocated space (100), BX=97, CX=2
+    st = step(st, key)
+    assert int(st.heads[0, 3]) == 100, "FLOW should mark offspring start"
+    assert int(st.regs[0, 2]) == 2      # CX = label size
+    # BX = last-label-line - IP position (97 - 3... see Inst_HeadSearch)
+    assert int(st.regs[0, 1]) == 96
+
+    # cycle 3: mov-head nop-C -> WRITE head to FLOW (=100)
+    st = step(st, key)
+    assert int(st.heads[0, 2]) == 100
+
+
+def test_ancestor_replicates_exactly():
+    params, st, genome = make_single_org()
+    st, gestation = run_until_divide(params, st)
+
+    # golden numbers from the reference run (expected average.dat row 0)
+    assert gestation == 389, f"gestation {gestation} != 389"
+    assert int(st.off_len[0]) == 100
+    offspring = np.asarray(st.off_mem[0, :100])
+    np.testing.assert_array_equal(offspring, genome,
+                                  "offspring must be an exact copy")
+    assert int(st.executed_size[0]) == 97
+    assert int(st.child_copied_size[0]) == 100
+    # merit = min(len, copied, executed) * bonus(1) = 97
+    assert float(st.merit[0]) == 97.0
+    assert float(st.fitness[0]) == pytest.approx(97.0 / 389.0)
+    # parent reset: memory cropped to 100, IP at 0, heads cleared
+    assert int(st.mem_len[0]) == 100
+    assert int(st.heads[0, 0]) == 0
+    assert int(st.generation[0]) == 1
+
+
+def test_second_gestation_same_length():
+    # after the divide reset the parent re-runs the same program; the second
+    # gestation must also be 389 (steady-state replication)
+    params, st, genome = make_single_org()
+    st, g1 = run_until_divide(params, st)
+    st = st.replace(divide_pending=jnp.zeros(1, bool))  # flush
+    st, g2 = run_until_divide(params, st)
+    assert g2 == 389
+
+
+def test_copy_mutations_change_offspring():
+    params, st, genome = make_single_org({"COPY_MUT_PROB": 0.05})
+    st, gestation = run_until_divide(params, st)
+    offspring = np.asarray(st.off_mem[0, :int(st.off_len[0])])
+    # with 5% per-copy mutation over ~200 copies, changes are certain
+    assert (offspring[:100] != genome).any() or int(st.off_len[0]) != 100
+
+
+def test_death_by_age():
+    # DEATH_METHOD 2: die at genome_length * AGE_LIMIT cycles
+    params, st, genome = make_single_org({"AGE_LIMIT": 1})
+    mask = jnp.ones(1, bool)
+    step = jax.jit(lambda s, k: micro_step(params, s, k, mask))
+    key = jax.random.key(1)
+    for _ in range(100):
+        st = step(st, key)
+    assert not bool(st.alive[0])
+    assert int(st.time_used[0]) == 100

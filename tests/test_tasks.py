@@ -1,0 +1,74 @@
+"""Task evaluation tests: the vectorized logic-ID computation must agree with
+cTaskLib::SetupTests semantics (cTaskLib.cc:369-448) on known cases."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from avida_tpu.config.environment import LOGIC_TASKS
+from avida_tpu.ops.tasks import compute_logic_id
+
+# The deterministic all-combination inputs from cEnvironment::SetupInputs
+# (cEnvironment.cc:1286-1289)
+I0, I1, I2 = 0x0F13149F, 0x3308E53E, 0x556241EB
+
+
+def _i32(v):
+    v = int(v) & 0xFFFFFFFF
+    return v - (1 << 32) if v >= (1 << 31) else v
+
+
+def lid(inputs, out):
+    buf = np.zeros((1, 3), np.int32)
+    n = len(inputs)
+    for i, v in enumerate(inputs):
+        buf[0, i] = _i32(v)
+    return int(compute_logic_id(jnp.asarray(buf), jnp.asarray([n]),
+                                jnp.asarray([_i32(out)]))[0])
+
+
+def test_not_single_input():
+    # output = ~input with one input stored: logic table ~A duplicated -> 85
+    assert lid([I0], ~I0) in LOGIC_TASKS["not"]
+
+
+def test_not_three_inputs():
+    # most recent input is buf[0]; ~buf[0] is still a NOT id
+    assert lid([I0, I1, I2], ~I0) in LOGIC_TASKS["not"]
+    assert lid([I2, I1, I0], ~I2) in LOGIC_TASKS["not"]
+
+
+def test_nand_and_or():
+    assert lid([I0, I1], ~(I0 & I1)) in LOGIC_TASKS["nand"]
+    assert lid([I0, I1, I2], I0 & I1) in LOGIC_TASKS["and"]
+    assert lid([I0, I1, I2], I1 | I2) in LOGIC_TASKS["or"]
+    assert lid([I0, I1, I2], I0 ^ I1) in LOGIC_TASKS["xor"]
+    assert lid([I0, I1, I2], ~(I0 ^ I2)) in LOGIC_TASKS["equ"]
+    assert lid([I0, I1, I2], ~(I0 | I1)) in LOGIC_TASKS["nor"]
+    assert lid([I0, I1, I2], I0 & ~I1) in LOGIC_TASKS["andn"]
+    assert lid([I0, I1, I2], I0 | ~I1) in LOGIC_TASKS["orn"]
+
+
+def test_echo():
+    assert lid([I0, I1, I2], I1) in LOGIC_TASKS["echo"]
+
+
+def test_inconsistent_output():
+    # A random constant is (almost surely) not a pure function of the inputs
+    assert lid([I0, I1, I2], 0x12345678) == -1
+
+
+def test_no_inputs_yields_constant_tables():
+    # With zero inputs stored the output must be constant 0 or ~0 to be a
+    # function; anything else is inconsistent
+    assert lid([], 0) == 0
+    assert lid([], -1) == 255
+    assert lid([], 42) == -1
+
+
+def test_logic_id_disjoint_sets():
+    names = ["not", "nand", "and", "orn", "or", "andn", "nor", "xor", "equ"]
+    seen = {}
+    for n in names:
+        for v in LOGIC_TASKS[n]:
+            assert v not in seen, f"{n} and {seen.get(v)} share id {v}"
+            seen[v] = n
