@@ -239,7 +239,7 @@ def zeros_population(n: int, L: int, R: int) -> PopulationState:
         off_mem=jnp.zeros((n, L), jnp.int8), off_len=i32(n),
         off_copied_size=i32(n),
         genotype_id=jnp.full(n, -1, jnp.int32), parent_id=jnp.full(n, -1, jnp.int32),
-        birth_update=i32(n),
+        birth_update=jnp.full(n, -1, jnp.int32),
         insts_executed=i32(n),
     )
 

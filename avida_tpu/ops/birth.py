@@ -53,7 +53,9 @@ def flush_births(params, st, key, neighbors, update_no):
     """Place pending offspring.  neighbors: int32[N, 8] static table."""
     n, L = st.mem.shape
     rows = jnp.arange(n)
-    pending = st.divide_pending
+    # a parent that died while its offspring waited loses the offspring too
+    # (the reference's pending birth dies with the parent's cell state)
+    pending = st.divide_pending & st.alive
 
     # ---- target selection (PositionOffspring, cc:5185; BIRTH_METHOD 0) ----
     cand = neighbors                                  # [N, 8]
@@ -82,12 +84,11 @@ def flush_births(params, st, key, neighbors, update_no):
     won = pending & (claim[target] == rows)
 
     # zero/fresh fields for the newborn
+    from avida_tpu.core.state import make_cell_inputs
     off_mem = st.off_mem
     off_len = st.off_len
     k_inputs, _ = jax.random.split(key)
-    low = jax.random.randint(k_inputs, (n, 3), 0, 1 << 24, dtype=jnp.int32)
-    tops = jnp.array([15 << 24, 51 << 24, 85 << 24], jnp.int32)
-    fresh_inputs = tops[None, :] + low
+    fresh_inputs = make_cell_inputs(k_inputs, n)
 
     max_exec = jnp.where(
         params.death_method == 2, params.age_limit * off_len,
@@ -140,8 +141,9 @@ def flush_births(params, st, key, neighbors, update_no):
         new_fields[name] = jnp.where(mask, src[parent_idx], dst)
 
     st = st.replace(**new_fields)
-    # winners' pending flags clear (losers retry next update); a parent cell
-    # overwritten by a newborn is already governed by the newborn state
-    cleared = jnp.where(won, False, st.divide_pending)
+    # winners' (and dead parents') pending flags clear; living losers retry
+    # next update; a parent cell overwritten by a newborn is already governed
+    # by the newborn state
+    cleared = jnp.where(won | ~st.alive, False, st.divide_pending)
     st = st.replace(divide_pending=cleared)
     return st

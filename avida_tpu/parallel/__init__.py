@@ -1,0 +1,4 @@
+from avida_tpu.parallel.mesh import (  # noqa: F401
+    CELL_AXIS, make_mesh, population_sharding, replicate,
+    shard_neighbors, shard_population,
+)

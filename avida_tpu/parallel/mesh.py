@@ -1,0 +1,60 @@
+"""Multi-device world sharding: the TPU-native replacement for avida-mp.
+
+The reference scales by running one world per MPI rank and migrating
+organisms across world boundaries with Boost.MPI point-to-point messages
+(cMultiProcessWorld, avida-core/source/main/cMultiProcessWorld.cc:142-310;
+SURVEY.md §2g.5, §5).  Here the *single* (larger) world is sharded across a
+`jax.sharding.Mesh`: every per-cell tensor in PopulationState is partitioned
+over the cell axis, the whole update step runs as one SPMD program, and
+cross-shard organism placement (the migration analogue) is carried by XLA
+collectives that GSPMD derives from the birth engine's gathers — riding ICI
+within a slice, DCN across slices.  The per-update barrier and deterministic
+migrant ordering the reference implements by hand (cc:283-310) fall out of
+the lockstep SPMD model for free.
+
+Sharding layout: the grid is laid out row-major (cell = y * world_x + x) and
+sharded along the cell axis, i.e. contiguous bands of rows per device.  With
+BIRTH_METHOD 0 (neighborhood placement) an offspring crosses a shard boundary
+only when the parent sits in a device's edge row — the cross-device traffic
+XLA emits is the halo exchange the reference implements as boundary-cell
+migration (cMultiProcessWorld.cc:227-258).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+CELL_AXIS = "cells"
+
+
+def make_mesh(devices=None) -> Mesh:
+    """1-D device mesh over the cell (population) axis."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (CELL_AXIS,))
+
+
+def population_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for any per-cell tensor: partition dim 0 over the mesh."""
+    return NamedSharding(mesh, P(CELL_AXIS))
+
+
+def shard_population(st, mesh: Mesh):
+    """Place every PopulationState array with its cell axis partitioned.
+
+    Requires num_cells % mesh.size == 0 (choose WORLD_Y divisible by the
+    device count; the driver-facing helpers below do this).
+    """
+    sh = population_sharding(mesh)
+    return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), st)
+
+
+def shard_neighbors(neighbors, mesh: Mesh):
+    return jax.device_put(neighbors, population_sharding(mesh))
+
+
+def replicate(x, mesh: Mesh):
+    return jax.device_put(x, NamedSharding(mesh, P()))

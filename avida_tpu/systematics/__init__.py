@@ -1,0 +1,1 @@
+from avida_tpu.systematics.genotypes import GenotypeArbiter, Genotype  # noqa: F401

@@ -76,6 +76,21 @@ def open_tasks_dat(data_dir: str, task_names: list) -> DatFile:
                   "of organisms that have the particular task as a component of their merit"])
 
 
+def open_dominant_dat(data_dir: str) -> DatFile:
+    return DatFile(
+        os.path.join(data_dir, "dominant.dat"), "Avida Dominant Data",
+        ["Update", "Average Merit of the Dominant Genotype",
+         "Average Gestation Time of the Dominant Genotype",
+         "Average Fitness of the Dominant Genotype",
+         "Repro Rate?", "Size of Dominant Genotype",
+         "Copied Size of Dominant Genotype",
+         "Executed Size of Dominant Genotype", "Abundance of Dominant Genotype",
+         "Number of Births", "Number of Dominant Breed True?",
+         "Dominant Gene Depth", "Dominant Breed In?",
+         "Max Fitness?", "Genotype ID of Dominant Genotype",
+         "Name of the Dominant Genotype"])
+
+
 def open_time_dat(data_dir: str) -> DatFile:
     return DatFile(
         os.path.join(data_dir, "time.dat"), "Avida time data",

@@ -96,6 +96,11 @@ class World:
         self._births_prev = 0
         self._avida_time = 0.0
 
+        # live phylogeny (ref Systematics::GenotypeArbiter; SURVEY §2f)
+        from avida_tpu.systematics import GenotypeArbiter
+        self.systematics = (GenotypeArbiter(self.params.num_cells)
+                            if cfg.get("TPU_SYSTEMATICS", 1) else None)
+
     # ---- event actions (subset of the 418-action library) ----
 
     def _resolve_org_path(self, name: str) -> np.ndarray:
@@ -106,10 +111,27 @@ class World:
         return default_ancestor(self.instset)
 
     def inject(self, genome: np.ndarray | None = None, cell: int | None = None):
+        """Activate one organism (ref cPopulation::Inject, cPopulation.cc:7377).
+
+        On an empty world this creates the population state; mid-run it
+        overwrites the target cell only (the reference's Inject semantics),
+        preserving every other living organism.
+        """
         self.key, k = jax.random.split(self.key)
         if genome is None:
             genome = default_ancestor(self.instset)
-        self.state = init_population(self.params, genome, k, inject_cell=cell)
+        if cell is None:
+            cell = self.params.num_cells // 2
+        if self.state is None:
+            self.state = init_population(self.params, genome, k,
+                                         inject_cell=cell)
+        else:
+            fresh = init_population(self.params, genome, k, inject_cell=cell)
+            c = cell
+            self.state = jax.tree_util.tree_map(
+                lambda cur, new: cur.at[c].set(new[c]), self.state, fresh)
+        if self.systematics is not None:
+            self.systematics.classify_seed(cell, genome, update=self.update)
 
     def _action_Inject(self, args):
         genome = self._resolve_org_path(args[0]) if args else None
@@ -145,8 +167,36 @@ class World:
         insts_this_update = int(s["total_insts"]) - self._insts_prev_total
         self._insts_prev_total = int(s["total_insts"])
         n = int(s["num_organisms"])
-        f.write_row([self.update, insts_this_update, n, 0, 0, 0, 0, 0,
-                     0, 0, 0, 0, 0, n, 0, 0])
+        sysm = self.systematics
+        num_gt = sysm.num_genotypes if sysm else 0
+        num_thr = sysm.num_threshold if sysm else 0
+        births = (sysm.num_births_total - self._births_prev) if sysm else 0
+        if sysm:
+            self._births_prev = sysm.num_births_total
+        f.write_row([self.update, insts_this_update, n, num_gt, num_thr,
+                     0, 0, 0, births, 0, 0, 0, 0, n, 0, 0])
+
+    def _action_PrintDominantData(self, args):
+        if self.systematics is None:
+            return
+        g = self.systematics.dominant()
+        if g is None:
+            return
+        f = self._file("dominant", output_mod.open_dominant_dat)
+        st = self.state
+        cells = np.nonzero((self.systematics.cell_gid == g.gid)
+                           & np.asarray(st.alive))[0]
+        if cells.size:
+            merit = float(np.asarray(st.merit)[cells].mean())
+            gest = float(np.asarray(st.gestation_time)[cells].mean())
+            fit = float(np.asarray(st.fitness)[cells].mean())
+        else:
+            merit = gest = fit = 0.0
+        f.write_row([
+            self.update, merit, gest, fit,
+            (merit / gest if gest else 0.0), g.length, g.length, g.length,
+            g.num_units, g.total_units, 0, g.depth, 0, fit, g.gid,
+            f"{g.depth:03d}-no_name"])
 
     def _action_PrintTasksData(self, args):
         s = self._summary()
@@ -188,9 +238,32 @@ class World:
         self.key, k = jax.random.split(self.key)
         self.state, executed = update_step(
             self.params, self.state, k, self.neighbors, jnp.int32(self.update))
+        if self.systematics is not None:
+            self._feed_systematics()
         # avida time advances by ave merit-weighted gestation share; the
         # reference tracks 1/ave_gestation per update (cStats::ProcessUpdate)
         return executed
+
+    def _feed_systematics(self):
+        """Hand this update's newborn rows to the host-side phylogeny.
+
+        Only small per-cell vectors plus the gathered newborn genomes cross
+        the device boundary (SURVEY §5: update-granularity transfers only).
+        """
+        st = self.state
+        alive = np.asarray(st.alive)
+        born = np.asarray(st.birth_update) == self.update
+        cells = np.nonzero(born & alive)[0]
+        if cells.size:
+            idx = jnp.asarray(cells)
+            genomes = np.asarray(st.genome[idx])
+            lens = np.asarray(st.genome_len[idx])
+            parents = np.asarray(st.parent_id[idx])
+        else:
+            genomes = np.zeros((0, self.params.max_memory), np.int8)
+            lens = parents = np.zeros(0, np.int32)
+        self.systematics.process(self.update, alive, cells, genomes, lens,
+                                 parents)
 
     def run(self, max_updates: int | None = None):
         if self.state is None:
@@ -206,11 +279,14 @@ class World:
             if self._exit:
                 break
             executed = self.run_update()
+            total_executed += int(executed)
             s = self._summary_light()
             g = s.get("ave_gestation", 0.0)
             if g and g > 0:
                 self._avida_time += 1.0 / float(g)
             self.update += 1
+            if self.systematics is not None and self.update % 100 == 0:
+                self.systematics.prune_extinct(keep_ancestry=True)
         for f in self._files.values():
             f.close()
         self._files = {}

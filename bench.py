@@ -1,0 +1,104 @@
+"""Headline benchmark: organism-instructions/second on the stock logic-9 world.
+
+Protocol (BASELINE.md): heads-default instruction set, logic-9 environment,
+merit-proportional scheduling, ~100k organisms (320x320 grid fully seeded
+with the default ancestor so the measurement starts at target population).
+Baseline = 1e8 org-inst/sec (BASELINE.json north star; the reference itself
+publishes no absolute numbers).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_INST_PER_SEC = 1e8
+
+
+def build(world_x, world_y, max_memory, seed):
+    from avida_tpu.config import AvidaConfig
+    from avida_tpu.core.state import (init_population, make_world_params,
+                                      zeros_population, make_cell_inputs)
+    from avida_tpu.ops import birth as birth_ops
+    from avida_tpu.world import World, default_ancestor
+
+    cfg = AvidaConfig()
+    cfg.WORLD_X = world_x
+    cfg.WORLD_Y = world_y
+    cfg.TPU_MAX_MEMORY = max_memory
+    cfg.RANDOM_SEED = seed
+    w = World(cfg=cfg)
+    anc = default_ancestor(w.instset)
+
+    # Seed EVERY cell with the ancestor (mass InjectAll; reference action
+    # "InjectAll", PopulationActions.cc) so throughput is measured at full
+    # population from update 0.
+    n, L, R = w.params.num_cells, w.params.max_memory, w.params.num_reactions
+    st = zeros_population(n, L, R)
+    key = jax.random.key(seed)
+    k_in, key = jax.random.split(key)
+    g = np.zeros(L, np.int8)
+    g[: len(anc)] = anc
+    glen = len(anc)
+    gm = jnp.asarray(np.broadcast_to(g, (n, L)))
+    st = st.replace(
+        inputs=make_cell_inputs(k_in, n),
+        mem=gm, genome=gm,
+        mem_len=jnp.full(n, glen, jnp.int32),
+        genome_len=jnp.full(n, glen, jnp.int32),
+        alive=jnp.ones(n, bool),
+        merit=jnp.full(n, float(glen), jnp.float32),
+        cur_bonus=jnp.full(n, w.params.default_bonus, jnp.float32),
+        executed_size=jnp.full(n, glen, jnp.int32),
+        copied_size=jnp.full(n, glen, jnp.int32),
+        max_executed=jnp.full(n, w.params.age_limit * glen, jnp.int32),
+    )
+    neighbors = jnp.asarray(
+        birth_ops.neighbor_table(world_x, world_y, cfg.WORLD_GEOMETRY))
+    return w.params, st, neighbors, key
+
+
+def main():
+    from avida_tpu.ops.update import update_step
+
+    # 320x320 = 102,400 organisms (BASELINE.json config: 100k target scale).
+    # Smaller on CPU so the bench terminates quickly off-TPU.
+    on_tpu = jax.devices()[0].platform == "tpu"
+    world = 320 if on_tpu else 60
+    warmup, timed = (3, 10) if on_tpu else (1, 3)
+
+    params, st, neighbors, key = build(world, world, 256, seed=100)
+
+    executed_total = 0
+    for u in range(warmup):
+        key, k = jax.random.split(key)
+        st, executed = update_step(params, st, k, neighbors, jnp.int32(u))
+    jax.block_until_ready(st)
+
+    t0 = time.perf_counter()
+    for u in range(warmup, warmup + timed):
+        key, k = jax.random.split(key)
+        st, executed = update_step(params, st, k, neighbors, jnp.int32(u))
+        executed_total += int(executed)
+    jax.block_until_ready(st)
+    dt = time.perf_counter() - t0
+
+    ips = executed_total / dt
+    print(json.dumps({
+        "metric": "org_instructions_per_sec",
+        "value": round(ips, 1),
+        "unit": "inst/s",
+        "vs_baseline": round(ips / BASELINE_INST_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,12 +1,19 @@
 import os
 
 # Tests run on CPU with a virtual 8-device mesh so multi-chip sharding logic
-# is exercised without TPU hardware (see SURVEY.md §7 step 8).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# is exercised without TPU hardware (see SURVEY.md §7 step 8).  The axon
+# sitecustomize hook registers the TPU backend whenever PALLAS_AXON_POOL_IPS
+# is set, overriding JAX_PLATFORMS -- but pytest's conftest imports before
+# jax, so forcing the config here wins as long as jax isn't initialized yet.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,105 @@
+"""Systematics (genotype arbiter) unit tests + world integration.
+
+Models the reference's provenance semantics (GenotypeArbiter.cc:79-123):
+dedup by sequence, parent links, depth, extinction bookkeeping.
+"""
+
+import numpy as np
+
+from avida_tpu.systematics import GenotypeArbiter
+
+
+def g(*ops):
+    return np.asarray(ops, np.int8)
+
+
+def test_seed_and_dedup():
+    arb = GenotypeArbiter(16)
+    arb.classify_seed(0, g(1, 2, 3))
+    arb.classify_seed(1, g(1, 2, 3))
+    arb.classify_seed(2, g(1, 2, 4))
+    assert arb.num_genotypes == 2
+    dom = arb.dominant()
+    assert dom.num_units == 2 and dom.total_units == 2
+
+
+def test_birth_parent_links_and_depth():
+    arb = GenotypeArbiter(16)
+    arb.classify_seed(0, g(1, 2, 3))
+    alive = np.zeros(16, bool)
+    alive[[0, 1]] = True
+    # child in cell 1 with a mutated genome, parent cell 0
+    arb.process(update=5, alive=alive,
+                newborn_cells=np.asarray([1]),
+                newborn_genomes=np.asarray([[9, 2, 3, 0]], np.int8),
+                newborn_lens=np.asarray([3]),
+                parent_cells=np.asarray([0]))
+    assert arb.num_genotypes == 2
+    child = arb.genotypes[arb.cell_gid[1]]
+    parent = arb.genotypes[arb.cell_gid[0]]
+    assert child.parent_gid == parent.gid
+    assert child.depth == 1
+    assert child.update_born == 5
+
+
+def test_death_and_extinction():
+    arb = GenotypeArbiter(8)
+    arb.classify_seed(0, g(1, 1))
+    alive = np.zeros(8, bool)  # everyone died
+    arb.process(update=3, alive=alive,
+                newborn_cells=np.asarray([], int),
+                newborn_genomes=np.zeros((0, 4), np.int8),
+                newborn_lens=np.asarray([], int),
+                parent_cells=np.asarray([], int))
+    assert arb.num_genotypes == 0
+    extinct = next(iter(arb.genotypes.values()))
+    assert extinct.update_deactivated == 3
+
+
+def test_same_genome_rebirth_reactivates():
+    arb = GenotypeArbiter(8)
+    arb.classify_seed(0, g(5, 5))
+    gid = arb.cell_gid[0]
+    alive = np.zeros(8, bool)
+    alive[1] = True
+    arb.process(update=2, alive=alive,
+                newborn_cells=np.asarray([1]),
+                newborn_genomes=np.asarray([[5, 5]], np.int8),
+                newborn_lens=np.asarray([2]),
+                parent_cells=np.asarray([0]))
+    # cell 0 died, cell 1 carries the same genotype: still one genotype, live
+    assert arb.cell_gid[1] == gid
+    assert arb.genotypes[gid].num_units == 1
+    assert arb.genotypes[gid].update_deactivated == -1
+
+
+def test_world_integration_systematics(small_world_cfg):
+    from avida_tpu.world import World
+    w = World(cfg=small_world_cfg.copy())
+    w.inject()
+    for _ in range(40):
+        w.run_update()
+        w.update += 1
+    sysm = w.systematics
+    assert sysm is not None
+    # live genotype units must agree with the alive mask
+    n_alive = int(np.asarray(w.state.alive).sum())
+    live_units = sum(gg.num_units for gg in sysm.genotypes.values())
+    assert live_units == n_alive
+    if n_alive > 1:
+        assert sysm.num_births_total > 1
+
+
+def test_prune_extinct_keeps_live_ancestry():
+    arb = GenotypeArbiter(8)
+    arb.classify_seed(0, g(1,))
+    alive = np.zeros(8, bool)
+    alive[1] = True
+    arb.process(update=1, alive=alive,
+                newborn_cells=np.asarray([1]),
+                newborn_genomes=np.asarray([[2]], np.int8),
+                newborn_lens=np.asarray([1]),
+                parent_cells=np.asarray([0]))
+    root_gid = 1
+    arb.prune_extinct(keep_ancestry=True)
+    assert root_gid in arb.genotypes  # extinct but ancestral to live genotype
