@@ -147,6 +147,10 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
 def instset_tables(instset):
     from avida_tpu.models.registry import get_hardware
     mod = get_hardware(instset.hw_type)["module"]
+    if len(instset.inst_names) > 64:
+        raise ValueError(
+            "packed-tape layout supports <= 64 instructions per set "
+            "(6 opcode bits + 2 flag bits; see ops/interpreter.py)")
     return mod.build_semantic_tables(instset.inst_names)
 
 
@@ -155,10 +159,13 @@ class PopulationState(struct.PyTreeNode):
     L = max_memory, R = num reactions."""
 
     # --- virtual hardware (ref cHardwareCPU.h:61-152) ---
-    mem: jax.Array            # int8[N, L]   memory tape (genome + allocation)
+    # One packed plane holds the memory tape AND the per-site flags
+    # (ref cCPUMemory executed/copied flags): bits 0-5 opcode, bit 6
+    # executed, bit 7 copied.  Packing keeps the per-cycle working set at
+    # N*L bytes so the whole update loop stays VMEM-resident on TPU
+    # (see ops/interpreter.py header).
+    tape: jax.Array           # uint8[N, L]
     mem_len: jax.Array        # int32[N]     current memory size
-    flag_exec: jax.Array      # bool[N, L]   per-site executed flag (cCPUMemory)
-    flag_copied: jax.Array    # bool[N, L]   per-site copied flag
     regs: jax.Array           # int32[N, 3]  AX BX CX
     heads: jax.Array          # int32[N, 4]  IP READ WRITE FLOW
     stacks: jax.Array         # int32[N, 2, 10]
@@ -198,9 +205,11 @@ class PopulationState(struct.PyTreeNode):
     max_executed: jax.Array   # int32[N]    death threshold (DEATH_METHOD)
     num_divides: jax.Array    # int32[N]
 
-    # --- pending birth (flushed by the birth engine each update) ---
+    # --- pending birth (flushed by the birth engine each update; the
+    # offspring opcodes stay in place on the tape beyond mem_len and are
+    # extracted by ops/interpreter.extract_offspring at flush) ---
     divide_pending: jax.Array  # bool[N]
-    off_mem: jax.Array        # int8[N, L]
+    off_start: jax.Array      # int32[N]   offspring start position on tape
     off_len: jax.Array        # int32[N]
     off_copied_size: jax.Array  # int32[N]
 
@@ -211,14 +220,27 @@ class PopulationState(struct.PyTreeNode):
 
     # --- per-update accounting ---
     insts_executed: jax.Array  # int32[N]  lifetime instructions executed
+    budget_carry: jax.Array    # int32[N]  banked cycles (ops/update.py cap)
+
+    @property
+    def mem(self) -> jax.Array:
+        """Opcode view of the packed tape (int8[N, L])."""
+        return (self.tape & jnp.uint8(0x3F)).astype(jnp.int8)
+
+    @property
+    def flag_exec(self) -> jax.Array:
+        return (self.tape & jnp.uint8(0x40)) != 0
+
+    @property
+    def flag_copied(self) -> jax.Array:
+        return (self.tape & jnp.uint8(0x80)) != 0
 
 
 def zeros_population(n: int, L: int, R: int) -> PopulationState:
     i32 = partial(jnp.zeros, dtype=jnp.int32)
     f32 = partial(jnp.zeros, dtype=jnp.float32)
     return PopulationState(
-        mem=jnp.zeros((n, L), jnp.int8), mem_len=i32(n),
-        flag_exec=jnp.zeros((n, L), bool), flag_copied=jnp.zeros((n, L), bool),
+        tape=jnp.zeros((n, L), jnp.uint8), mem_len=i32(n),
         regs=i32((n, 3)), heads=i32((n, 4)),
         stacks=i32((n, 2, 10)), sp=i32((n, 2)), active_stack=i32(n),
         read_label=jnp.zeros((n, 10), jnp.int8), read_label_len=i32(n),
@@ -236,11 +258,12 @@ def zeros_population(n: int, L: int, R: int) -> PopulationState:
         executed_size=i32(n), copied_size=i32(n), child_copied_size=i32(n),
         generation=i32(n), max_executed=i32(n), num_divides=i32(n),
         divide_pending=jnp.zeros(n, bool),
-        off_mem=jnp.zeros((n, L), jnp.int8), off_len=i32(n),
+        off_start=i32(n), off_len=i32(n),
         off_copied_size=i32(n),
         genotype_id=jnp.full(n, -1, jnp.int32), parent_id=jnp.full(n, -1, jnp.int32),
         birth_update=jnp.full(n, -1, jnp.int32),
         insts_executed=i32(n),
+        budget_carry=i32(n),
     )
 
 
@@ -271,7 +294,7 @@ def init_population(params: WorldParams, seed_genome: np.ndarray,
     g[:glen] = seed_genome
     c = inject_cell
     st = st.replace(
-        mem=st.mem.at[c].set(jnp.asarray(g)),
+        tape=st.tape.at[c].set(jnp.asarray(g).astype(jnp.uint8)),
         genome=st.genome.at[c].set(jnp.asarray(g)),
         mem_len=st.mem_len.at[c].set(glen),
         genome_len=st.genome_len.at[c].set(glen),

@@ -51,8 +51,9 @@ def neighbor_table(world_x: int, world_y: int, geometry: int) -> np.ndarray:
 
 def flush_births(params, st, key, neighbors, update_no):
     """Place pending offspring.  neighbors: int32[N, 8] static table."""
-    n, L = st.mem.shape
+    n, L = st.tape.shape
     rows = jnp.arange(n)
+    k_place, k_inputs, k_off = jax.random.split(key, 3)
     # a parent that died while its offspring waited loses the offspring too
     # (the reference's pending birth dies with the parent's cell state)
     pending = st.divide_pending & st.alive
@@ -63,7 +64,7 @@ def flush_births(params, st, key, neighbors, update_no):
         cand = jnp.concatenate([cand, rows[:, None]], axis=1)   # [N, 9]
     ncand = cand.shape[1]
     occupied = st.alive[cand]                         # [N, C]
-    u = jax.random.uniform(key, (n, ncand))
+    u = jax.random.uniform(k_place, (n, ncand))
     score = u
     if params.prefer_empty:
         score = score + jnp.where(~occupied, 10.0, 0.0)
@@ -83,11 +84,11 @@ def flush_births(params, st, key, neighbors, update_no):
     parent_idx = jnp.clip(claim, 0, n - 1)  # int[N]: who fathered it
     won = pending & (claim[target] == rows)
 
-    # zero/fresh fields for the newborn
+    # materialize offspring genomes (deferred h-divide half + divide
+    # mutations; ops/interpreter.extract_offspring)
     from avida_tpu.core.state import make_cell_inputs
-    off_mem = st.off_mem
-    off_len = st.off_len
-    k_inputs, _ = jax.random.split(key)
+    from avida_tpu.ops.interpreter import extract_offspring, pack_tape
+    off_mem, off_len = extract_offspring(params, st, k_off)
     fresh_inputs = make_cell_inputs(k_inputs, n)
 
     max_exec = jnp.where(
@@ -95,9 +96,8 @@ def flush_births(params, st, key, neighbors, update_no):
         jnp.where(params.death_method == 1, params.age_limit, 2**30))
 
     updates = {
-        "mem": off_mem, "mem_len": off_len,
+        "tape": pack_tape(off_mem), "mem_len": off_len,
         "genome": off_mem, "genome_len": off_len,
-        "flag_exec": jnp.zeros((n, L), bool), "flag_copied": jnp.zeros((n, L), bool),
         "regs": jnp.zeros((n, 3), jnp.int32), "heads": jnp.zeros((n, 4), jnp.int32),
         "stacks": jnp.zeros((n, 2, 10), jnp.int32), "sp": jnp.zeros((n, 2), jnp.int32),
         "active_stack": jnp.zeros(n, jnp.int32),
@@ -126,12 +126,13 @@ def flush_births(params, st, key, neighbors, update_no):
         "max_executed": max_exec,
         "num_divides": jnp.zeros(n, jnp.int32),
         "divide_pending": jnp.zeros(n, bool),
-        "off_mem": jnp.zeros((n, L), jnp.int8), "off_len": jnp.zeros(n, jnp.int32),
+        "off_start": jnp.zeros(n, jnp.int32), "off_len": jnp.zeros(n, jnp.int32),
         "off_copied_size": jnp.zeros(n, jnp.int32),
         "genotype_id": jnp.full(n, -1, jnp.int32),
         "parent_id": rows.astype(jnp.int32),
         "birth_update": jnp.full(n, update_no, jnp.int32),
         "insts_executed": jnp.zeros(n, jnp.int32),
+        "budget_carry": jnp.zeros(n, jnp.int32),
     }
 
     new_fields = {}

@@ -6,8 +6,27 @@ and its 563-way function-pointer dispatch at cc:1079) with *instruction-class
 batching*: every semantic opcode's effect is computed as masked batched tensor
 ops over the whole population, then merged.  There is no per-organism control
 flow -- organisms at different opcodes are different lanes of the same tensor
-program, which is what makes the design map onto the TPU's vector units and
-lets XLA fuse the whole step into a few kernels.
+program.
+
+TPU kernel design (measured on v5e; see git history for the microbenchmarks):
+
+* **One packed tape.**  Memory opcode + per-site executed/copied flags
+  (ref cCPUMemory per-site flags) live in a single uint8 plane:
+  bits 0-5 opcode (<=64 instructions), bit 6 executed, bit 7 copied.
+  The whole per-step working set is then ~N*L bytes and stays VMEM-resident
+  across the update's while_loop instead of round-tripping HBM.
+* **No element gathers.**  A per-row `mem[rows, ip]` gather costs ~3x a full
+  dense pass on TPU, and 2-D per-row-offset gathers are ~400x.  Every read
+  is a masked reduction (`sum(where(cols == pos, tape, 0))`), every write a
+  masked select, and label matching uses *static* shifts (pad+slice).
+* **Rare-op gating.**  h-search, label reads, divide viability, IO/task
+  evaluation and h-alloc each run under `lax.cond` on "any lane wants it
+  this cycle", so their full-width passes are skipped on the (common)
+  cycles where no organism executes them.
+* **Deferred offspring extraction.**  h-divide only records the split point
+  (off_start/off_len); the per-row variable shift that materializes the
+  offspring genome runs once per update in the birth engine (ops/birth.py)
+  as a log2(L)-step barrel shift, not in the per-cycle loop.
 
 Per-instruction semantics are re-derived from the cited reference
 implementations (see avida_tpu/models/heads.py docstrings for the map).
@@ -28,6 +47,20 @@ from avida_tpu.models.heads import (
 )
 from avida_tpu.ops import tasks as tasks_ops
 
+# packed-tape layout
+OP_MASK = jnp.uint8(0x3F)     # bits 0-5: opcode
+EXEC_BIT = jnp.uint8(0x40)    # bit 6: executed flag (cCPUMemory FlagExecuted)
+COPIED_BIT = jnp.uint8(0x80)  # bit 7: copied flag (FlagCopied)
+
+
+def pack_tape(ops):
+    """Opcode array (int8) -> packed tape (uint8, flags clear)."""
+    return ops.astype(jnp.uint8) & OP_MASK
+
+
+def tape_ops(tape):
+    return (tape & OP_MASK).astype(jnp.int32)
+
 
 def _adjust(pos, mlen):
     """Head adjustment (ref cHeadCPU::fullAdjust, cHeadCPU.cc:28): negative
@@ -36,15 +69,38 @@ def _adjust(pos, mlen):
     return jnp.where(pos < 0, 0, pos % mlen)
 
 
+def _shift_left(plane, k):
+    """plane[:, i] <- plane[:, i+k], zero-filled at the end (static shift)."""
+    if k == 0:
+        return plane
+    pad = jnp.zeros_like(plane[:, :k])
+    return jnp.concatenate([plane[:, k:], pad], axis=1)
+
+
+def barrel_shift_left(plane, shift, L):
+    """Per-row left-rotate-free shift: out[n, q] = plane[n, q + shift[n]]
+    (zero beyond the end).  log2(L) static shifts instead of a 2-D gather
+    (which is ~400x slower on TPU)."""
+    out = plane
+    k = 1
+    b = 0
+    while k < L:
+        bit = (shift >> b) & 1
+        out = jnp.where((bit == 1)[:, None], _shift_left(out, k), out)
+        k <<= 1
+        b += 1
+    return out
+
+
 def micro_step(params, st, key, exec_mask):
     """Execute one CPU cycle for every organism where exec_mask is set.
 
     Equivalent to one pass of the reference hot loop (Avida2Driver.cc:111-116)
     over every scheduled organism simultaneously.  Returns the new state.
     """
-    n, L = st.mem.shape
-    rows = jnp.arange(n)
+    n, L = st.tape.shape
     cols = jnp.arange(L)
+    tape = st.tape
 
     # instruction-set tables (trace-time constants)
     sem_t = jnp.asarray(params.sem, jnp.int32)
@@ -56,8 +112,51 @@ def micro_step(params, st, key, exec_mask):
 
     mlen = jnp.maximum(st.mem_len, 1)
     ip = _adjust(st.heads[:, HEAD_IP], mlen)
-    cur_op = st.mem[rows, ip].astype(jnp.int32)
-    cur_op = jnp.clip(cur_op, 0, num_insts - 1)
+    rp = _adjust(st.heads[:, HEAD_READ], mlen)
+    wp = _adjust(st.heads[:, HEAD_WRITE], mlen)
+
+    # ================= THE read traversal =================
+    # ONE multi-output masked reduction over the tape produces everything
+    # any instruction could need this cycle: the fetched instruction pair
+    # (at IP, IP+1), the read-head opcode, the 10 label opcodes after IP,
+    # and the divide-viability flag counts.  Reductions are the dominant
+    # per-cycle cost on TPU (~0.3 ms per [N,L] traversal at N=100k); fusing
+    # them into one pass and avoiding integer division ([N,L] `%` is ~4x a
+    # whole traversal) is what the profile demanded.
+    ops_plane = (tape & OP_MASK).astype(jnp.int32)
+    shift1 = jnp.concatenate(
+        [ops_plane[:, 1:], jnp.zeros((n, 1), jnp.int32)], axis=1)
+    flags_plane = (tape >> 6).astype(jnp.int32)             # bit0 exec, bit1 copied
+    fetch_plane = ops_plane | (shift1 << 6) | (flags_plane << 12)
+    inwin = cols[None, :] < mlen[:, None]
+    rel0 = cols[None, :] - (ip + 1)[:, None]
+    rel = rel0 + jnp.where(rel0 < 0, mlen[:, None], 0)      # (c - ip - 1) mod mlen
+    lab_sh = jnp.where(rel < 5, rel, rel - 5) * 6
+    lab_lo_m = inwin & (rel < 5)
+    lab_hi_m = inwin & (rel >= 5) & (rel < MAX_LABEL_SIZE)
+    m_ip = cols[None, :] == ip[:, None]
+    m_rp = cols[None, :] == rp[:, None]
+    # divide viability zones (pre-step flag state; see adjustment below)
+    parent_size = rp
+    child_end = jnp.where(wp == 0, mlen, wp)
+    child_size = child_end - parent_size
+    in_parent = cols[None, :] < parent_size[:, None]
+    copy_zone = ((cols[None, :] >= parent_size[:, None]) &
+                 (cols[None, :] < child_end[:, None]))
+
+    def msum(mask, plane):
+        return jnp.sum(jnp.where(mask, plane, 0), axis=1, dtype=jnp.int32)
+
+    s_ip = msum(m_ip, fetch_plane)
+    s_rp = msum(m_rp, ops_plane)
+    lab_lo = msum(lab_lo_m, ops_plane << jnp.minimum(lab_sh, 30))
+    lab_hi = msum(lab_hi_m, ops_plane << jnp.minimum(lab_sh, 30))
+    exec_count0 = msum(in_parent, flags_plane & 1)
+    copied_count = msum(copy_zone, flags_plane >> 1)
+    # ======================================================
+
+    cur_op = jnp.clip(s_ip & 63, 0, num_insts - 1)
+    ip_exec_already = ((s_ip >> 12) & 1) != 0
     sem = jnp.where(exec_mask, sem_t[cur_op], -1)
 
     def is_op(s):
@@ -65,7 +164,9 @@ def micro_step(params, st, key, exec_mask):
 
     # ---- operand resolution (FindModifiedRegister/Head, cc:1622,1663) ----
     next_pos = _adjust(ip + 1, mlen)
-    next_op = jnp.clip(st.mem[rows, next_pos].astype(jnp.int32), 0, num_insts - 1)
+    op0 = (tape[:, 0] & OP_MASK).astype(jnp.int32)          # wrap target
+    next_op = jnp.where(ip == mlen - 1, op0, (s_ip >> 6) & 63)
+    next_op = jnp.clip(next_op, 0, num_insts - 1)
     next_is_nop = is_nop_t[next_op]
     mod_kind = jnp.where(exec_mask, mod_kind_t[cur_op], MOD_NONE)
     wants_mod = (mod_kind == MOD_REG) | (mod_kind == MOD_HEAD)
@@ -73,72 +174,95 @@ def micro_step(params, st, key, exec_mask):
     operand = jnp.where(has_mod, nop_mod_t[next_op], default_op_t[cur_op])
     consumed = has_mod.astype(jnp.int32)
 
-    # ---- label read (ReadLabel, cc:1484: nop run after IP, max 10) ----
+    # ---- label decode (ReadLabel, cc:1484: nop run after IP, max 10) ----
     has_label = mod_kind == MOD_LABEL
-    loff = jnp.arange(MAX_LABEL_SIZE, dtype=jnp.int32)
-    lab_pos = _adjust(ip[:, None] + 1 + loff[None, :], mlen[:, None])  # [N,10]
-    lab_ops = jnp.clip(st.mem[rows[:, None], lab_pos].astype(jnp.int32),
-                       0, num_insts - 1)
+    lab_ops = jnp.stack(
+        [(lab_lo >> (6 * k)) & 63 for k in range(5)]
+        + [(lab_hi >> (6 * k)) & 63 for k in range(5)], axis=1)  # [N,10]
+    lab_ops = jnp.clip(lab_ops, 0, num_insts - 1)
     lab_isnop = is_nop_t[lab_ops]
-    lab_run = jnp.cumprod(lab_isnop.astype(jnp.int32), axis=1)
+    # genomes shorter than the label window can alias back onto the label
+    # instruction itself; a wrapped-past-origin position is not part of a run
+    loff = jnp.arange(MAX_LABEL_SIZE, dtype=jnp.int32)
+    in_range = (loff[None, :] + 1) <= (mlen - 1)[:, None]
+    lab_run = jnp.cumprod((lab_isnop & in_range).astype(jnp.int32), axis=1)
     label_len = jnp.where(has_label, lab_run.sum(axis=1), 0)
-    label = nop_mod_t[lab_ops]                                          # [N,10]
+    label = nop_mod_t[lab_ops]                              # [N,10]
     consumed = jnp.where(has_label, label_len, consumed)
 
     # ---- executed flags (SetFlagExecuted in SingleProcess + helpers) ----
-    flag_exec = st.flag_exec
-    flag_exec = flag_exec.at[rows, ip].set(flag_exec[rows, ip] | exec_mask)
-    nop_exec = has_mod  # the consumed modifier nop is marked executed
-    flag_exec = flag_exec.at[rows, next_pos].set(flag_exec[rows, next_pos] | nop_exec)
-    # first label nop marked (MAX_LABEL_EXE_SIZE=1, cAvidaConfig default)
-    lab0 = lab_pos[:, 0]
     lab0_exec = has_label & (label_len > 0)
-    flag_exec = flag_exec.at[rows, lab0].set(flag_exec[rows, lab0] | lab0_exec)
+    nop_exec = has_mod | lab0_exec  # modifier/first-label nop marked executed
+    exec_here = m_ip & exec_mask[:, None]
+    exec_next = (cols[None, :] == next_pos[:, None]) & nop_exec[:, None]
+    tape = tape | jnp.where(exec_here | exec_next, EXEC_BIT, jnp.uint8(0))
 
     # ---- register reads (pre-update values) ----
     regs0 = st.regs
-    val = regs0[rows, operand]          # ?reg? for MOD_REG ops
+    r_onehot = jnp.arange(3)[None, :] == operand[:, None]   # [N,3]
+    val = jnp.sum(jnp.where(r_onehot, regs0, 0), axis=1)
     next_reg = (operand + 1) % 3
-    val2 = regs0[rows, next_reg]
+    r2_onehot = jnp.arange(3)[None, :] == next_reg[:, None]
+    val2 = jnp.sum(jnp.where(r2_onehot, regs0, 0), axis=1)
     bx = regs0[:, 1]
     cx = regs0[:, 2]
 
     # ---- PRNG draws for this step ----
-    k_mut, k_in1, k_ins, k_del, k_mpos, k_ipos, k_dpos, k_iinst = \
-        jax.random.split(key, 8)
+    k_mut, k_in1 = jax.random.split(key, 2)
     u_copy_mut = jax.random.uniform(k_mut, (n,))
     rand_inst = jax.random.randint(k_in1, (n,), 0, num_insts, dtype=jnp.int32)
 
     # ---- stacks (cCPUStack.h:59-77: push decrements sp, pop reads+zeros) ----
-    a = st.active_stack
-    spa = st.sp[rows, a]
+    a1 = st.active_stack[:, None] == jnp.arange(2)[None, :]     # [N,2]
+    spa = jnp.sum(jnp.where(a1, st.sp, 0), axis=1)
     push_m = is_op(SEM_PUSH)
     pop_m = is_op(SEM_POP)
     sp_push = (spa + 9) % 10
-    pop_val = st.stacks[rows, a, spa]
-    stacks = st.stacks
-    stacks = stacks.at[rows, a, sp_push].set(
-        jnp.where(push_m, val, stacks[rows, a, sp_push]))
-    stacks = stacks.at[rows, a, spa].set(
-        jnp.where(pop_m, 0, stacks[rows, a, spa]))
+    slot = jnp.arange(10)[None, None, :]
+    cur_slot = a1[:, :, None] & (slot == spa[:, None, None])
+    push_slot = a1[:, :, None] & (slot == sp_push[:, None, None])
+    pop_val = jnp.sum(jnp.where(cur_slot, st.stacks, 0), axis=(1, 2))
+    stacks = jnp.where(push_slot & push_m[:, None, None],
+                       val[:, None, None], st.stacks)
+    stacks = jnp.where(cur_slot & pop_m[:, None, None], 0, stacks)
     new_spa = jnp.where(push_m, sp_push, jnp.where(pop_m, (spa + 1) % 10, spa))
-    sp = st.sp.at[rows, a].set(new_spa)
-    active_stack = jnp.where(is_op(SEM_SWAP_STK), 1 - a, a)
+    sp = jnp.where(a1, new_spa[:, None], st.sp)
+    active_stack = jnp.where(is_op(SEM_SWAP_STK), 1 - st.active_stack,
+                             st.active_stack)
 
     # ---- h-search (cc:7245: complement label, find-forward from origin) ----
     lbl_c = (label + 1) % 3             # complement rotation (Rotate(1,3))
     srch = is_op(SEM_H_SEARCH)
-    # match[o, q] = complement label occurs at memory offset q
-    match = jnp.ones((n, L), bool)
-    for k in range(MAX_LABEL_SIZE):
-        pk = jnp.minimum(cols[None, :] + k, L - 1)
-        opk = jnp.clip(st.mem[rows[:, None], pk].astype(jnp.int32), 0, num_insts - 1)
-        mk = is_nop_t[opk] & (nop_mod_t[opk] == lbl_c[:, k:k + 1])
-        match = match & jnp.where(k < label_len[:, None], mk, True)
-    match = match & ((cols[None, :] + label_len[:, None]) <= mlen[:, None])
-    match = match & (label_len[:, None] > 0)
-    found = match.any(axis=1)
-    q_found = jnp.argmax(match, axis=1)
+
+    def search_block(_):
+        # match[o, q] = complement label occurs at memory offset q.
+        # Shifted nop planes replace per-row gathers; the loop is bounded by
+        # the LONGEST label actually being searched this cycle (labels are
+        # 1-3 nops in practice, MAX_LABEL_SIZE=10 is the ceiling), with
+        # dynamic slices doing the shifting.
+        ops_plane = (tape & OP_MASK).astype(jnp.int32)
+        clipped = jnp.clip(ops_plane, 0, num_insts - 1)
+        isnop_plane = is_nop_t[clipped]
+        nopval_plane = jnp.where(isnop_plane, nop_mod_t[clipped],
+                                 jnp.int32(-1))
+        nv_pad = jnp.pad(nopval_plane, ((0, 0), (0, MAX_LABEL_SIZE)),
+                         constant_values=-2)
+        lmax = jnp.max(jnp.where(srch, label_len, 0))
+
+        def body(k, match):
+            shifted = jax.lax.dynamic_slice_in_dim(nv_pad, k, L, axis=1)
+            want = jax.lax.dynamic_slice_in_dim(lbl_c, k, 1, axis=1)  # [N,1]
+            mk = shifted == want
+            return match & (mk | (k >= label_len)[:, None])
+
+        match = jax.lax.fori_loop(0, lmax, body, jnp.ones((n, L), bool))
+        match = match & ((cols[None, :] + label_len[:, None]) <= mlen[:, None])
+        match = match & (label_len[:, None] > 0)
+        return match.any(axis=1), jnp.argmax(match, axis=1)
+
+    found, q_found = jax.lax.cond(
+        srch.any(), search_block,
+        lambda _: (jnp.zeros(n, bool), jnp.zeros(n, jnp.int32)), None)
     ip_after_label = _adjust(ip + label_len, mlen)   # IP sits on last label nop
     search_head = jnp.where(found, q_found + label_len - 1, ip_after_label)
     search_bx = search_head - ip_after_label
@@ -169,51 +293,60 @@ def micro_step(params, st, key, exec_mask):
         alloc_ok = alloc_ok & ~st.mal_active
     alloc_ok = alloc_ok & (old_len <= (alloc_size.astype(jnp.float32)
                                        * params.offspring_size_range).astype(jnp.int32))
+    # an un-flushed offspring lives beyond mem_len; allocating would overwrite
+    # it, so the parent stalls until the end-of-update birth flush (documented
+    # lockstep semantic; divides are immediately followed by flush in the ref)
+    alloc_ok = alloc_ok & ~st.divide_pending
     alloc_m = alloc_m0 & alloc_ok
     new_len_alloc = old_len + alloc_size
-    # ALLOC_METHOD 0: fill with default instruction (op 0)
-    fill_zone = (cols[None, :] >= old_len[:, None]) & (cols[None, :] < new_len_alloc[:, None])
-    mem = jnp.where((alloc_m[:, None] & fill_zone), jnp.int8(0), st.mem)
+
+    # ALLOC_METHOD 0: fill with default instruction (op 0), flags clear.
+    # (Elementwise write; fuses into the single tape-write traversal below.)
+    fill_zone = ((cols[None, :] >= old_len[:, None]) &
+                 (cols[None, :] < new_len_alloc[:, None]))
+    tape = jnp.where(alloc_m[:, None] & fill_zone, jnp.uint8(0), tape)
     mem_len = jnp.where(alloc_m, new_len_alloc, st.mem_len)
     mal_active = st.mal_active | alloc_m
 
     # ---- h-copy (cc:7130: read->write with copy mutation, advance both) ----
+    # (read-head opcode s_rp came from the read traversal; a same-cycle
+    # h-alloc never coincides with h-copy on the same lane, so the pre-alloc
+    # read is identical)
     copy_m = is_op(SEM_H_COPY)
-    rp = _adjust(st.heads[:, HEAD_READ], mlen)
-    wp = _adjust(st.heads[:, HEAD_WRITE], mlen)
-    read_inst = jnp.clip(mem[rows, rp].astype(jnp.int32), 0, num_insts - 1)
+    read_inst = jnp.clip(s_rp, 0, num_insts - 1)
     do_mut = copy_m & (u_copy_mut < params.copy_mut_prob)
     written = jnp.where(do_mut, rand_inst, read_inst)
-    mem = mem.at[rows, wp].set(
-        jnp.where(copy_m, written.astype(jnp.int8), mem[rows, wp]))
-    flag_copied = st.flag_copied
-    flag_copied = flag_copied.at[rows, wp].set(flag_copied[rows, wp] | copy_m)
+    # write sets the copied flag; the executed flag at the site persists
+    # (ref cCPUMemory::SetFlagCopied does not clear FlagExecuted)
+    packed = written.astype(jnp.uint8) | COPIED_BIT
+    w_onehot = (cols[None, :] == wp[:, None]) & copy_m[:, None]
+    tape = jnp.where(w_onehot, packed[:, None] | (tape & EXEC_BIT), tape)
     # read-label tracking uses the PRE-mutation instruction (ReadInst cc:1459)
     ri_nop = is_nop_t[read_inst] & copy_m
     ri_clear = (~is_nop_t[read_inst]) & copy_m
     rl_len = st.read_label_len
     can_append = ri_nop & (rl_len < MAX_LABEL_SIZE)
-    read_label = st.read_label.at[rows, jnp.clip(rl_len, 0, MAX_LABEL_SIZE - 1)].set(
-        jnp.where(can_append, nop_mod_t[read_inst].astype(jnp.int8),
-                  st.read_label[rows, jnp.clip(rl_len, 0, MAX_LABEL_SIZE - 1)]))
+    rl_slot = jnp.arange(MAX_LABEL_SIZE)[None, :] == rl_len[:, None]
+    read_label = jnp.where(rl_slot & can_append[:, None],
+                           nop_mod_t[read_inst][:, None].astype(jnp.int8),
+                           st.read_label)
     read_label_len = jnp.where(ri_clear, 0,
                                jnp.where(can_append, rl_len + 1, rl_len))
 
     # ---- h-divide (Inst_HeadDivide cc:6961 -> Divide_Main cc:1775) ----
     div_try = is_op(SEM_H_DIVIDE)
     div_point = rp
-    child_end = jnp.where(wp == 0, mlen, wp)
-    child_size = child_end - div_point
-    parent_size = div_point
     gsize = st.genome_len
     fsize = gsize.astype(jnp.float32)
     min_sz = jnp.maximum(params.min_genome_len,
                          (fsize / params.offspring_size_range).astype(jnp.int32))
     max_sz = jnp.minimum(L, (fsize * params.offspring_size_range).astype(jnp.int32))
-    exec_count = (flag_exec & (cols[None, :] < parent_size[:, None])).sum(axis=1)
-    copy_zone = ((cols[None, :] >= parent_size[:, None]) &
-                 (cols[None, :] < (parent_size + child_size)[:, None]))
-    copied_count = (flag_copied & copy_zone).sum(axis=1)
+
+    # viability flag counts came from the read traversal (pre-step flags);
+    # the reference marks the h-divide site executed before counting, so add
+    # it when this cycle's fetch is the first execution of that site
+    exec_count = exec_count0 + jnp.where(
+        div_try & ~ip_exec_already & (ip < parent_size), 1, 0)
     viable = ((child_size >= min_sz) & (child_size <= max_sz) &
               (parent_size >= min_sz) & (parent_size <= max_sz) &
               (exec_count >= (parent_size.astype(jnp.float32)
@@ -223,54 +356,27 @@ def micro_step(params, st, key, exec_mask):
               ~st.divide_pending)   # lockstep: one pending birth per organism
     div_m = div_try & viable
 
-    # offspring genome extraction: off[q] = mem[div_point + q], q < child_size
-    src = jnp.minimum(div_point[:, None] + cols[None, :], L - 1)
-    off_raw = mem[rows[:, None], src]
-    off_mask = cols[None, :] < child_size[:, None]
-    off = jnp.where(off_mask, off_raw, jnp.int8(0))
-    off_len = child_size
-
-    # divide mutations (Divide_DoMutations, cHardwareBase.cc:296: point sub,
-    # then single insertion, then single deletion; stock rates 0/0.05/0.05)
-    u_mut = jax.random.uniform(k_ins, (n, 3))
-    r_inst2 = jax.random.randint(k_iinst, (n, 2), 0, num_insts, dtype=jnp.int32)
-    # point substitution
-    if params.divide_mut_prob > 0:
-        mpos = jax.random.randint(k_mpos, (n,), 0, jnp.maximum(off_len, 1))
-        do_sub = div_m & (u_mut[:, 0] < params.divide_mut_prob) & (off_len > 0)
-        off = off.at[rows, jnp.clip(mpos, 0, L - 1)].set(
-            jnp.where(do_sub, r_inst2[:, 0].astype(jnp.int8),
-                      off[rows, jnp.clip(mpos, 0, L - 1)]))
-    # single insertion
-    if params.divide_ins_prob > 0:
-        ipos = jax.random.randint(k_ipos, (n,), 0, jnp.maximum(off_len, 1) + 1)
-        do_ins = div_m & (u_mut[:, 1] < params.divide_ins_prob) & (off_len + 1 <= max_sz)
-        shifted = jnp.where(cols[None, :] > ipos[:, None],
-                            off[rows[:, None], jnp.maximum(cols[None, :] - 1, 0)],
-                            off)
-        inserted = shifted.at[rows, jnp.clip(ipos, 0, L - 1)].set(
-            r_inst2[:, 1].astype(jnp.int8))
-        off = jnp.where(do_ins[:, None], inserted, off)
-        off_len = jnp.where(do_ins, off_len + 1, off_len)
-    # single deletion
-    if params.divide_del_prob > 0:
-        dpos = jax.random.randint(k_dpos, (n,), 0, jnp.maximum(off_len, 1))
-        do_del = div_m & (u_mut[:, 2] < params.divide_del_prob) & (off_len - 1 >= params.min_genome_len)
-        deleted = jnp.where(cols[None, :] >= dpos[:, None],
-                            off[rows[:, None], jnp.minimum(cols[None, :] + 1, L - 1)],
-                            off)
-        deleted = jnp.where(cols[None, :] >= (off_len - 1)[:, None], jnp.int8(0), deleted)
-        off = jnp.where(do_del[:, None], deleted, off)
-        off_len = jnp.where(do_del, off_len - 1, off_len)
+    # offspring extraction is DEFERRED: record the split; ops/birth.py
+    # materializes the genome (barrel shift + divide mutations) at flush
+    off_start = jnp.where(div_m, div_point, st.off_start)
+    off_len = jnp.where(div_m, child_size, st.off_len)
 
     # ---- IO + task evaluation (Inst_TaskIO cc:4188; SURVEY §3.4) ----
     io_m = is_op(SEM_IO)
-    env_tables = tasks_ops.env_tables_to_device(params)
-    logic_id = tasks_ops.compute_logic_id(st.input_buf, st.input_buf_n, val)
-    new_bonus, new_tc, new_rc, _ = tasks_ops.apply_reactions(
-        env_tables, io_m, logic_id, st.cur_bonus,
-        st.cur_task_count, st.cur_reaction_count)
-    value_in = st.inputs[rows, st.input_ptr % 3]
+    in_slot = jnp.arange(3)[None, :] == (st.input_ptr % 3)[:, None]
+    value_in = jnp.sum(jnp.where(in_slot, st.inputs, 0), axis=1)
+
+    def io_block(_):
+        env_tables = tasks_ops.env_tables_to_device(params)
+        logic_id = tasks_ops.compute_logic_id(st.input_buf, st.input_buf_n, val)
+        return tasks_ops.apply_reactions(
+            env_tables, io_m, logic_id, st.cur_bonus,
+            st.cur_task_count, st.cur_reaction_count)[:3]
+
+    new_bonus, new_tc, new_rc = jax.lax.cond(
+        io_m.any(), io_block,
+        lambda _: (st.cur_bonus, st.cur_task_count, st.cur_reaction_count),
+        None)
     input_ptr = jnp.where(io_m, st.input_ptr + 1, st.input_ptr)
     input_buf = jnp.where(io_m[:, None],
                           jnp.stack([value_in, st.input_buf[:, 0],
@@ -295,7 +401,12 @@ def micro_step(params, st, key, exec_mask):
         wrote = wrote | is_op(s)
 
     def setreg(regs, idx, v, m):
-        return regs.at[rows, idx].set(jnp.where(m, v, regs[rows, idx]))
+        oh = (jnp.arange(3)[None, :] == idx[:, None]) & m[:, None]
+        return jnp.where(oh, v[:, None], regs)
+
+    def setreg_c(regs, idx, v, m):  # constant register index
+        oh = (jnp.arange(3)[None, :] == idx) & m[:, None]
+        return jnp.where(oh, v[:, None], regs)
 
     regs = setreg(regs0, operand, res, wrote)
     regs = setreg(regs, next_reg, val, is_op(SEM_SWAP))
@@ -303,13 +414,15 @@ def micro_step(params, st, key, exec_mask):
     # itself, its position reflects the consumed modifier nop (FindModifiedHead
     # advances IP onto the nop before the head is read).
     hsel0 = jnp.where(mod_kind == MOD_HEAD, operand, HEAD_IP)
+    h_onehot = jnp.arange(4)[None, :] == hsel0[:, None]     # [N,4]
+    head_sel = jnp.sum(jnp.where(h_onehot, st.heads, 0), axis=1)
     eff_head_pos = jnp.where(hsel0 == HEAD_IP,
                              _adjust(ip + consumed, mlen),
-                             _adjust(st.heads[rows, hsel0], mlen))
-    regs = setreg(regs, 2, eff_head_pos, is_op(SEM_GET_HEAD))
-    regs = setreg(regs, 0, old_len, alloc_m)            # h-alloc: AX <- old size
-    regs = setreg(regs, 1, search_bx, srch)             # h-search: BX dist
-    regs = setreg(regs, 2, search_cx, srch)             # h-search: CX size
+                             _adjust(head_sel, mlen))
+    regs = setreg_c(regs, 2, eff_head_pos, is_op(SEM_GET_HEAD))
+    regs = setreg_c(regs, 0, old_len, alloc_m)          # h-alloc: AX <- old size
+    regs = setreg_c(regs, 1, search_bx, srch)           # h-search: BX dist
+    regs = setreg_c(regs, 2, search_cx, srch)           # h-search: CX size
     # divide (DIVIDE_METHOD 1): hardware reset -> registers cleared
     regs = jnp.where(div_m[:, None], 0, regs)
 
@@ -317,16 +430,14 @@ def micro_step(params, st, key, exec_mask):
     heads = st.heads
     mov_m = is_op(SEM_MOV_HEAD)
     jmp_m = is_op(SEM_JMP_HEAD)
-    hsel = hsel0
-    hpos = eff_head_pos
     flow0 = _adjust(heads[:, HEAD_FLOW], mlen)
-    new_hpos = jnp.where(mov_m, flow0, _adjust(hpos + cx, mlen))
-    heads = heads.at[rows, hsel].set(
-        jnp.where(mov_m | jmp_m, new_hpos, heads[rows, hsel]))
+    new_hpos = jnp.where(mov_m, flow0, _adjust(eff_head_pos + cx, mlen))
+    mv = (mov_m | jmp_m)
+    heads = jnp.where(h_onehot & mv[:, None], new_hpos[:, None], heads)
     setflow_m = is_op(SEM_SET_FLOW)
-    heads = heads.at[:, HEAD_FLOW].set(
-        jnp.where(setflow_m, _adjust(val, mlen),
-                  jnp.where(srch, new_flow_srch, heads[:, HEAD_FLOW])))
+    new_flow = jnp.where(setflow_m, _adjust(val, mlen),
+                         jnp.where(srch, new_flow_srch, heads[:, HEAD_FLOW]))
+    heads = heads.at[:, HEAD_FLOW].set(new_flow)
     # h-copy advances READ/WRITE (with eager wrap, cHeadCPU.h:78)
     heads = heads.at[:, HEAD_READ].set(
         jnp.where(copy_m, _adjust(rp + 1, mlen), heads[:, HEAD_READ]))
@@ -336,8 +447,8 @@ def micro_step(params, st, key, exec_mask):
     # ---- IP advance ----
     # mov-head targeting IP suppresses the end-of-cycle advance (cc:6809);
     # a successful divide resets the CPU (DIVIDE_METHOD 1 -> IP=0).
-    mov_ip = mov_m & (hsel == HEAD_IP)
-    jmp_ip = jmp_m & (hsel == HEAD_IP)
+    mov_ip = mov_m & (hsel0 == HEAD_IP)
+    jmp_ip = jmp_m & (hsel0 == HEAD_IP)
     ip_seq = _adjust(ip + consumed + skip.astype(jnp.int32) + 1, mlen)
     # jmp-head on IP: jump from the post-modifier position, then advance
     jmp_tgt = _adjust(_adjust(ip + consumed + cx, mlen) + 1, mlen)
@@ -349,8 +460,9 @@ def micro_step(params, st, key, exec_mask):
 
     # ---- divide: parent reset + pending offspring ----
     mem_len = jnp.where(div_m, div_point, mem_len)
-    flag_exec = jnp.where(div_m[:, None], False, flag_exec)
-    flag_copied = jnp.where(div_m[:, None], False, flag_copied)
+    # clear per-site flags on divided rows (offspring opcodes stay in place
+    # beyond mem_len until the birth flush extracts them)
+    tape = jnp.where(div_m[:, None], tape & OP_MASK, tape)
     heads = jnp.where(div_m[:, None], 0, heads)
     stacks = jnp.where(div_m[:, None, None], 0, stacks)
     sp = jnp.where(div_m[:, None], 0, sp)
@@ -389,7 +501,7 @@ def micro_step(params, st, key, exec_mask):
     insts_executed = st.insts_executed + exec_mask.astype(jnp.int32)
 
     return st.replace(
-        mem=mem, mem_len=mem_len, flag_exec=flag_exec, flag_copied=flag_copied,
+        tape=tape, mem_len=mem_len,
         regs=regs, heads=heads, stacks=stacks, sp=sp, active_stack=active_stack,
         read_label=read_label, read_label_len=read_label_len,
         mal_active=mal_active, alive=alive,
@@ -404,11 +516,65 @@ def micro_step(params, st, key, exec_mask):
         executed_size=executed_size, child_copied_size=child_copied_size,
         generation=generation, num_divides=num_divides,
         divide_pending=st.divide_pending | div_m,
-        off_mem=jnp.where(div_m[:, None], off, st.off_mem),
-        off_len=jnp.where(div_m, off_len, st.off_len),
+        off_start=off_start, off_len=off_len,
         off_copied_size=jnp.where(div_m, copied_count, st.off_copied_size),
         insts_executed=insts_executed,
     )
+
+
+def extract_offspring(params, st, key):
+    """Materialize pending offspring genomes: off[n, q] = opcodes[n,
+    off_start[n] + q] for q < off_len[n], with divide mutations applied
+    (Divide_DoMutations, cHardwareBase.cc:296: point sub, single insertion,
+    single deletion; stock rates 0/0.05/0.05).
+
+    Runs once per update in the birth engine -- the deferred half of
+    h-divide.  Returns (off int8[N, L], off_len int32[N])."""
+    n, L = st.tape.shape
+    rows = jnp.arange(n)
+    cols = jnp.arange(L)
+    ops = tape_ops(st.tape).astype(jnp.int8)
+    off = barrel_shift_left(ops, st.off_start, L)
+    off_len = st.off_len
+    off = jnp.where(cols[None, :] < off_len[:, None], off, jnp.int8(0))
+
+    gsize = st.genome_len.astype(jnp.float32)
+    min_sz = jnp.maximum(params.min_genome_len,
+                         (gsize / params.offspring_size_range).astype(jnp.int32))
+    max_sz = jnp.minimum(L, (gsize * params.offspring_size_range).astype(jnp.int32))
+    div_m = st.divide_pending
+
+    k_u, k_mpos, k_ipos, k_dpos, k_iinst = jax.random.split(key, 5)
+    u_mut = jax.random.uniform(k_u, (n, 3))
+    r_inst2 = jax.random.randint(k_iinst, (n, 2), 0, params.num_insts,
+                                 dtype=jnp.int32)
+    # point substitution
+    if params.divide_mut_prob > 0:
+        mpos = jax.random.randint(k_mpos, (n,), 0, jnp.maximum(off_len, 1))
+        do_sub = div_m & (u_mut[:, 0] < params.divide_mut_prob) & (off_len > 0)
+        sub_mask = (cols[None, :] == mpos[:, None]) & do_sub[:, None]
+        off = jnp.where(sub_mask, r_inst2[:, 0:1].astype(jnp.int8), off)
+    # single insertion
+    if params.divide_ins_prob > 0:
+        ipos = jax.random.randint(k_ipos, (n,), 0, jnp.maximum(off_len, 1) + 1)
+        do_ins = div_m & (u_mut[:, 1] < params.divide_ins_prob) & (off_len + 1 <= max_sz)
+        shifted = jnp.where(cols[None, :] > ipos[:, None],
+                            jnp.pad(off, ((0, 0), (1, 0)))[:, :L], off)
+        ins_mask = cols[None, :] == ipos[:, None]
+        inserted = jnp.where(ins_mask, r_inst2[:, 1:2].astype(jnp.int8), shifted)
+        off = jnp.where(do_ins[:, None], inserted, off)
+        off_len = jnp.where(do_ins, off_len + 1, off_len)
+    # single deletion
+    if params.divide_del_prob > 0:
+        dpos = jax.random.randint(k_dpos, (n,), 0, jnp.maximum(off_len, 1))
+        do_del = div_m & (u_mut[:, 2] < params.divide_del_prob) & (off_len - 1 >= params.min_genome_len)
+        deleted = jnp.where(cols[None, :] >= dpos[:, None],
+                            jnp.pad(off, ((0, 0), (0, 1)))[:, 1:], off)
+        deleted = jnp.where(cols[None, :] >= (off_len - 1)[:, None],
+                            jnp.int8(0), deleted)
+        off = jnp.where(do_del[:, None], deleted, off)
+        off_len = jnp.where(do_del, off_len - 1, off_len)
+    return off, off_len
 
 
 def _calc_size_merit(params, genome_len, copied_size, executed_size):

@@ -32,10 +32,24 @@ def update_step(params, st, key, neighbors, update_no):
     k_budget, k_steps, k_birth = jax.random.split(key, 3)
 
     budgets = sched_ops.compute_budgets(params, st, k_budget)
-    max_k = budgets.max()
-    if params.max_steps_per_update:
-        max_k = jnp.minimum(max_k, params.max_steps_per_update)
-        budgets = jnp.minimum(budgets, params.max_steps_per_update)
+    # Budget carry-over (TPU lockstep semantic, SURVEY §7 step 3): the
+    # micro-step count per update is capped so SIMD lanes stay busy -- the
+    # reference's merit-proportional allocation is heavy-tailed (an organism
+    # at k x mean merit gets k x AVE_TIME_SLICE cycles *within one update*,
+    # which would leave every other lane idle for the tail).  Cycles an
+    # organism earned but could not execute this update (cap, or the
+    # post-divide stall below) are banked per-organism and re-granted next
+    # update, so merit proportionality is preserved as bounded-burst stride
+    # scheduling: within-update bursts are capped at 2 x AVE_TIME_SLICE
+    # (config TPU_MAX_STEPS_PER_UPDATE overrides), and the bank holds up to
+    # 100 x AVE_TIME_SLICE before cycles are dropped.  Documented deviation:
+    # a lineage sustaining > 2x the population-mean merit spreads more
+    # slowly than in the reference (selection direction and first-discovery
+    # statistics are unaffected; fixation sweeps are time-smeared).
+    budgets = budgets + st.budget_carry
+    cap = params.max_steps_per_update or 2 * params.ave_time_slice
+    max_k = jnp.minimum(budgets.max(), cap)
+    granted = jnp.minimum(budgets, max_k)
 
     executed0 = st.insts_executed
 
@@ -45,11 +59,18 @@ def update_step(params, st, key, neighbors, update_no):
 
     def body(carry):
         s, st = carry
-        exec_mask = st.alive & (s < budgets)
+        # a freshly divided parent stalls until the end-of-update birth
+        # flush extracts its offspring from the tape (deferred h-divide;
+        # ops/interpreter.py header) -- it resumes next update
+        exec_mask = st.alive & (s < granted) & ~st.divide_pending
         st = micro_step(params, st, jax.random.fold_in(k_steps, s), exec_mask)
         return s + 1, st
 
     _, st = jax.lax.while_loop(cond, body, (jnp.int32(0), st))
+    # bank whatever each organism earned but did not execute (cap or stall)
+    executed_this = st.insts_executed - executed0
+    carry = jnp.clip(budgets - executed_this, 0, 100 * params.ave_time_slice)
+    st = st.replace(budget_carry=jnp.where(st.alive, carry, 0))
 
     st = birth_ops.flush_births(params, st, k_birth, neighbors, update_no)
 
@@ -63,13 +84,15 @@ def update_step(params, st, key, neighbors, update_no):
 def _point_mutation_sweep(params, st, key):
     """Per-site point mutations once per update (Avida2Driver.cc:146-155 ->
     cHardwareBase::PointMutate cc:1087)."""
-    n, L = st.mem.shape
+    n, L = st.tape.shape
     u = jax.random.uniform(key, (n, L))
     r = jax.random.randint(jax.random.fold_in(key, 1), (n, L), 0,
-                           params.num_insts, dtype=jnp.int8)
+                           params.num_insts, dtype=jnp.int32).astype(jnp.uint8)
     in_genome = jnp.arange(L)[None, :] < st.mem_len[:, None]
     hit = (u < params.point_mut_prob) & in_genome & st.alive[:, None]
-    return st.replace(mem=jnp.where(hit, r, st.mem))
+    # replace opcode bits, keep flag bits
+    mutated = (st.tape & jnp.uint8(0xC0)) | r
+    return st.replace(tape=jnp.where(hit, mutated, st.tape))
 
 
 @partial(jax.jit, static_argnums=0)

@@ -118,7 +118,7 @@ def restore_population(params, orgs, key, neighbors=None):
         offs[c] = o["gest_offset"]
 
     st = st.replace(
-        mem=jnp.asarray(mem), mem_len=jnp.asarray(mem_len),
+        tape=jnp.asarray(mem).astype(jnp.uint8), mem_len=jnp.asarray(mem_len),
         genome=jnp.asarray(mem), genome_len=jnp.asarray(mem_len),
         merit=jnp.asarray(merit), alive=jnp.asarray(alive),
         generation=jnp.asarray(gen),

@@ -51,7 +51,7 @@ def build(world_x, world_y, max_memory, seed):
     gm = jnp.asarray(np.broadcast_to(g, (n, L)))
     st = st.replace(
         inputs=make_cell_inputs(k_in, n),
-        mem=gm, genome=gm,
+        tape=gm.astype(jnp.uint8), genome=gm,
         mem_len=jnp.full(n, glen, jnp.int32),
         genome_len=jnp.full(n, glen, jnp.int32),
         alive=jnp.ones(n, bool),

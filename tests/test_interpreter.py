@@ -16,7 +16,7 @@ import pytest
 from avida_tpu.config import AvidaConfig, default_instset
 from avida_tpu.config.environment import default_logic9_environment
 from avida_tpu.core.state import init_population, make_world_params
-from avida_tpu.ops.interpreter import micro_step
+from avida_tpu.ops.interpreter import extract_offspring, micro_step
 from avida_tpu.world import default_ancestor
 
 
@@ -85,7 +85,8 @@ def test_ancestor_replicates_exactly():
     # golden numbers from the reference run (expected average.dat row 0)
     assert gestation == 389, f"gestation {gestation} != 389"
     assert int(st.off_len[0]) == 100
-    offspring = np.asarray(st.off_mem[0, :100])
+    off, _ = extract_offspring(params, st, jax.random.key(9))
+    offspring = np.asarray(off[0, :100])
     np.testing.assert_array_equal(offspring, genome,
                                   "offspring must be an exact copy")
     assert int(st.executed_size[0]) == 97
@@ -112,9 +113,10 @@ def test_second_gestation_same_length():
 def test_copy_mutations_change_offspring():
     params, st, genome = make_single_org({"COPY_MUT_PROB": 0.05})
     st, gestation = run_until_divide(params, st)
-    offspring = np.asarray(st.off_mem[0, :int(st.off_len[0])])
+    off, off_len = extract_offspring(params, st, jax.random.key(9))
+    offspring = np.asarray(off[0, :int(off_len[0])])
     # with 5% per-copy mutation over ~200 copies, changes are certain
-    assert (offspring[:100] != genome).any() or int(st.off_len[0]) != 100
+    assert (offspring[:100] != genome).any() or int(off_len[0]) != 100
 
 
 def test_death_by_age():
