@@ -84,7 +84,17 @@ class Resource:
     inflow: float = 0.0
     outflow: float = 0.0
     initial: float = 0.0
-    geometry: str = "global"
+    geometry: str = "global"      # global | grid | torus (spatial)
+    xdiffuse: float = 1.0         # spatial only (cSpatialResCount diffusion)
+    ydiffuse: float = 1.0
+    inflowx1: int = -1            # spatial inflow box (-1 = everywhere)
+    inflowx2: int = -1
+    inflowy1: int = -1
+    inflowy2: int = -1
+
+    @property
+    def is_spatial(self) -> bool:
+        return self.geometry != "global"
 
 
 @dataclass
@@ -104,6 +114,12 @@ class Environment:
     def reaction_names(self):
         return [r.name for r in self.reactions]
 
+    def global_resources(self):
+        return [r for r in self.resources if not r.is_spatial]
+
+    def spatial_resources(self):
+        return [r for r in self.resources if r.is_spatial]
+
     def device_tables(self):
         """Build numpy tables for the jitted task-evaluation kernel.
 
@@ -117,6 +133,14 @@ class Environment:
         mask = np.zeros((nr, 256), bool)
         value = np.zeros(nr, np.float64)
         ptype = np.zeros(nr, np.int32)
+        # first-process resource binding (cReactionProcess; -1 = infinite)
+        gres = {r.name: i for i, r in enumerate(self.global_resources())}
+        sres = {r.name: i for i, r in enumerate(self.spatial_resources())}
+        p_res = np.full(nr, -1, np.int32)
+        p_spatial = np.zeros(nr, bool)
+        p_max = np.ones(nr, np.float64)
+        p_frac = np.ones(nr, np.float64)
+        p_depl = np.ones(nr, bool)
         max_tc = np.full(nr, 2**30, np.int64)
         min_tc = np.zeros(nr, np.int64)
         max_rc = np.full(nr, 2**30, np.int64)
@@ -130,8 +154,24 @@ class Environment:
                     f"task {r.task!r} is not in the vectorized logic task set yet")
             mask[i, list(LOGIC_TASKS[r.task])] = True
             if r.processes:
-                value[i] = r.processes[0].value
-                ptype[i] = r.processes[0].type
+                p = r.processes[0]
+                value[i] = p.value
+                ptype[i] = p.type
+                p_max[i] = p.max_number
+                p_frac[i] = p.max_fraction
+                p_depl[i] = p.depletable
+                if p.resource is not None and p.resource in gres:
+                    p_res[i] = gres[p.resource]
+                elif p.resource is not None and p.resource in sres:
+                    p_res[i] = sres[p.resource]
+                    p_spatial[i] = True
+                elif p.resource is not None:
+                    # ref cEnvironment::LoadReactionProcess errors on unknown
+                    # resource names; silently treating it as infinite would
+                    # quietly run a limited experiment unlimited
+                    raise ValueError(
+                        f"reaction {r.name!r} binds unknown resource "
+                        f"{p.resource!r}")
             for q in r.requisites:
                 max_tc[i] = min(max_tc[i], q.max_task_count)
                 min_tc[i] = max(min_tc[i], q.min_task_count)
@@ -146,6 +186,8 @@ class Environment:
             "max_task_count": max_tc, "min_task_count": min_tc,
             "max_reaction_count": max_rc, "min_reaction_count": min_rc,
             "req_reaction_mask": req_mask, "noreq_reaction_mask": noreq_mask,
+            "proc_res_idx": p_res, "proc_res_spatial": p_spatial,
+            "proc_max": p_max, "proc_frac": p_frac, "proc_depletable": p_depl,
         }
 
 
@@ -216,6 +258,13 @@ def load_environment(path: str) -> Environment:
                         inflow=float(kv.get("inflow", 0.0)),
                         outflow=float(kv.get("outflow", 0.0)),
                         initial=float(kv.get("initial", 0.0)),
+                        geometry=kv.get("geometry", "global"),
+                        xdiffuse=float(kv.get("xdiffuse", 1.0)),
+                        ydiffuse=float(kv.get("ydiffuse", 1.0)),
+                        inflowx1=int(kv.get("inflowx1", -1)),
+                        inflowx2=int(kv.get("inflowx2", -1)),
+                        inflowy1=int(kv.get("inflowy1", -1)),
+                        inflowy2=int(kv.get("inflowy2", -1)),
                     ))
             # GRADIENT_RESOURCE / CELL / GRID -- planned (spatial resources)
     return env

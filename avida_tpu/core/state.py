@@ -83,6 +83,26 @@ class WorldParams(struct.PyTreeNode):
     min_task_count: tuple = struct.field(pytree_node=False, default=())
     req_reaction_mask: tuple = struct.field(pytree_node=False, default=())
     noreq_reaction_mask: tuple = struct.field(pytree_node=False, default=())
+    # reaction -> resource bindings (cReactionProcess)
+    proc_res_idx: tuple = struct.field(pytree_node=False, default=())
+    proc_res_spatial: tuple = struct.field(pytree_node=False, default=())
+    proc_max: tuple = struct.field(pytree_node=False, default=())
+    proc_frac: tuple = struct.field(pytree_node=False, default=())
+    proc_depletable: tuple = struct.field(pytree_node=False, default=())
+    # global resource pools (cResourceCount)
+    num_global_res: int = struct.field(pytree_node=False, default=0)
+    res_inflow: tuple = struct.field(pytree_node=False, default=())
+    res_outflow: tuple = struct.field(pytree_node=False, default=())
+    res_initial: tuple = struct.field(pytree_node=False, default=())
+    # spatial resources (cSpatialResCount)
+    num_spatial_res: int = struct.field(pytree_node=False, default=0)
+    sres_inflow: tuple = struct.field(pytree_node=False, default=())
+    sres_outflow: tuple = struct.field(pytree_node=False, default=())
+    sres_initial: tuple = struct.field(pytree_node=False, default=())
+    sres_xdiffuse: tuple = struct.field(pytree_node=False, default=())
+    sres_ydiffuse: tuple = struct.field(pytree_node=False, default=())
+    sres_inflow_box: tuple = struct.field(pytree_node=False, default=())
+    sres_torus: tuple = struct.field(pytree_node=False, default=())
 
     @property
     def num_cells(self) -> int:
@@ -141,6 +161,25 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         min_task_count=tuple(env_tables["min_task_count"].tolist()),
         req_reaction_mask=tt(env_tables["req_reaction_mask"]),
         noreq_reaction_mask=tt(env_tables["noreq_reaction_mask"]),
+        proc_res_idx=tuple(env_tables["proc_res_idx"].tolist()),
+        proc_res_spatial=tuple(env_tables["proc_res_spatial"].tolist()),
+        proc_max=tuple(env_tables["proc_max"].tolist()),
+        proc_frac=tuple(env_tables["proc_frac"].tolist()),
+        proc_depletable=tuple(env_tables["proc_depletable"].tolist()),
+        num_global_res=len(environment.global_resources()),
+        res_inflow=tuple(r.inflow for r in environment.global_resources()),
+        res_outflow=tuple(r.outflow for r in environment.global_resources()),
+        res_initial=tuple(r.initial for r in environment.global_resources()),
+        num_spatial_res=len(environment.spatial_resources()),
+        sres_inflow=tuple(r.inflow for r in environment.spatial_resources()),
+        sres_outflow=tuple(r.outflow for r in environment.spatial_resources()),
+        sres_initial=tuple(r.initial for r in environment.spatial_resources()),
+        sres_xdiffuse=tuple(r.xdiffuse for r in environment.spatial_resources()),
+        sres_ydiffuse=tuple(r.ydiffuse for r in environment.spatial_resources()),
+        sres_inflow_box=tuple((r.inflowx1, r.inflowx2, r.inflowy1, r.inflowy2)
+                              for r in environment.spatial_resources()),
+        sres_torus=tuple(r.geometry == "torus"
+                         for r in environment.spatial_resources()),
     )
 
 
@@ -222,6 +261,10 @@ class PopulationState(struct.PyTreeNode):
     insts_executed: jax.Array  # int32[N]  lifetime instructions executed
     budget_carry: jax.Array    # int32[N]  banked cycles (ops/update.py cap)
 
+    # --- resources (world-level state carried with the population) ---
+    resources: jax.Array       # f32[Rg]    global pools (cResourceCount)
+    res_grid: jax.Array        # f32[Rs, N] spatial per-cell (cSpatialResCount)
+
     @property
     def mem(self) -> jax.Array:
         """Opcode view of the packed tape (int8[N, L])."""
@@ -236,7 +279,8 @@ class PopulationState(struct.PyTreeNode):
         return (self.tape & jnp.uint8(0x80)) != 0
 
 
-def zeros_population(n: int, L: int, R: int) -> PopulationState:
+def zeros_population(n: int, L: int, R: int, n_global_res: int = 0,
+                     n_spatial_res: int = 0) -> PopulationState:
     i32 = partial(jnp.zeros, dtype=jnp.int32)
     f32 = partial(jnp.zeros, dtype=jnp.float32)
     return PopulationState(
@@ -264,6 +308,8 @@ def zeros_population(n: int, L: int, R: int) -> PopulationState:
         birth_update=jnp.full(n, -1, jnp.int32),
         insts_executed=i32(n),
         budget_carry=i32(n),
+        resources=f32(n_global_res),
+        res_grid=f32((n_spatial_res, n)),
     )
 
 
@@ -282,9 +328,14 @@ def init_population(params: WorldParams, seed_genome: np.ndarray,
     cPhenotype::SetupInject, cPhenotype.cc:599: merit = genome length,
     copied = executed = length)."""
     n, L, R = params.num_cells, params.max_memory, params.num_reactions
-    st = zeros_population(n, L, R)
+    st = zeros_population(n, L, R, params.num_global_res,
+                          params.num_spatial_res)
     k_inputs, key = jax.random.split(key)
-    st = st.replace(inputs=make_cell_inputs(k_inputs, n))
+    st = st.replace(inputs=make_cell_inputs(k_inputs, n),
+                    resources=jnp.asarray(params.res_initial, jnp.float32),
+                    res_grid=jnp.broadcast_to(
+                        jnp.asarray(params.sres_initial, jnp.float32)[:, None],
+                        (params.num_spatial_res, n)))
     if inject_cell is None:
         inject_cell = n // 2  # reference injects cell 0; center is equivalent on a torus
     g = np.zeros(L, np.int8)

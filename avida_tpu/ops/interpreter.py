@@ -370,12 +370,14 @@ def micro_step(params, st, key, exec_mask):
         env_tables = tasks_ops.env_tables_to_device(params)
         logic_id = tasks_ops.compute_logic_id(st.input_buf, st.input_buf_n, val)
         return tasks_ops.apply_reactions(
-            env_tables, io_m, logic_id, st.cur_bonus,
-            st.cur_task_count, st.cur_reaction_count)[:3]
+            params, env_tables, io_m, logic_id, st.cur_bonus,
+            st.cur_task_count, st.cur_reaction_count,
+            st.resources, st.res_grid)[:5]
 
-    new_bonus, new_tc, new_rc = jax.lax.cond(
+    new_bonus, new_tc, new_rc, resources, res_grid = jax.lax.cond(
         io_m.any(), io_block,
-        lambda _: (st.cur_bonus, st.cur_task_count, st.cur_reaction_count),
+        lambda _: (st.cur_bonus, st.cur_task_count, st.cur_reaction_count,
+                   st.resources, st.res_grid),
         None)
     input_ptr = jnp.where(io_m, st.input_ptr + 1, st.input_ptr)
     input_buf = jnp.where(io_m[:, None],
@@ -519,6 +521,7 @@ def micro_step(params, st, key, exec_mask):
         off_start=off_start, off_len=off_len,
         off_copied_size=jnp.where(div_m, copied_count, st.off_copied_size),
         insts_executed=insts_executed,
+        resources=resources, res_grid=res_grid,
     )
 
 

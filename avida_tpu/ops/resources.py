@@ -1,0 +1,186 @@
+"""Resource dynamics: global pools and spatial (per-cell) grids.
+
+TPU-native re-expression of the reference resource engine:
+ - global pools: cResourceCount (avida-core/source/main/cResourceCount.cc:207
+   Setup; decay/inflow integration at cc:35 with UPDATE_STEP=1/10000) becomes
+   a closed-form per-update step on a tiny f32 vector;
+ - spatial resources: cSpatialResCount (main/cSpatialResCount.cc; diffusion
+   `FlowAll` cc:316, sources/sinks cc:358-390) becomes one 3x3 convolution
+   per update over an [R, Y, X] grid -- the reference's cell-pair flow loop
+   is exactly a discrete Laplacian stencil, which is the single most
+   TPU-friendly operation there is;
+ - consumption: the reference serializes organisms, drawing each one's
+   demand down immediately (cEnvironment::DoProcesses cc:1610).  In lockstep
+   all same-cycle demands against a pool are summed and, when they exceed
+   the available level, every consumer is scaled proportionally (documented
+   deviation; spatial resources have one organism per cell, so their
+   consumption has no contention at all).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def step_global(params, resources):
+    """One update of inflow/outflow for global pools (closed form).
+
+    level' = level + inflow - outflow * level, the reference's net change
+    over one update (cResourceCount::DoUpdates integrates the same ODE in
+    1e-4 substeps; for stock rates the difference is <1e-3 per update).
+    """
+    if params.num_global_res == 0:
+        return resources
+    inflow = jnp.asarray(params.res_inflow, jnp.float32)
+    outflow = jnp.asarray(params.res_outflow, jnp.float32)
+    return jnp.maximum(resources + inflow - outflow * resources, 0.0)
+
+
+def step_spatial(params, res_grid):
+    """One update of a spatial resource: inflow box, outflow, diffusion.
+
+    res_grid: f32[R_s, N] with N = world_x * world_y (cell-indexed, matching
+    PopulationState).  Diffusion is a 3x3 stencil with per-resource X/Y
+    rates; toroidal worlds wrap (ref cSpatialResCount::FlowAll cc:316).
+    """
+    if params.num_spatial_res == 0:
+        return res_grid
+    R = params.num_spatial_res
+    X, Y = params.world_x, params.world_y
+    g = res_grid.reshape(R, Y, X)
+
+    inflow = jnp.asarray(params.sres_inflow, jnp.float32)      # [R]
+    outflow = jnp.asarray(params.sres_outflow, jnp.float32)    # [R]
+    xd = jnp.asarray(params.sres_xdiffuse, jnp.float32)        # [R]
+    yd = jnp.asarray(params.sres_ydiffuse, jnp.float32)        # [R]
+    torus = jnp.asarray(params.sres_torus, bool)               # [R]
+    box = np.asarray(params.sres_inflow_box, np.int32).reshape(R, 4)
+
+    # inflow into the configured box, divided among its cells (ref
+    # cSpatialResCount::Source cc:362-363 `amount /= totalcells`); a box of
+    # (-1,-1,-1,-1) means the whole world
+    xs = np.arange(X)[None, None, :]
+    ys = np.arange(Y)[None, :, None]
+    x1, x2, y1, y2 = box[:, 0], box[:, 1], box[:, 2], box[:, 3]
+    everywhere = (x1 < 0)[:, None, None]
+    in_box = (everywhere |
+              ((xs >= x1[:, None, None]) & (xs <= x2[:, None, None]) &
+               (ys >= y1[:, None, None]) & (ys <= y2[:, None, None])))
+    box_cells = np.maximum(in_box.sum(axis=(1, 2)), 1)
+    per_cell = inflow / jnp.asarray(box_cells, jnp.float32)
+    g = g + jnp.where(jnp.asarray(in_box), per_cell[:, None, None], 0.0)
+
+    # outflow (decay)
+    g = g * (1.0 - outflow)[:, None, None]
+
+    # diffusion: explicit 3x3 stencil.  Per-axis coefficients are clamped to
+    # the explicit-scheme stability bound (cx + cy <= 1/2) so any
+    # xdiffuse/ydiffuse in [0, 1] -- including the reference default 1.0 --
+    # diffuses instead of exploding; mass is conserved by construction.
+    # Per-resource geometry: torus resources wrap, grid resources have
+    # zero-flux edges (ref cSpatialResCount geometry handling).
+    def neighbors(gg, wrap):
+        if wrap:
+            return (jnp.roll(gg, 1, axis=2), jnp.roll(gg, -1, axis=2),
+                    jnp.roll(gg, 1, axis=1), jnp.roll(gg, -1, axis=1))
+        return (jnp.concatenate([gg[:, :, :1], gg[:, :, :-1]], axis=2),
+                jnp.concatenate([gg[:, :, 1:], gg[:, :, -1:]], axis=2),
+                jnp.concatenate([gg[:, :1, :], gg[:, :-1, :]], axis=1),
+                jnp.concatenate([gg[:, 1:, :], gg[:, -1:, :]], axis=1))
+
+    lt, rt, ut, dt = neighbors(g, True)
+    lb, rb, ub, db = neighbors(g, False)
+    w = torus[:, None, None]
+    left = jnp.where(w, lt, lb)
+    right = jnp.where(w, rt, rb)
+    up = jnp.where(w, ut, ub)
+    down = jnp.where(w, dt, db)
+    cx = jnp.clip(0.5 * xd, 0.0, 0.25)[:, None, None]
+    cy = jnp.clip(0.5 * yd, 0.0, 0.25)[:, None, None]
+    g = g + cx * (left + right - 2.0 * g) + cy * (up + down - 2.0 * g)
+
+    return jnp.maximum(g, 0.0).reshape(R, Y * X)
+
+
+def consume(params, env_tables, rewarded, task_quality, resources, res_grid):
+    """Resource draw-down for this cycle's rewarded reactions.
+
+    rewarded: bool[N, NR] -- reaction fired for organism n this cycle.
+    Returns (amount[N, NR] consumed units feeding the bonus math,
+             new_resources[Rg], new_res_grid[Rs, N]).
+
+    Mirrors cEnvironment::DoProcesses (cc:1610): each process consumes
+    min(level * max_fraction, max_number) of its bound resource (times task
+    quality); infinite-resource processes use max_number outright.  Same-
+    cycle demands on one global pool are scaled proportionally when they
+    exceed the level (lockstep semantic; see module docstring).
+    """
+    res_idx = env_tables["proc_res_idx"]          # i32[NR] (-1 infinite)
+    spatial = env_tables["proc_res_spatial"]      # bool[NR]
+    max_num = env_tables["proc_max"]              # f32[NR]
+    frac = env_tables["proc_frac"]                # f32[NR]
+    depletable = env_tables["proc_depletable"]    # bool[NR]
+
+    rw = rewarded.astype(jnp.float32) * task_quality
+    infinite = res_idx < 0
+
+    # available level per (org, reaction)
+    gidx = jnp.clip(res_idx, 0, max(params.num_global_res - 1, 0))
+    sidx = jnp.clip(res_idx, 0, max(params.num_spatial_res - 1, 0))
+    if params.num_global_res:
+        avail_g = resources[gidx][None, :]                       # [1, NR]
+    else:
+        avail_g = jnp.zeros((1, res_idx.shape[0]), jnp.float32)
+    if params.num_spatial_res:
+        avail_s = res_grid[sidx, :].T                            # [N, NR]
+    else:
+        avail_s = jnp.zeros((1, res_idx.shape[0]), jnp.float32)
+    avail = jnp.where(infinite[None, :], jnp.inf,
+                      jnp.where(spatial[None, :], avail_s, avail_g))
+
+    wanted = jnp.minimum(avail * frac[None, :], max_num[None, :]) * rw
+    wanted = jnp.where(infinite[None, :], max_num[None, :] * rw, wanted)
+
+    # ---- global pools: proportional scaling under contention.  Only
+    # depletable processes draw the pool down, so only they contend; a
+    # non-depletable process reads min(level*frac, max) without scaling
+    # (ref cReactionProcess depletable semantics) ----
+    if params.num_global_res:
+        is_g = (~infinite & ~spatial)[None, :]
+        want_g = jnp.where(is_g, wanted, 0.0)
+        onehot = (jnp.arange(params.num_global_res)[:, None]
+                  == res_idx[None, :])                           # [Rg, NR]
+        want_depl = jnp.where(depletable[None, :], want_g, 0.0)
+        demand = jnp.einsum("nr,gr->g", want_depl, onehot.astype(jnp.float32))
+        scale_res = jnp.where(demand > resources,
+                              resources / jnp.maximum(demand, 1e-30), 1.0)
+        scale_rxn = jnp.einsum("g,gr->r", scale_res,
+                               onehot.astype(jnp.float32))
+        scale_rxn = jnp.where(infinite | spatial | ~depletable, 1.0, scale_rxn)
+        got_g = want_g * scale_rxn[None, :]
+        drawn = jnp.einsum("nr,gr->g",
+                           jnp.where(is_g & depletable[None, :], got_g, 0.0),
+                           onehot.astype(jnp.float32))
+        resources = jnp.maximum(resources - drawn, 0.0)
+    else:
+        got_g = jnp.zeros_like(wanted)
+        scale_rxn = jnp.ones(res_idx.shape[0], jnp.float32)
+
+    # ---- spatial: one organism per cell, no contention ----
+    if params.num_spatial_res:
+        is_s = (~infinite & spatial)[None, :]
+        got_s = jnp.where(is_s, wanted, 0.0)                     # [N, NR]
+        onehot_s = (jnp.arange(params.num_spatial_res)[:, None]
+                    == res_idx[None, :])                         # [Rs, NR]
+        drawn_s = jnp.einsum("nr,sr->sn",
+                             jnp.where(is_s & depletable[None, :], got_s, 0.0),
+                             onehot_s.astype(jnp.float32))
+        res_grid = jnp.maximum(res_grid - drawn_s, 0.0)
+    else:
+        got_s = jnp.zeros_like(wanted)
+
+    amount = jnp.where(infinite[None, :], wanted,
+                       jnp.where(spatial[None, :], got_s, got_g))
+    return amount, resources, res_grid

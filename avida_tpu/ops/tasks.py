@@ -59,19 +59,23 @@ def compute_logic_id(input_buf, input_buf_n, output):
     return jnp.where(func_ok, logic, -1)
 
 
-def apply_reactions(env_tables, io_mask, logic_id, cur_bonus,
-                    cur_task_count, cur_reaction_count):
+def apply_reactions(params, env_tables, io_mask, logic_id, cur_bonus,
+                    cur_task_count, cur_reaction_count, resources, res_grid):
     """Trigger reactions for organisms performing IO this step.
 
     env_tables: dict of jnp arrays built from Environment.device_tables().
-    Returns (new_bonus, new_task_count, new_reaction_count, any_reward[N]).
+    Returns (new_bonus, new_task_count, new_reaction_count,
+             new_resources, new_res_grid, any_reward[N]).
 
     Mirrors cEnvironment::TestOutput's reaction loop (cEnvironment.cc:1332-
     1404): each reaction fires if its task's logic-id set contains logic_id
-    and its requisite windows pass; rewards apply pow/add/mult to the bonus
-    (cc:1743-1758).  Stock logic-9 uses requisite max_count=1 so only the
-    first performance per gestation is rewarded.
+    and its requisite windows pass; rewards consume bound resources
+    (ops/resources.py) and apply pow/add/mult of value x consumed-amount to
+    the bonus (DoProcesses cc:1731-1758).  Stock logic-9 uses requisite
+    max_count=1 so only the first performance per gestation is rewarded.
     """
+    from avida_tpu.ops import resources as res_ops
+
     mask = env_tables["task_logic_mask"]          # bool[R,256]
     value = env_tables["proc_value"]              # f[R]
     ptype = env_tables["proc_type"]               # i[R]
@@ -93,18 +97,25 @@ def apply_reactions(env_tables, io_mask, logic_id, cur_bonus,
 
     rewarded = performed & in_window & req_ok & noreq_ok
 
-    fval = value[None, :].astype(cur_bonus.dtype)
+    # resource consumption -> per-(org, reaction) amounts (1.0 if infinite)
+    amount, resources, res_grid = res_ops.consume(
+        params, env_tables, rewarded, 1.0, resources, res_grid)
+
+    fdt = cur_bonus.dtype
+    fval = value[None, :].astype(fdt)
+    va = fval * amount.astype(fdt)                # value x consumed units
     pow_mult = jnp.where(rewarded & (ptype[None, :] == PROCTYPE_POW),
-                         jnp.exp2(fval), 1.0).prod(axis=1)
-    mult_mult = jnp.where(rewarded & (ptype[None, :] == PROCTYPE_MULT),
-                          fval, 1.0).prod(axis=1)
+                         jnp.exp2(va), 1.0).prod(axis=1)
+    mult_mult = jnp.where(rewarded & (ptype[None, :] == PROCTYPE_MULT) &
+                          (va != 0), va, 1.0).prod(axis=1)
     add_sum = jnp.where(rewarded & (ptype[None, :] == PROCTYPE_ADD),
-                        fval, 0.0).sum(axis=1)
+                        va, 0.0).sum(axis=1)
 
     new_bonus = cur_bonus * pow_mult * mult_mult + add_sum
     new_task_count = cur_task_count + performed.astype(jnp.int32)
     new_reaction_count = cur_reaction_count + rewarded.astype(jnp.int32)
-    return new_bonus, new_task_count, new_reaction_count, rewarded.any(axis=1)
+    return (new_bonus, new_task_count, new_reaction_count,
+            resources, res_grid, rewarded.any(axis=1))
 
 
 def env_tables_to_device(params):
@@ -117,4 +128,9 @@ def env_tables_to_device(params):
         "min_task_count": jnp.asarray(params.min_task_count, jnp.int32),
         "req_reaction_mask": jnp.asarray(params.req_reaction_mask, bool),
         "noreq_reaction_mask": jnp.asarray(params.noreq_reaction_mask, bool),
+        "proc_res_idx": jnp.asarray(params.proc_res_idx, jnp.int32),
+        "proc_res_spatial": jnp.asarray(params.proc_res_spatial, bool),
+        "proc_max": jnp.asarray(params.proc_max, jnp.float32),
+        "proc_frac": jnp.asarray(params.proc_frac, jnp.float32),
+        "proc_depletable": jnp.asarray(params.proc_depletable, bool),
     }

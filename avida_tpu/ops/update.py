@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from avida_tpu.ops import birth as birth_ops
+from avida_tpu.ops import resources as res_ops
 from avida_tpu.ops import scheduler as sched_ops
 from avida_tpu.ops.interpreter import micro_step
 
@@ -30,6 +31,10 @@ from avida_tpu.ops.interpreter import micro_step
 def update_step(params, st, key, neighbors, update_no):
     """Run one update.  Returns (new_state, executed_this_update)."""
     k_budget, k_steps, k_birth = jax.random.split(key, 3)
+
+    # resource dynamics integrate once per update (ops/resources.py)
+    st = st.replace(resources=res_ops.step_global(params, st.resources),
+                    res_grid=res_ops.step_spatial(params, st.res_grid))
 
     budgets = sched_ops.compute_budgets(params, st, k_budget)
     # Budget carry-over (TPU lockstep semantic, SURVEY §7 step 3): the

@@ -42,14 +42,26 @@ def population_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(CELL_AXIS))
 
 
+# PopulationState fields whose cell axis is NOT dim 0 (see core/state.py):
+# the spatial resource grid is [R_s, N], global pools have no cell axis.
+_FIELD_SPECS = {"res_grid": P(None, CELL_AXIS), "resources": P()}
+
+
 def shard_population(st, mesh: Mesh):
     """Place every PopulationState array with its cell axis partitioned.
 
-    Requires num_cells % mesh.size == 0 (choose WORLD_Y divisible by the
-    device count; the driver-facing helpers below do this).
+    Per-organism arrays carry the cell axis as dim 0; the exceptions are
+    named in _FIELD_SPECS (resource state).  Requires num_cells % mesh.size
+    == 0 (choose WORLD_Y divisible by the device count; the driver-facing
+    helpers below do this).
     """
-    sh = population_sharding(mesh)
-    return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), st)
+    fields = {name: getattr(st, name) for name in st.__dataclass_fields__}
+    placed = {
+        name: jax.device_put(
+            a, NamedSharding(mesh, _FIELD_SPECS.get(name, P(CELL_AXIS))))
+        for name, a in fields.items()
+    }
+    return st.replace(**placed)
 
 
 def shard_neighbors(neighbors, mesh: Mesh):

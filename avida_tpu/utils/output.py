@@ -91,6 +91,14 @@ def open_dominant_dat(data_dir: str) -> DatFile:
          "Name of the Dominant Genotype"])
 
 
+def open_resource_dat(data_dir: str, resource_names: list) -> DatFile:
+    return DatFile(
+        os.path.join(data_dir, "resource.dat"), "Avida resource data",
+        ["Update", "Avida time"] + [f"{n} resource" for n in resource_names],
+        preamble=["First columns give the current update and time, next columns give",
+                  "the quantity of the particular resource"])
+
+
 def open_time_dat(data_dir: str) -> DatFile:
     return DatFile(
         os.path.join(data_dir, "time.dat"), "Avida time data",

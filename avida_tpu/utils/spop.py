@@ -97,9 +97,14 @@ def restore_population(params, orgs, key, neighbors=None):
     from avida_tpu.ops.interpreter import micro_step
 
     n, L, R = params.num_cells, params.max_memory, params.num_reactions
-    st = zeros_population(n, L, R)
+    st = zeros_population(n, L, R, params.num_global_res, params.num_spatial_res)
     k_in, key = jax.random.split(key)
-    st = st.replace(inputs=make_cell_inputs(k_in, n))
+    st = st.replace(
+        inputs=make_cell_inputs(k_in, n),
+        resources=jnp.asarray(params.res_initial, jnp.float32),
+        res_grid=jnp.broadcast_to(
+            jnp.asarray(params.sres_initial, jnp.float32)[:, None],
+            (params.num_spatial_res, n)))
 
     mem = np.zeros((n, L), np.int8)
     mem_len = np.zeros(n, np.int32)

@@ -128,8 +128,16 @@ class World:
         else:
             fresh = init_population(self.params, genome, k, inject_cell=cell)
             c = cell
-            self.state = jax.tree_util.tree_map(
-                lambda cur, new: cur.at[c].set(new[c]), self.state, fresh)
+            # overwrite only per-organism arrays (cell axis = dim 0);
+            # world-level resource state is untouched by an Inject
+            world_fields = {"resources", "res_grid"}
+            updates = {
+                name: getattr(self.state, name).at[c].set(
+                    getattr(fresh, name)[c])
+                for name in self.state.__dataclass_fields__
+                if name not in world_fields
+            }
+            self.state = self.state.replace(**updates)
         if self.systematics is not None:
             self.systematics.classify_seed(cell, genome, update=self.update)
 
@@ -211,6 +219,34 @@ class World:
         self._time_prev = int(s["total_insts"])
         f.write_row([self.update, self._avida_time,
                      float(s["ave_generation"]), insts])
+
+    def _action_PrintResourceData(self, args):
+        names = ([r.name for r in self.environment.global_resources()]
+                 + [r.name for r in self.environment.spatial_resources()])
+        if not names:
+            return
+        f = self._file("resource", output_mod.open_resource_dat, names)
+        levels = [float(x) for x in np.asarray(self.state.resources)]
+        if self.params.num_spatial_res:
+            levels += [float(x) for x in
+                       np.asarray(self.state.res_grid).sum(axis=1)]
+        f.write_row([self.update, self._avida_time] + levels)
+
+    def _action_SetResource(self, args):
+        """SetResource <name> <level> (ref EnvironmentActions.cc)."""
+        name, level = args[0], float(args[1])
+        for i, r in enumerate(self.environment.global_resources()):
+            if r.name == name:
+                self.state = self.state.replace(
+                    resources=self.state.resources.at[i].set(level))
+                return
+        for i, r in enumerate(self.environment.spatial_resources()):
+            if r.name == name:
+                n = self.params.num_cells
+                self.state = self.state.replace(
+                    res_grid=self.state.res_grid.at[i].set(
+                        jnp.full(n, level / n, jnp.float32)))
+                return
 
     def _action_SavePopulation(self, args):
         from avida_tpu.utils import spop

@@ -42,7 +42,8 @@ def build(world_x, world_y, max_memory, seed):
     # "InjectAll", PopulationActions.cc) so throughput is measured at full
     # population from update 0.
     n, L, R = w.params.num_cells, w.params.max_memory, w.params.num_reactions
-    st = zeros_population(n, L, R)
+    st = zeros_population(n, L, R, w.params.num_global_res,
+                          w.params.num_spatial_res)
     key = jax.random.key(seed)
     k_in, key = jax.random.split(key)
     g = np.zeros(L, np.int8)
