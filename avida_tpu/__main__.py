@@ -1,0 +1,67 @@
+"""Command-line driver: the `avida` executable equivalent.
+
+Mirrors the reference CLI (targets/avida/primitive.cc:36 main;
+Avida::Util::ProcessCmdLineArgs, source/util/CmdLine.cc:205):
+
+  python -m avida_tpu [-c <dir>] [-s <seed>] [-set NAME VALUE]...
+                      [-d <data_dir>] [-u <max_updates>] [-a] [-v]
+
+  -c DIR     config directory (avida.cfg / environment.cfg / events.cfg /
+             instruction set / .org files); defaults built in when absent
+  -s SEED    random seed override (RANDOM_SEED)
+  -set N V   any config variable override (repeatable)
+  -d DIR     data output directory
+  -u N       stop after N updates (overrides events-driven exit)
+  -a         analyze mode: run ANALYZE_FILE (analyze.cfg) through the
+             batch VM instead of an evolution run (ANALYZE_MODE=1)
+  -v         verbose
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="avida_tpu", add_help=True)
+    p.add_argument("-c", "--config-dir", default=None)
+    p.add_argument("-s", "--seed", type=int, default=None)
+    p.add_argument("-set", dest="overrides", nargs=2, action="append",
+                   default=[], metavar=("NAME", "VALUE"))
+    p.add_argument("-d", "--data-dir", default=None)
+    p.add_argument("-u", "--updates", type=int, default=None)
+    p.add_argument("-a", "--analyze", action="store_true")
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+
+    overrides = list(map(tuple, args.overrides))
+    if args.seed is not None:
+        overrides.append(("RANDOM_SEED", args.seed))
+
+    from avida_tpu.world import World
+    world = World(config_dir=args.config_dir, overrides=overrides,
+                  data_dir=args.data_dir)
+
+    if args.analyze:
+        from avida_tpu.analyze.analyzer import Analyzer
+        az = Analyzer(world.params, world.instset,
+                      data_dir=world.data_dir, verbose=args.verbose)
+        path = (os.path.join(args.config_dir, world.cfg.ANALYZE_FILE)
+                if args.config_dir else world.cfg.ANALYZE_FILE)
+        az.run_file(path)
+        return 0
+
+    t0 = time.time()
+    world.run(max_updates=args.updates)
+    dt = time.time() - t0
+    if args.verbose:
+        print(f"{world.update} updates, {world.num_organisms} organisms, "
+              f"{dt:.1f}s", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1 @@
+from avida_tpu.analyze.testcpu import evaluate_genomes, TestResult  # noqa: F401

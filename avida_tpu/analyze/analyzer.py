@@ -1,0 +1,293 @@
+"""Analyze mode: the offline genotype post-processing VM.
+
+TPU-native equivalent of cAnalyze (avida-core/source/analyze/cAnalyze.cc —
+101 commands registered at cc:11205-11330, batch model cGenotypeBatch,
+threaded job queue cAnalyzeJobQueue).  The reference evaluates genotypes one
+at a time on worker threads; here every batch operation that needs fitness
+data feeds the WHOLE batch through the lockstep Test CPU at once
+(analyze/testcpu.py), so "parallel analyze jobs" become one device program.
+
+Supported commands (the working core of the reference set; the registry
+pattern makes additions one-liners):
+  LOAD <file.spop>          load genotypes into the current batch
+  LOAD_SEQUENCE <seq>       load one genome from its letter sequence
+  SET_BATCH <i> / DUPLICATE <from> [<to>] / PURGE_BATCH [<i>]
+  RECALCULATE               run the batch through the Test CPU
+  FILTER <field> <op> <value>   keep genotypes matching (e.g. fitness > 0)
+  FIND_GENOTYPE [num_cpus|total_cpus|fitness]   keep the best genotype
+  DETAIL <file> [fields...] write a genotype table (.dat format)
+  TRACE [dir]               per-cycle hardware trace of each genotype
+  LANDSCAPE [file]          one-step mutational landscape of the batch
+  ANALYZE_KNOCKOUTS [file]  per-site knockout viability/fitness
+  VERBOSE / SYSTEM <cmd>    utility commands
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+
+import numpy as np
+
+from avida_tpu.analyze.testcpu import evaluate_genomes
+from avida_tpu.utils.output import DatFile
+from avida_tpu.utils import spop as spop_mod
+
+
+class AnalyzeGenotype:
+    """Batch entry (ref cAnalyzeGenotype)."""
+
+    def __init__(self, sequence, gid=0, name="", num_cpus=1, total_cpus=1):
+        self.sequence = np.asarray(sequence, np.int8)
+        self.id = gid
+        self.name = name or f"org-{gid}"
+        self.num_cpus = num_cpus          # live organism count at save
+        self.total_cpus = total_cpus
+        # filled by RECALCULATE
+        self.viable = None
+        self.fitness = 0.0
+        self.merit = 0.0
+        self.gestation_time = 0
+        self.copied_size = 0
+        self.executed_size = 0
+        self.task_counts = None
+
+    @property
+    def length(self):
+        return len(self.sequence)
+
+
+class Analyzer:
+    """Interpret an analyze.cfg program (ref cAnalyze::RunFile)."""
+
+    def __init__(self, params, instset, data_dir="data", verbose=False):
+        self.params = params
+        self.instset = instset
+        self.data_dir = data_dir
+        self.batches: dict[int, list[AnalyzeGenotype]] = {}
+        self.current = 0
+        self.verbose = verbose
+        self._next_id = 1
+
+    @property
+    def batch(self) -> list[AnalyzeGenotype]:
+        return self.batches.setdefault(self.current, [])
+
+    # ---- program driver -------------------------------------------------
+
+    def run_file(self, path: str):
+        with open(path) as f:
+            self.run_lines(f.read().splitlines())
+
+    def run_lines(self, lines):
+        for raw in lines:
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            self.run_command(line)
+
+    def run_command(self, line: str):
+        tokens = shlex.split(line)
+        cmd, args = tokens[0].upper(), tokens[1:]
+        handler = getattr(self, f"_cmd_{cmd}", None)
+        if handler is None:
+            raise ValueError(f"unknown analyze command {cmd!r}")
+        if self.verbose:
+            print(f"analyze: {line}")
+        return handler(args)
+
+    # ---- batch management ----------------------------------------------
+
+    def _cmd_SET_BATCH(self, args):
+        self.current = int(args[0])
+
+    def _cmd_DUPLICATE(self, args):
+        src = int(args[0])
+        dst = int(args[1]) if len(args) > 1 else self.current
+        self.batches.setdefault(dst, []).extend(
+            AnalyzeGenotype(g.sequence.copy(), self._take_id(), g.name,
+                            g.num_cpus, g.total_cpus)
+            for g in self.batches.get(src, []))
+
+    def _cmd_PURGE_BATCH(self, args):
+        idx = int(args[0]) if args else self.current
+        self.batches[idx] = []
+
+    def _take_id(self):
+        i = self._next_id
+        self._next_id += 1
+        return i
+
+    # ---- loading --------------------------------------------------------
+
+    def _cmd_LOAD(self, args):
+        orgs = spop_mod.load_population(args[0], self.params, None)
+        seen = {}
+        for o in orgs:
+            key = o["genome"].tobytes()
+            if key in seen:
+                seen[key].num_cpus += 1
+                seen[key].total_cpus += 1
+            else:
+                g = AnalyzeGenotype(o["genome"], self._take_id())
+                seen[key] = g
+                self.batch.append(g)
+
+    def _cmd_LOAD_SEQUENCE(self, args):
+        seq = spop_mod._string_to_seq(args[0])
+        self.batch.append(AnalyzeGenotype(seq, self._take_id()))
+
+    # ---- evaluation ------------------------------------------------------
+
+    def _padded(self, genotypes):
+        L = self.params.max_memory
+        G = len(genotypes)
+        buf = np.zeros((G, L), np.int8)
+        lens = np.zeros(G, np.int32)
+        for i, g in enumerate(genotypes):
+            n = min(g.length, L)
+            buf[i, :n] = g.sequence[:n]
+            lens[i] = n
+        return buf, lens
+
+    def _cmd_RECALCULATE(self, args):
+        if not self.batch:
+            return
+        buf, lens = self._padded(self.batch)
+        r = evaluate_genomes(self.params, buf, lens)
+        for i, g in enumerate(self.batch):
+            g.viable = bool(r.viable[i])
+            g.fitness = float(r.fitness[i])
+            g.merit = float(r.merit[i])
+            g.gestation_time = int(r.gestation_time[i])
+            g.copied_size = int(r.copied_size[i])
+            g.executed_size = int(r.executed_size[i])
+            g.task_counts = np.asarray(r.task_counts[i])
+
+    # ---- filtering -------------------------------------------------------
+
+    _OPS = {"==": lambda a, b: a == b, "!=": lambda a, b: a != b,
+            ">": lambda a, b: a > b, "<": lambda a, b: a < b,
+            ">=": lambda a, b: a >= b, "<=": lambda a, b: a <= b}
+
+    def _cmd_FILTER(self, args):
+        field, op, value = args[0], args[1], float(args[2])
+        f = self._OPS[op]
+        self.batches[self.current] = [
+            g for g in self.batch if f(float(getattr(g, field)), value)]
+
+    def _cmd_FIND_GENOTYPE(self, args):
+        if not self.batch:
+            return
+        crit = args[0] if args else "num_cpus"
+        best = max(self.batch, key=lambda g: getattr(g, crit))
+        self.batches[self.current] = [best]
+
+    # ---- output ----------------------------------------------------------
+
+    _DETAIL_DEFAULT = ["id", "fitness", "merit", "gestation_time", "length"]
+
+    def _cmd_DETAIL(self, args):
+        fname = args[0] if args else "detail.dat"
+        fields = args[1:] if len(args) > 1 else self._DETAIL_DEFAULT
+        f = DatFile(os.path.join(self.data_dir, fname),
+                    "Avida analyze details", fields)
+        for g in self.batch:
+            row = []
+            for fd in fields:
+                if fd == "sequence":
+                    row.append(spop_mod._seq_to_string(g.sequence))
+                elif fd == "viable":
+                    row.append(int(bool(g.viable)))
+                else:
+                    row.append(getattr(g, fd))
+            f.write_row(row)
+        f.close()
+
+    def _cmd_TRACE(self, args):
+        from avida_tpu.analyze.trace import trace_genome
+        outdir = os.path.join(self.data_dir, args[0] if args else "trace")
+        os.makedirs(outdir, exist_ok=True)
+        for g in self.batch:
+            path = os.path.join(outdir, f"org-{g.id}.trace")
+            trace_genome(self.params, self.instset, g.sequence, path)
+
+    # ---- genetics --------------------------------------------------------
+
+    def _cmd_LANDSCAPE(self, args):
+        """One-step mutational landscape of each batch genotype
+        (ref cLandscape::Process, main/cLandscape.cc)."""
+        fname = args[0] if args else "landscape.dat"
+        f = DatFile(os.path.join(self.data_dir, fname), "Mutational landscape",
+                    ["genotype id", "base fitness", "num mutants",
+                     "frac lethal", "frac detrimental", "frac neutral",
+                     "frac beneficial", "average fitness",
+                     "max mutant fitness"])
+        ni = self.params.num_insts
+        for g in self.batch:
+            base = self._recalc_one(g)
+            L = g.length
+            muts = []
+            for site in range(L):
+                for op in range(ni):
+                    if op == g.sequence[site]:
+                        continue
+                    m = g.sequence.copy()
+                    m[site] = op
+                    muts.append(m)
+            buf, lens = self._padded(
+                [AnalyzeGenotype(m) for m in muts])
+            r = evaluate_genomes(self.params, buf, lens)
+            fit = np.where(r.viable, r.fitness, 0.0)
+            base_f = max(base, 1e-30)
+            rel = fit / base_f
+            f.write_row([
+                g.id, base, len(muts),
+                float((fit <= 0).mean()),
+                float(((fit > 0) & (rel < 0.95)).mean()),
+                float(((rel >= 0.95) & (rel <= 1.05)).mean()),
+                float((rel > 1.05).mean()),
+                float(fit.mean()), float(fit.max())])
+        f.close()
+
+    def _cmd_ANALYZE_KNOCKOUTS(self, args):
+        """Replace each site with the null instruction and test viability
+        (ref cAnalyze KNOCKOUT machinery)."""
+        fname = args[0] if args else "knockouts.dat"
+        f = DatFile(os.path.join(self.data_dir, fname), "Knockout analysis",
+                    ["genotype id", "length", "num lethal", "num detrimental",
+                     "num neutral", "num beneficial"])
+        nop = 0  # op 0 (nop-A) is the neutral filler instruction
+        for g in self.batch:
+            base = self._recalc_one(g)
+            kos = []
+            for site in range(g.length):
+                m = g.sequence.copy()
+                m[site] = nop
+                kos.append(AnalyzeGenotype(m))
+            buf, lens = self._padded(kos)
+            r = evaluate_genomes(self.params, buf, lens)
+            fit = np.where(r.viable, r.fitness, 0.0)
+            base_f = max(base, 1e-30)
+            rel = fit / base_f
+            f.write_row([
+                g.id, g.length, int((fit <= 0).sum()),
+                int(((fit > 0) & (rel < 0.95)).sum()),
+                int(((rel >= 0.95) & (rel <= 1.05)).sum()),
+                int((rel > 1.05).sum())])
+        f.close()
+
+    def _recalc_one(self, g) -> float:
+        buf, lens = self._padded([g])
+        r = evaluate_genomes(self.params, buf, lens)
+        g.fitness = float(r.fitness[0])
+        g.viable = bool(r.viable[0])
+        return g.fitness if g.viable else 0.0
+
+    # ---- misc ------------------------------------------------------------
+
+    def _cmd_VERBOSE(self, args):
+        self.verbose = not args or args[0] not in ("0", "off")
+
+    def _cmd_SYSTEM(self, args):
+        os.system(" ".join(args))

@@ -1,0 +1,181 @@
+"""Sandboxed genotype evaluation: the batched Test CPU.
+
+TPU-native equivalent of cTestCPU (avida-core/source/cpu/cTestCPU.cc:
+TestGenome :190, ProcessGestation :144) + its fake world interface
+(cpu/cTestCPUInterface.cc).  The reference evaluates one genotype at a time
+in a sandboxed CPU, running up to TEST_CPU_TIME_MOD x length cycles until
+the organism divides, then recursing into the offspring for up to
+nHardware::TEST_CPU_GENERATIONS (3) generations to find the true (fixed
+point) replication behavior.
+
+Here the whole genotype batch is ONE lockstep population: each genome gets a
+lane, micro-steps run until every lane divided or timed out, and the
+generation recursion is a host-side loop over at most 3 batched runs (each
+next round only re-runs lanes whose offspring differed from the parent).
+This is the oracle behind analyze-mode RECALCULATE, dominant fitness
+reporting, reversion/sterilization tests and mutational landscapes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from avida_tpu.core.state import make_cell_inputs, zeros_population
+from avida_tpu.ops.interpreter import extract_offspring, micro_step
+
+TEST_CPU_GENERATIONS = 3   # ref nHardware::TEST_CPU_GENERATIONS
+
+
+@dataclass
+class TestResult:
+    """Per-genotype metrics (ref cCPUTestInfo accessors)."""
+    viable: np.ndarray          # bool[G]  divided with a self-replicating line
+    gestation_time: np.ndarray  # int32[G] cycles to (final-generation) divide
+    merit: np.ndarray           # f32[G]
+    fitness: np.ndarray         # f32[G]   merit / gestation
+    task_counts: np.ndarray     # int32[G, R] tasks at divide
+    copied_size: np.ndarray     # int32[G]
+    executed_size: np.ndarray   # int32[G]
+    offspring_genome: np.ndarray  # int8[G, L]
+    offspring_len: np.ndarray   # int32[G]
+    generations: np.ndarray     # int32[G] generations to reach a fixed point
+
+
+def _sandbox_state(params, genomes, lens, key):
+    g = genomes.shape[0]
+    st = zeros_population(g, params.max_memory, params.num_reactions,
+                          params.num_global_res, params.num_spatial_res)
+    k_in, _ = jax.random.split(key)
+    st = st.replace(
+        inputs=make_cell_inputs(k_in, g),
+        tape=genomes.astype(jnp.uint8),
+        genome=genomes.astype(jnp.int8),
+        mem_len=lens, genome_len=lens,
+        alive=lens > 0,
+        merit=lens.astype(jnp.float32),
+        cur_bonus=jnp.full(g, params.default_bonus, jnp.float32),
+        executed_size=lens, copied_size=lens,
+        max_executed=jnp.full(g, 2**30, jnp.int32),  # no aging in the sandbox
+        resources=jnp.asarray(params.res_initial, jnp.float32),
+        res_grid=jnp.broadcast_to(
+            jnp.asarray(params.sres_initial, jnp.float32)[:, None],
+            (params.num_spatial_res, g)),
+    )
+    return st
+
+
+@partial(jax.jit, static_argnums=(0, 3))
+def _run_gestation(params, genomes, lens, time_mod, key):
+    """Run every lane until divide or time_mod * len cycles (one generation).
+
+    Returns (state-after, divided[G], gestation[G], offspring[G, L],
+    off_len[G]).  Mirrors cTestCPU::ProcessGestation (cTestCPU.cc:144).
+    """
+    st = _sandbox_state(params, genomes, lens, key)
+    budget = time_mod * jnp.maximum(lens, 1)
+    max_t = budget.max()
+
+    def cond(c):
+        t, st = c
+        active = st.alive & ~st.divide_pending & (t < budget)
+        return active.any() & (t < max_t)
+
+    def body(c):
+        t, st = c
+        mask = st.alive & ~st.divide_pending & (t < budget)
+        st = micro_step(params, st, jax.random.fold_in(key, t), mask)
+        return t + 1, st
+
+    _, st = jax.lax.while_loop(cond, body, (jnp.int32(0), st))
+    off, off_len = extract_offspring(params, st, jax.random.fold_in(key, 0x7FFFFFFF))
+    return st, st.divide_pending, st.gestation_time, off, off_len
+
+
+def evaluate_genomes(params, genomes, lens=None, seed: int = 0,
+                 time_mod: int = 20) -> TestResult:
+    """Evaluate a batch of genotypes in the sandbox (host-facing API).
+
+    genomes: int array [G, L] (padded with anything beyond lens).
+    time_mod: TEST_CPU_TIME_MOD (cAvidaConfig; default 20).
+    """
+    genomes = jnp.asarray(genomes)
+    G, L = genomes.shape
+    assert L == params.max_memory, (
+        f"genome buffer width {L} != params.max_memory {params.max_memory}")
+    # the sandbox evaluates the genotype itself: all mutation machinery off
+    # (ref cTestCPU runs with its own context; analyze RECALCULATE expects
+    # deterministic per-genotype metrics)
+    params = params.replace(copy_mut_prob=0.0, divide_mut_prob=0.0,
+                            divide_ins_prob=0.0, divide_del_prob=0.0,
+                            point_mut_prob=0.0)
+    if lens is None:
+        lens = (genomes != 0).cumsum(axis=1).argmax(axis=1) + 1
+    lens = jnp.asarray(lens, jnp.int32)
+    key = jax.random.key(seed)
+
+    cur_g, cur_len = genomes, lens
+    done = np.zeros(G, bool)
+    generations = np.zeros(G, np.int32)
+    out = {}
+    for gen in range(TEST_CPU_GENERATIONS):
+        st, divided, gest, off, off_len = _run_gestation(
+            params, cur_g, cur_len, time_mod, jax.random.fold_in(key, gen))
+        divided_np = np.asarray(divided)
+        if gen == 0:
+            out = {
+                "divided": divided_np.copy(),
+                "gestation": np.asarray(gest).copy(),
+                "merit": np.asarray(st.merit).copy(),
+                "fitness": np.asarray(st.fitness).copy(),
+                "tasks": np.asarray(st.last_task_count).copy(),
+                "copied": np.asarray(st.child_copied_size).copy(),
+                "executed": np.asarray(st.executed_size).copy(),
+                "off": np.asarray(off).copy(),
+                "off_len": np.asarray(off_len).copy(),
+            }
+        else:
+            redo = ~done
+            for name, val in (("divided", divided_np), ("gestation", gest),
+                              ("merit", st.merit), ("fitness", st.fitness),
+                              ("tasks", st.last_task_count),
+                              ("copied", st.child_copied_size),
+                              ("executed", st.executed_size),
+                              ("off", off), ("off_len", off_len)):
+                out[name][redo] = np.asarray(val)[redo]
+            generations[redo] += 1
+        # a lane is settled when it failed to divide or bred true
+        # (offspring == input genome): ref cTestCPU generation recursion
+        off_np = np.asarray(off)
+        off_len_np = np.asarray(off_len)
+        cur_np = np.asarray(cur_g)
+        len_np = np.asarray(cur_len)
+        same = (off_len_np == len_np)
+        L_idx = np.arange(L)
+        valid = L_idx[None, :] < np.minimum(off_len_np, len_np)[:, None]
+        same &= ~np.any((off_np != cur_np) & valid, axis=1)
+        done |= (~divided_np) | same
+        if done.all():
+            break
+        # next generation: run the (new) offspring of unsettled lanes
+        nxt = np.where(done[:, None], cur_np, off_np)
+        nxt_len = np.where(done, len_np, off_len_np)
+        cur_g, cur_len = jnp.asarray(nxt), jnp.asarray(nxt_len)
+
+    gest = out["gestation"]
+    return TestResult(
+        viable=out["divided"] & (gest > 0),
+        gestation_time=gest,
+        merit=out["merit"],
+        fitness=np.where(gest > 0, out["merit"] / np.maximum(gest, 1), 0.0),
+        task_counts=out["tasks"],
+        copied_size=out["copied"],
+        executed_size=out["executed"],
+        offspring_genome=out["off"],
+        offspring_len=out["off_len"],
+        generations=generations,
+    )

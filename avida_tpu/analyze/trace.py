@@ -1,0 +1,86 @@
+"""Per-cycle hardware tracing.
+
+Equivalent of the reference tracing stack: cHardwareTracer
+(avida-core/source/cpu/cHardwareTracer.h:34, invoked from the inner loop at
+cHardwareCPU.cc:956), cHardwareStatusPrinter (cpu/cHardwareStatusPrinter.cc
+renders registers/heads/stacks per cycle for the analyze TRACE command) and
+the GUI SnapshotTracer (source/viewer/OrganismTrace.cc:134).
+
+The lockstep engine has no per-organism callback hook; instead the trace
+runs the genome through the sandbox one micro-step at a time and snapshots
+the architectural state after every cycle.  `collect_trace` returns the
+snapshots as arrays (the GUI-facing API); `trace_genome` renders the
+cHardwareStatusPrinter-style text file.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from avida_tpu.analyze.testcpu import _sandbox_state
+
+
+def collect_trace(params, genome, max_cycles: int = 2000, seed: int = 0):
+    """Run one genome in the sandbox, snapshotting state every cycle.
+
+    Returns a list of dicts (one per executed cycle): ip, read/write/flow
+    head positions, registers, top of stack, memory length, divide flag.
+    """
+    from avida_tpu.ops.interpreter import micro_step
+
+    genome = np.asarray(genome, np.int8)
+    L = params.max_memory
+    buf = np.zeros((1, L), np.int8)
+    n = min(len(genome), L)
+    buf[:, :n] = genome[:n]
+    params = params.replace(copy_mut_prob=0.0, divide_mut_prob=0.0,
+                            divide_ins_prob=0.0, divide_del_prob=0.0)
+    key = jax.random.key(seed)
+    st = _sandbox_state(params, jnp.asarray(buf), jnp.asarray([n], jnp.int32),
+                        key)
+    step = jax.jit(lambda s, k: micro_step(params, s, k, s.alive
+                                           & ~s.divide_pending))
+    snaps = []
+    for t in range(max_cycles):
+        st = step(st, jax.random.fold_in(key, t))
+        snaps.append({
+            "cycle": t + 1,
+            "ip": int(st.heads[0, 0]),
+            "read": int(st.heads[0, 1]),
+            "write": int(st.heads[0, 2]),
+            "flow": int(st.heads[0, 3]),
+            "regs": np.asarray(st.regs[0]).tolist(),
+            "stack_top": int(st.stacks[0, int(st.active_stack[0]),
+                                       int(st.sp[0, int(st.active_stack[0])])]),
+            "mem_len": int(st.mem_len[0]),
+            "divided": bool(st.divide_pending[0]),
+        })
+        if snaps[-1]["divided"]:
+            break
+    return snaps, st
+
+
+def trace_genome(params, instset, genome, path: str,
+                 max_cycles: int = 2000, seed: int = 0):
+    """Write a cHardwareStatusPrinter-style text trace to `path`."""
+    genome = np.asarray(genome, np.int8)
+    snaps, st = collect_trace(params, genome, max_cycles, seed)
+    mem = np.asarray(st.mem[0])
+    names = instset.inst_names
+    with open(path, "w") as f:
+        f.write(f"# Trace of genome (length {len(genome)})\n")
+        f.write("# " + " ".join(names[int(o)] for o in genome) + "\n\n")
+        for s in snaps:
+            op = int(mem[s['ip'] % max(s['mem_len'], 1)])
+            f.write(
+                f"U:{s['cycle']} IP:{s['ip']} AX:{s['regs'][0]} "
+                f"BX:{s['regs'][1]} CX:{s['regs'][2]} "
+                f"R-Head:{s['read']} W-Head:{s['write']} F-Head:{s['flow']} "
+                f"Mem:{s['mem_len']} Stack:{s['stack_top']}"
+                + ("  DIVIDE" if s["divided"] else "") + "\n")
+        f.write(f"\n# {len(snaps)} cycles"
+                + (" (divided)" if snaps and snaps[-1]["divided"] else "")
+                + "\n")
+    return snaps

@@ -80,7 +80,7 @@ def update_step(params, st, key, neighbors, update_no):
     st = birth_ops.flush_births(params, st, k_birth, neighbors, update_no)
 
     if params.point_mut_prob > 0:
-        st = _point_mutation_sweep(params, st, jax.random.fold_in(k_steps, -1))
+        st = _point_mutation_sweep(params, st, jax.random.fold_in(k_steps, 0x7FFFFFFF))
 
     executed = (st.insts_executed - executed0).sum()
     return st, executed

@@ -1,0 +1,102 @@
+"""Analyze-mode tests: the batch VM over saved populations.
+
+Models the reference's analyze consistency scenarios (tests/analyze_*,
+_analyze_detail_all): LOAD a .spop, RECALCULATE, DETAIL, TRACE, knockouts.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from avida_tpu.analyze.analyzer import Analyzer, AnalyzeGenotype
+from avida_tpu.config import AvidaConfig, default_instset
+from avida_tpu.config.environment import default_logic9_environment
+from avida_tpu.core.state import make_world_params
+from avida_tpu.utils.spop import _seq_to_string
+from avida_tpu.world import default_ancestor
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 1
+    cfg.WORLD_Y = 1
+    cfg.TPU_MAX_MEMORY = 320
+    iset = default_instset()
+    params = make_world_params(cfg, iset, default_logic9_environment())
+    return params, iset, default_ancestor(iset)
+
+
+def test_load_sequence_recalculate_detail(setup, tmp_path):
+    params, iset, anc = setup
+    az = Analyzer(params, iset, data_dir=str(tmp_path))
+    az.run_command(f"LOAD_SEQUENCE {_seq_to_string(anc)}")
+    az.run_command("RECALCULATE")
+    g = az.batch[0]
+    assert g.viable and g.gestation_time == 389
+    assert g.fitness == pytest.approx(97.0 / 389.0)
+    az.run_command("DETAIL ancestor.dat id fitness gestation_time length sequence")
+    text = (tmp_path / "ancestor.dat").read_text()
+    rows = [l for l in text.splitlines() if l and not l.startswith("#")]
+    assert len(rows) == 1
+    assert "389" in rows[0]
+
+
+def test_load_spop_roundtrip(setup, tmp_path):
+    params, iset, anc = setup
+    # build a little world, save .spop, then LOAD it in analyze mode
+    from avida_tpu.world import World
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 8
+    cfg.WORLD_Y = 8
+    cfg.RANDOM_SEED = 3
+    cfg.TPU_MAX_MEMORY = 320
+    w = World(cfg=cfg, data_dir=str(tmp_path))
+    w.inject()
+    for _ in range(30):
+        w.run_update()
+        w.update += 1
+    w._action_SavePopulation([])
+    spop = tmp_path / f"detail-{w.update}.spop"
+    assert spop.exists()
+
+    az = Analyzer(w.params, iset, data_dir=str(tmp_path))
+    az.run_command(f"LOAD {spop}")
+    assert len(az.batch) >= 1
+    az.run_command("RECALCULATE")
+    az.run_command("FILTER fitness > 0")
+    assert all(g.fitness > 0 for g in az.batch)
+    az.run_command("FIND_GENOTYPE num_cpus")
+    assert len(az.batch) == 1
+
+
+def test_trace(setup, tmp_path):
+    params, iset, anc = setup
+    az = Analyzer(params, iset, data_dir=str(tmp_path))
+    az.run_command(f"LOAD_SEQUENCE {_seq_to_string(anc)}")
+    az.run_command("TRACE")
+    files = os.listdir(tmp_path / "trace")
+    assert len(files) == 1
+    text = (tmp_path / "trace" / files[0]).read_text()
+    assert "DIVIDE" in text
+    # 389 executed cycles to first divide
+    assert "U:389" in text
+
+
+def test_knockouts(setup, tmp_path):
+    params, iset, anc = setup
+    az = Analyzer(params, iset, data_dir=str(tmp_path))
+    # a short region: knock out only sites 90..99 to keep runtime modest ->
+    # use a truncated batch trick: full genome knockout is covered by the
+    # command; here we just assert the output exists and counts sum to L
+    az.batch.append(AnalyzeGenotype(anc, 1))
+    az.run_command("ANALYZE_KNOCKOUTS ko.dat")
+    rows = [l for l in (tmp_path / "ko.dat").read_text().splitlines()
+            if l and not l.startswith("#")]
+    vals = rows[0].split()
+    length, counts = int(vals[1]), [int(v) for v in vals[2:6]]
+    assert length == len(anc)
+    assert sum(counts) == length
+    assert counts[0] > 0          # some sites are lethal (the divide, copy loop)
+    assert counts[2] > 40         # the nop-C spacer region is neutral

@@ -1,0 +1,76 @@
+"""Batched Test CPU tests (avida_tpu/analyze/testcpu.py).
+
+Oracle: the default ancestor's known life history (gestation 389, merit 97,
+fitness 97/389 -- reference golden data, tests/heads_default_100u) and
+obvious non-replicators.
+"""
+
+import numpy as np
+import pytest
+
+from avida_tpu.analyze import evaluate_genomes
+from avida_tpu.config import AvidaConfig, default_instset
+from avida_tpu.config.environment import default_logic9_environment
+from avida_tpu.core.state import make_world_params
+from avida_tpu.world import default_ancestor
+
+
+def make_params(L=320):
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 1
+    cfg.WORLD_Y = 1
+    cfg.TPU_MAX_MEMORY = L
+    return make_world_params(cfg, default_instset(), default_logic9_environment())
+
+
+def pad(g, L):
+    out = np.zeros(L, np.int8)
+    out[:len(g)] = g
+    return out
+
+
+def test_ancestor_metrics():
+    params = make_params()
+    iset = default_instset()
+    anc = default_ancestor(iset)
+    junk = np.full(100, iset.inst_names.index("nop-C"), np.int8)  # all nops
+    genomes = np.stack([pad(anc, 320), pad(junk, 320)])
+    lens = np.asarray([len(anc), 100], np.int32)
+    r = evaluate_genomes(params, genomes, lens)
+    assert bool(r.viable[0])
+    assert int(r.gestation_time[0]) == 389
+    assert float(r.merit[0]) == 97.0
+    assert float(r.fitness[0]) == pytest.approx(97.0 / 389.0)
+    assert int(r.offspring_len[0]) == 100
+    np.testing.assert_array_equal(r.offspring_genome[0, :100], anc)
+    assert int(r.generations[0]) == 0          # breeds true in generation 1
+    # the nop ball never divides
+    assert not bool(r.viable[1])
+
+
+def test_mutations_disabled_in_sandbox():
+    """The sandbox must evaluate the genotype deterministically even when the
+    world config has mutations on (ref cTestCPU uses its own rate context)."""
+    params = make_params()  # stock COPY_MUT_PROB=0.0075 active in world runs
+    anc = default_ancestor(default_instset())
+    genomes = np.stack([pad(anc, 320)] * 4)
+    lens = np.full(4, len(anc), np.int32)
+    r = evaluate_genomes(params, genomes, lens, seed=123)
+    for i in range(4):
+        np.testing.assert_array_equal(r.offspring_genome[i, :100], anc)
+    assert (r.gestation_time == 389).all()
+
+
+def test_nonviable_knockout():
+    """Knocking the divide out of the ancestor must make it non-viable --
+    the ANALYZE_KNOCKOUTS primitive (cAnalyze.cc)."""
+    params = make_params()
+    iset = default_instset()
+    anc = default_ancestor(iset)
+    ko = anc.copy()
+    ko[96] = iset.inst_names.index("nop-C")    # h-divide -> nop-C
+    genomes = np.stack([pad(anc, 320), pad(ko, 320)])
+    lens = np.full(2, len(anc), np.int32)
+    r = evaluate_genomes(params, genomes, lens)
+    assert bool(r.viable[0])
+    assert not bool(r.viable[1])
