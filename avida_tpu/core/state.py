@@ -243,6 +243,8 @@ class PopulationState(struct.PyTreeNode):
     generation: jax.Array     # int32[N]
     max_executed: jax.Array   # int32[N]    death threshold (DEATH_METHOD)
     num_divides: jax.Array    # int32[N]
+    breed_true: jax.Array     # bool[N]     born identical to parent genome
+                              # (ref cPhenotype copy_true / is_breed_true)
 
     # --- pending birth (flushed by the birth engine each update; the
     # offspring opcodes stay in place on the tape beyond mem_len and are
@@ -301,6 +303,7 @@ def zeros_population(n: int, L: int, R: int, n_global_res: int = 0,
         fitness=f32(n), last_bonus=f32(n), last_merit_base=f32(n),
         executed_size=i32(n), copied_size=i32(n), child_copied_size=i32(n),
         generation=i32(n), max_executed=i32(n), num_divides=i32(n),
+        breed_true=jnp.zeros(n, bool),
         divide_pending=jnp.zeros(n, bool),
         off_start=i32(n), off_len=i32(n),
         off_copied_size=i32(n),

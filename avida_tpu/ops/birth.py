@@ -91,6 +91,12 @@ def flush_births(params, st, key, neighbors, update_no):
     off_mem, off_len = extract_offspring(params, st, k_off)
     fresh_inputs = make_cell_inputs(k_inputs, n)
 
+    # breed-true: offspring genome identical to parent's birth genome
+    # (ref cPhenotype copy_true; feeds count.dat/average.dat breed stats)
+    cols = jnp.arange(L)
+    same_site = (off_mem == st.genome) | (cols[None, :] >= off_len[:, None])
+    is_breed_true = (off_len == st.genome_len) & same_site.all(axis=1)
+
     max_exec = jnp.where(
         params.death_method == 2, params.age_limit * off_len,
         jnp.where(params.death_method == 1, params.age_limit, 2**30))
@@ -124,6 +130,7 @@ def flush_births(params, st, key, neighbors, update_no):
         "child_copied_size": jnp.zeros(n, jnp.int32),
         "generation": st.generation,             # parent already incremented
         "max_executed": max_exec,
+        "breed_true": is_breed_true,
         "num_divides": jnp.zeros(n, jnp.int32),
         "divide_pending": jnp.zeros(n, bool),
         "off_start": jnp.zeros(n, jnp.int32), "off_len": jnp.zeros(n, jnp.int32),

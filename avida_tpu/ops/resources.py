@@ -59,15 +59,18 @@ def step_spatial(params, res_grid):
     box = np.asarray(params.sres_inflow_box, np.int32).reshape(R, 4)
 
     # inflow into the configured box, divided among its cells (ref
-    # cSpatialResCount::Source cc:362-363 `amount /= totalcells`); a box of
-    # (-1,-1,-1,-1) means the whole world
+    # cSpatialResCount::Source cc:362-363 `amount /= totalcells`).  Each -1
+    # coordinate defaults to full range on its own axis (per-axis defaults,
+    # matching the reference's unspecified-bound handling), so a partially
+    # specified box never silently collapses to empty.
     xs = np.arange(X)[None, None, :]
     ys = np.arange(Y)[None, :, None]
-    x1, x2, y1, y2 = box[:, 0], box[:, 1], box[:, 2], box[:, 3]
-    everywhere = (x1 < 0)[:, None, None]
-    in_box = (everywhere |
-              ((xs >= x1[:, None, None]) & (xs <= x2[:, None, None]) &
-               (ys >= y1[:, None, None]) & (ys <= y2[:, None, None])))
+    x1 = np.where(box[:, 0] < 0, 0, box[:, 0])
+    x2 = np.where(box[:, 1] < 0, X - 1, box[:, 1])
+    y1 = np.where(box[:, 2] < 0, 0, box[:, 2])
+    y2 = np.where(box[:, 3] < 0, Y - 1, box[:, 3])
+    in_box = ((xs >= x1[:, None, None]) & (xs <= x2[:, None, None]) &
+              (ys >= y1[:, None, None]) & (ys <= y2[:, None, None]))
     box_cells = np.maximum(in_box.sum(axis=(1, 2)), 1)
     per_cell = inflow / jnp.asarray(box_cells, jnp.float32)
     g = g + jnp.where(jnp.asarray(in_box), per_cell[:, None, None], 0.0)
@@ -75,12 +78,15 @@ def step_spatial(params, res_grid):
     # outflow (decay)
     g = g * (1.0 - outflow)[:, None, None]
 
-    # diffusion: explicit 3x3 stencil.  Per-axis coefficients are clamped to
-    # the explicit-scheme stability bound (cx + cy <= 1/2) so any
-    # xdiffuse/ydiffuse in [0, 1] -- including the reference default 1.0 --
-    # diffuses instead of exploding; mass is conserved by construction.
-    # Per-resource geometry: torus resources wrap, grid resources have
-    # zero-flux edges (ref cSpatialResCount geometry handling).
+    # diffusion: explicit 3x3 stencil, SUB-STEPPED so configured rates are
+    # honored.  A single explicit application is only stable for
+    # cx + cy <= 1/2 (cx = xdiffuse/2); the reference default
+    # xdiffuse=ydiffuse=1.0 exceeds it, so the per-update flow is split into
+    # ceil((xd+yd)_max) stencil applications with the coefficients divided
+    # accordingly -- full configured diffusion per update, still stable,
+    # mass conserved by construction.  Per-resource geometry: torus
+    # resources wrap, grid resources have zero-flux edges (ref
+    # cSpatialResCount geometry handling).
     def neighbors(gg, wrap):
         if wrap:
             return (jnp.roll(gg, 1, axis=2), jnp.roll(gg, -1, axis=2),
@@ -90,16 +96,22 @@ def step_spatial(params, res_grid):
                 jnp.concatenate([gg[:, :1, :], gg[:, :-1, :]], axis=1),
                 jnp.concatenate([gg[:, 1:, :], gg[:, -1:, :]], axis=1))
 
-    lt, rt, ut, dt = neighbors(g, True)
-    lb, rb, ub, db = neighbors(g, False)
+    max_rate = max(float(x) + float(y)
+                   for x, y in zip(params.sres_xdiffuse, params.sres_ydiffuse))
+    nsub = max(int(np.ceil(max_rate)), 1)   # static: rates are config
+    # clamp at 0: a (mis)configured negative rate must not invert the
+    # stencil into unbounded anti-diffusion
+    cx = jnp.maximum(0.5 * xd / nsub, 0.0)[:, None, None]
+    cy = jnp.maximum(0.5 * yd / nsub, 0.0)[:, None, None]
     w = torus[:, None, None]
-    left = jnp.where(w, lt, lb)
-    right = jnp.where(w, rt, rb)
-    up = jnp.where(w, ut, ub)
-    down = jnp.where(w, dt, db)
-    cx = jnp.clip(0.5 * xd, 0.0, 0.25)[:, None, None]
-    cy = jnp.clip(0.5 * yd, 0.0, 0.25)[:, None, None]
-    g = g + cx * (left + right - 2.0 * g) + cy * (up + down - 2.0 * g)
+    for _ in range(nsub):
+        lt, rt, ut, dt = neighbors(g, True)
+        lb, rb, ub, db = neighbors(g, False)
+        left = jnp.where(w, lt, lb)
+        right = jnp.where(w, rt, rb)
+        up = jnp.where(w, ut, ub)
+        down = jnp.where(w, dt, db)
+        g = g + cx * (left + right - 2.0 * g) + cy * (up + down - 2.0 * g)
 
     return jnp.maximum(g, 0.0).reshape(R, Y * X)
 
@@ -168,15 +180,27 @@ def consume(params, env_tables, rewarded, task_quality, resources, res_grid):
         got_g = jnp.zeros_like(wanted)
         scale_rxn = jnp.ones(res_idx.shape[0], jnp.float32)
 
-    # ---- spatial: one organism per cell, no contention ----
+    # ---- spatial: one organism per cell, but multiple reactions bound to
+    # the same resource can fire for that organism in one cycle, each
+    # computing `wanted` from the same pre-draw cell level -- so scale all
+    # depletable demands per (cell, resource) when they exceed the level,
+    # exactly like the global-pool path ----
     if params.num_spatial_res:
         is_s = (~infinite & spatial)[None, :]
-        got_s = jnp.where(is_s, wanted, 0.0)                     # [N, NR]
+        want_s = jnp.where(is_s, wanted, 0.0)                    # [N, NR]
         onehot_s = (jnp.arange(params.num_spatial_res)[:, None]
-                    == res_idx[None, :])                         # [Rs, NR]
+                    == res_idx[None, :]).astype(jnp.float32)     # [Rs, NR]
+        want_depl_s = jnp.where(depletable[None, :], want_s, 0.0)
+        demand_s = jnp.einsum("nr,sr->sn", want_depl_s, onehot_s)  # [Rs, N]
+        scale_sn = jnp.where(demand_s > res_grid,
+                             res_grid / jnp.maximum(demand_s, 1e-30), 1.0)
+        scale_nr = jnp.einsum("sn,sr->nr", scale_sn, onehot_s)   # [N, NR]
+        scale_nr = jnp.where((infinite | ~spatial | ~depletable)[None, :],
+                             1.0, scale_nr)
+        got_s = want_s * scale_nr
         drawn_s = jnp.einsum("nr,sr->sn",
                              jnp.where(is_s & depletable[None, :], got_s, 0.0),
-                             onehot_s.astype(jnp.float32))
+                             onehot_s)
         res_grid = jnp.maximum(res_grid - drawn_s, 0.0)
     else:
         got_s = jnp.zeros_like(wanted)

@@ -77,12 +77,17 @@ def update_step(params, st, key, neighbors, update_no):
     carry = jnp.clip(budgets - executed_this, 0, 100 * params.ave_time_slice)
     st = st.replace(budget_carry=jnp.where(st.alive, carry, 0))
 
+    # snapshot the per-update execution count BEFORE the birth flush:
+    # flush_births zeroes insts_executed on every cell receiving a newborn,
+    # so a post-flush difference would subtract the prior occupant's
+    # lifetime count (undercounting, possibly negative)
+    executed = executed_this.sum()
+
     st = birth_ops.flush_births(params, st, k_birth, neighbors, update_no)
 
     if params.point_mut_prob > 0:
         st = _point_mutation_sweep(params, st, jax.random.fold_in(k_steps, 0x7FFFFFFF))
 
-    executed = (st.insts_executed - executed0).sum()
     return st, executed
 
 
@@ -101,9 +106,10 @@ def _point_mutation_sweep(params, st, key):
 
 
 @partial(jax.jit, static_argnums=0)
-def summarize(params, st):
+def summarize(params, st, update_no=jnp.int32(-1)):
     """Device-side reduction of per-update stats (feeds cStats/.dat output;
-    ref cPopulation::UpdateOrganismStats cc:5847)."""
+    ref cPopulation::UpdateOrganismStats cc:5847).  `update_no` is the index
+    of the most recently completed update (for births-this-update counts)."""
     alive = st.alive
     n_alive = alive.sum()
     denom = jnp.maximum(n_alive, 1).astype(st.merit.dtype)
@@ -115,6 +121,8 @@ def summarize(params, st):
     gest = jnp.where(alive, st.gestation_time, 0)
     has_gest = alive & (st.gestation_time > 0)
     gest_denom = jnp.maximum(has_gest.sum(), 1).astype(fdt)
+    repro = jnp.where(has_gest,
+                      1.0 / jnp.maximum(st.gestation_time, 1).astype(fdt), 0)
 
     task_counts = (alive[:, None] & (st.last_task_count > 0)).sum(axis=0)
     task_doing = (alive[:, None] & (st.cur_task_count > 0)).sum(axis=0)
@@ -124,15 +132,40 @@ def summarize(params, st):
         "ave_merit": avg(st.merit),
         "ave_fitness": avg(st.fitness),
         "ave_gestation": jnp.where(has_gest, gest, 0).sum().astype(fdt) / gest_denom,
+        "ave_repro_rate": repro.sum() / gest_denom,
         "ave_genome_len": avg(st.genome_len),
+        "ave_copied_size": avg(st.copied_size),
+        "ave_executed_size": avg(st.executed_size),
         "ave_generation": avg(st.generation),
         "ave_age": avg(st.time_used),
         "max_fitness": jnp.where(alive, st.fitness, 0).max(),
         "max_merit": jnp.where(alive, st.merit, 0).max(),
         "num_births": (alive & (st.birth_update >= 0)).sum(),
+        # update_no >= 0 guard: injected organisms carry the birth_update
+        # sentinel -1, which must not collide with "events firing at update 0"
+        "births_this_update": (alive & (update_no >= 0)
+                               & (st.birth_update == update_no)).sum(),
+        "num_breed_true": (alive & st.breed_true).sum(),
+        "num_no_birth": (alive & (st.num_divides == 0)).sum(),
         "total_insts": st.insts_executed.astype(jnp.int64).sum()
         if jax.config.jax_enable_x64 else st.insts_executed.sum(),
         "task_counts": task_counts,
         "task_doing": task_doing,
         "num_divides": st.num_divides.sum(),
     }
+
+
+@partial(jax.jit, static_argnums=0)
+def light_stats(params, st, update_no):
+    """Tiny per-update reduction for host bookkeeping (avida time,
+    generation triggers, birth/death counts) -- returns device scalars, no
+    host sync implied.  update_no = the update that just completed."""
+    alive = st.alive
+    has = alive & (st.gestation_time > 0)
+    gd = jnp.maximum(has.sum(), 1).astype(jnp.float32)
+    ave_gest = jnp.where(has, st.gestation_time, 0).sum().astype(jnp.float32) / gd
+    n_alive = alive.sum()
+    n = jnp.maximum(n_alive, 1).astype(jnp.float32)
+    ave_gen = jnp.where(alive, st.generation, 0).sum().astype(jnp.float32) / n
+    births = (alive & (st.birth_update == update_no)).sum()
+    return ave_gest, ave_gen, n_alive, births

@@ -23,7 +23,7 @@ from avida_tpu.config.events import Event, parse_event_line
 from avida_tpu.core.state import (init_population, make_world_params,
                                   PopulationState)
 from avida_tpu.ops import birth as birth_ops
-from avida_tpu.ops.update import update_step, summarize
+from avida_tpu.ops.update import update_step, summarize, light_stats
 from avida_tpu.utils import output as output_mod
 
 # Reference default ancestor (support/config/default-heads.org): h-alloc,
@@ -92,9 +92,18 @@ class World:
         self.state: PopulationState | None = None
         self._exit = False
         self._files = {}
+        self._cum_insts = 0          # host-accumulated, birth-reset-proof
         self._insts_prev_total = 0
-        self._births_prev = 0
-        self._avida_time = 0.0
+        self._pending_exec = []      # unsynced per-update device scalars
+        self._avida_time = jnp.float32(0.0)   # device scalar, synced lazily
+        self._last_ave_gen = jnp.float32(0.0)
+        self._deaths_this = jnp.int32(0)      # device scalar
+        self._prev_alive = None               # device scalar
+        self._events_done_for = None
+        self._warned_actions = set()
+        # per-generation-event next-fire bookkeeping (cEventList generation
+        # triggers compare against population average generation)
+        self._gen_next = {}
 
         # live phylogeny (ref Systematics::GenotypeArbiter; SURVEY §2f)
         from avida_tpu.systematics import GenotypeArbiter
@@ -155,34 +164,51 @@ class World:
 
     def _summary(self):
         if getattr(self, "_summary_cache_update", None) != self.update:
-            s = summarize(self.params, self.state)
+            s = summarize(self.params, self.state, jnp.int32(self.update - 1))
             self._summary_stats = {k: np.asarray(v) for k, v in s.items()}
             self._summary_cache_update = self.update
         return self._summary_stats
+
+    def _flush_exec(self) -> int:
+        """Drain queued per-update executed counts into the host total."""
+        if self._pending_exec:
+            self._cum_insts += int(sum(int(x) for x in self._pending_exec))
+            self._pending_exec = []
+        return self._cum_insts
 
     def _action_PrintAverageData(self, args):
         s = self._summary()
         f = self._file("average", output_mod.open_average_dat)
         n = max(int(s["num_organisms"]), 1)
+        sysm = self.systematics
+        abundance = (n / max(sysm.num_genotypes, 1)) if sysm else 0.0
+        depth = sysm.average_depth() if sysm else 0.0
+        births = int(s["births_this_update"])
         f.write_row([
             self.update, float(s["ave_merit"]), float(s["ave_gestation"]),
-            float(s["ave_fitness"]), 0, 0, 0.0, 0.0, 0, 0.0, 0.0, 0,
-            float(s["ave_generation"]), 0, 0, 0])
+            float(s["ave_fitness"]), float(s["ave_repro_rate"]),
+            float(s["ave_genome_len"]), float(s["ave_copied_size"]),
+            float(s["ave_executed_size"]), abundance,
+            births / n, int(s["num_breed_true"]) / n, depth,
+            float(s["ave_generation"]), 0.0, 0,
+            births / n])
 
     def _action_PrintCountData(self, args):
         s = self._summary()
         f = self._file("count", output_mod.open_count_dat)
-        insts_this_update = int(s["total_insts"]) - self._insts_prev_total
-        self._insts_prev_total = int(s["total_insts"])
+        total = self._flush_exec()
+        insts_this_update = total - self._insts_prev_total
+        self._insts_prev_total = total
         n = int(s["num_organisms"])
         sysm = self.systematics
         num_gt = sysm.num_genotypes if sysm else 0
         num_thr = sysm.num_threshold if sysm else 0
-        births = (sysm.num_births_total - self._births_prev) if sysm else 0
-        if sysm:
-            self._births_prev = sysm.num_births_total
+        births = int(s["births_this_update"])
+        breed_true = int(s["num_breed_true"])
+        no_birth = int(s["num_no_birth"])   # never yet divided (cStats)
         f.write_row([self.update, insts_this_update, n, num_gt, num_thr,
-                     0, 0, 0, births, 0, 0, 0, 0, n, 0, 0])
+                     0, 0, 0, births, int(self._deaths_this), breed_true,
+                     breed_true, no_birth, n, 0, 0])
 
     def _action_PrintDominantData(self, args):
         if self.systematics is None:
@@ -215,9 +241,10 @@ class World:
     def _action_PrintTimeData(self, args):
         s = self._summary()
         f = self._file("time", output_mod.open_time_dat)
-        insts = int(s["total_insts"]) - getattr(self, "_time_prev", 0)
-        self._time_prev = int(s["total_insts"])
-        f.write_row([self.update, self._avida_time,
+        total = self._flush_exec()
+        insts = total - getattr(self, "_time_prev", 0)
+        self._time_prev = total
+        f.write_row([self.update, float(self._avida_time),
                      float(s["ave_generation"]), insts])
 
     def _action_PrintResourceData(self, args):
@@ -230,7 +257,7 @@ class World:
         if self.params.num_spatial_res:
             levels += [float(x) for x in
                        np.asarray(self.state.res_grid).sum(axis=1)]
-        f.write_row([self.update, self._avida_time] + levels)
+        f.write_row([self.update, float(self._avida_time)] + levels)
 
     def _action_SetResource(self, args):
         """SetResource <name> <level> (ref EnvironmentActions.cc)."""
@@ -255,17 +282,44 @@ class World:
             os.path.join(self.data_dir, f"detail-{self.update}.spop"),
             self.params, self.state, self.update)
 
+    def _dispatch(self, ev):
+        handler = getattr(self, f"_action_{ev.action}", None)
+        if handler is None:
+            if ev.action not in self._warned_actions:
+                self._warned_actions.add(ev.action)
+                import sys
+                print(f"[avida-tpu] warning: event action '{ev.action}' "
+                      f"not implemented; skipping", file=sys.stderr)
+            return
+        handler(ev.args)
+
     def process_events(self):
+        """Fire due events (ref cEventList::Process, called at the top of
+        every update, Avida2Driver.cc:92).  Generation triggers compare the
+        population average generation against the event's schedule.
+        Idempotent per update (run() pre-fires begin events before the loop;
+        the first loop iteration must not fire update-0 events again)."""
+        if self._events_done_for == self.update:
+            return
+        self._events_done_for = self.update
+        gen_events = [ev for ev in self.events if ev.trigger == "generation"]
+        gen = float(self._last_ave_gen) if gen_events else 0.0
         for ev in self.events:
-            if ev.trigger == "update" and ev.fires_at(self.update):
-                handler = getattr(self, f"_action_{ev.action}", None)
-                if handler is None:
-                    continue  # unimplemented actions are skipped (logged once)
-                handler(ev.args)
-            elif ev.trigger == "immediate" and self.update == 0:
-                handler = getattr(self, f"_action_{ev.action}", None)
-                if handler:
-                    handler(ev.args)
+            if ev.trigger == "update":
+                if ev.fires_at(self.update):
+                    self._dispatch(ev)
+            elif ev.trigger == "immediate":
+                if self.update == 0:
+                    self._dispatch(ev)
+            elif ev.trigger == "generation":
+                nxt = self._gen_next.setdefault(id(ev), ev.start)
+                while gen >= nxt and nxt <= ev.stop:
+                    self._dispatch(ev)
+                    if ev.interval <= 0:
+                        nxt = float("inf")      # one-shot
+                    else:
+                        nxt += ev.interval
+                self._gen_next[id(ev)] = nxt
 
     # ---- the master update loop (Avida2Driver::Run equivalent) ----
 
@@ -276,8 +330,18 @@ class World:
             self.params, self.state, k, self.neighbors, jnp.int32(self.update))
         if self.systematics is not None:
             self._feed_systematics()
-        # avida time advances by ave merit-weighted gestation share; the
-        # reference tracks 1/ave_gestation per update (cStats::ProcessUpdate)
+        # avida time advances by 1/ave_gestation per update (the reference's
+        # cStats::ProcessUpdate bookkeeping).  All accumulators stay device-
+        # side scalars -- no host sync in the update loop.
+        ave_gest, self._last_ave_gen, n_alive, births = light_stats(
+            self.params, self.state, jnp.int32(self.update))
+        self._avida_time = self._avida_time + jnp.where(
+            ave_gest > 0, 1.0 / jnp.maximum(ave_gest, 1e-9), 0.0)
+        if self._prev_alive is not None:
+            # deaths this update = prev alive + births - now alive
+            self._deaths_this = jnp.maximum(
+                self._prev_alive + births - n_alive, 0)
+        self._prev_alive = n_alive
         return executed
 
     def _feed_systematics(self):
@@ -307,7 +371,7 @@ class World:
             self.process_events()
             if self.state is None:
                 self.inject()
-        total_executed = 0
+        start_insts = self._cum_insts
         while not self._exit:
             if max_updates is not None and self.update >= max_updates:
                 break
@@ -315,27 +379,17 @@ class World:
             if self._exit:
                 break
             executed = self.run_update()
-            total_executed += int(executed)
-            s = self._summary_light()
-            g = s.get("ave_gestation", 0.0)
-            if g and g > 0:
-                self._avida_time += 1.0 / float(g)
+            # queue the device scalar; host-sync only at report boundaries
+            self._pending_exec.append(executed)
+            if len(self._pending_exec) >= 256:
+                self._flush_exec()
             self.update += 1
             if self.systematics is not None and self.update % 100 == 0:
                 self.systematics.prune_extinct(keep_ancestry=True)
         for f in self._files.values():
             f.close()
         self._files = {}
-        return total_executed
-
-    def _summary_light(self):
-        # gestation for avida-time bookkeeping; cheap device reduction
-        st = self.state
-        alive = st.alive
-        has = np.asarray(alive & (st.gestation_time > 0))
-        if has.any():
-            return {"ave_gestation": float(np.asarray(st.gestation_time)[has].mean())}
-        return {"ave_gestation": 0.0}
+        return self._flush_exec() - start_insts
 
     @property
     def num_organisms(self) -> int:
