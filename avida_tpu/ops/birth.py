@@ -101,52 +101,52 @@ def flush_births(params, st, key, neighbors, update_no):
         params.death_method == 2, params.age_limit * off_len,
         jnp.where(params.death_method == 1, params.age_limit, 2**30))
 
-    updates = {
+    # Fields that genuinely depend on the parent and must be gathered by
+    # parent index (the expensive part: two [N, L] row gathers + a dozen
+    # [N] gathers).  Everything else on a newborn is a constant/fresh value
+    # and is written directly at the target cell with no gather at all --
+    # splitting these was worth ~2x on the whole birth flush at 100k cells.
+    parent_updates = {
         "tape": pack_tape(off_mem), "mem_len": off_len,
         "genome": off_mem, "genome_len": off_len,
-        "regs": jnp.zeros((n, 3), jnp.int32), "heads": jnp.zeros((n, 4), jnp.int32),
-        "stacks": jnp.zeros((n, 2, 10), jnp.int32), "sp": jnp.zeros((n, 2), jnp.int32),
-        "active_stack": jnp.zeros(n, jnp.int32),
-        "read_label": jnp.zeros((n, 10), jnp.int8),
-        "read_label_len": jnp.zeros(n, jnp.int32),
-        "mal_active": jnp.zeros(n, bool),
-        "alive": jnp.ones(n, bool),
-        "inputs": fresh_inputs, "input_ptr": jnp.zeros(n, jnp.int32),
-        "input_buf": jnp.zeros((n, 3), jnp.int32),
-        "input_buf_n": jnp.zeros(n, jnp.int32),
-        "output_buf": jnp.zeros(n, jnp.int32),
         "merit": st.merit,                       # parent post-DivideReset merit
-        "cur_bonus": jnp.full(n, params.default_bonus, st.cur_bonus.dtype),
-        "cur_task_count": jnp.zeros_like(st.cur_task_count),
-        "cur_reaction_count": jnp.zeros_like(st.cur_reaction_count),
         "last_task_count": st.last_task_count,   # inherited expectation
-        "time_used": jnp.zeros(n, jnp.int32), "cpu_cycles": jnp.zeros(n, jnp.int32),
-        "gestation_start": jnp.zeros(n, jnp.int32),
         "gestation_time": st.gestation_time,     # parent's (SetupOffspring)
         "fitness": st.fitness, "last_bonus": st.last_bonus,
         "last_merit_base": st.last_merit_base,
         "executed_size": st.executed_size,
         "copied_size": st.child_copied_size,
-        "child_copied_size": jnp.zeros(n, jnp.int32),
         "generation": st.generation,             # parent already incremented
         "max_executed": max_exec,
         "breed_true": is_breed_true,
-        "num_divides": jnp.zeros(n, jnp.int32),
-        "divide_pending": jnp.zeros(n, bool),
-        "off_start": jnp.zeros(n, jnp.int32), "off_len": jnp.zeros(n, jnp.int32),
-        "off_copied_size": jnp.zeros(n, jnp.int32),
-        "genotype_id": jnp.full(n, -1, jnp.int32),
         "parent_id": rows.astype(jnp.int32),
-        "birth_update": jnp.full(n, update_no, jnp.int32),
-        "insts_executed": jnp.zeros(n, jnp.int32),
-        "budget_carry": jnp.zeros(n, jnp.int32),
+    }
+    const_updates = {
+        "regs": 0, "heads": 0, "stacks": 0, "sp": 0, "active_stack": 0,
+        "read_label": jnp.int8(0), "read_label_len": 0,
+        "mal_active": False, "alive": True,
+        "input_ptr": 0, "input_buf": 0, "input_buf_n": 0, "output_buf": 0,
+        "cur_bonus": jnp.asarray(params.default_bonus, st.cur_bonus.dtype),
+        "cur_task_count": 0, "cur_reaction_count": 0,
+        "time_used": 0, "cpu_cycles": 0, "gestation_start": 0,
+        "child_copied_size": 0, "num_divides": 0,
+        "divide_pending": False, "off_start": 0, "off_len": 0,
+        "off_copied_size": 0, "genotype_id": -1,
+        "birth_update": update_no, "insts_executed": 0, "budget_carry": 0,
     }
 
     new_fields = {}
-    for name, src in updates.items():
+    for name, src in parent_updates.items():
         dst = getattr(st, name)
         mask = births.reshape((n,) + (1,) * (src.ndim - 1))
         new_fields[name] = jnp.where(mask, src[parent_idx], dst)
+    for name, val in const_updates.items():
+        dst = getattr(st, name)
+        mask = births.reshape((n,) + (1,) * (dst.ndim - 1))
+        new_fields[name] = jnp.where(mask, jnp.asarray(val, dst.dtype), dst)
+    # fresh per-cell input stream for the newborn (cell property, not
+    # inherited -- indexed by target cell, so no gather either)
+    new_fields["inputs"] = jnp.where(births[:, None], fresh_inputs, st.inputs)
 
     st = st.replace(**new_fields)
     # winners' (and dead parents') pending flags clear; living losers retry

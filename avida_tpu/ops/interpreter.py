@@ -116,18 +116,17 @@ def micro_step(params, st, key, exec_mask):
     wp = _adjust(st.heads[:, HEAD_WRITE], mlen)
 
     # ================= THE read traversal =================
-    # ONE multi-output masked reduction over the tape produces everything
-    # any instruction could need this cycle: the fetched instruction pair
-    # (at IP, IP+1), the read-head opcode, the 10 label opcodes after IP,
-    # and the divide-viability flag counts.  Reductions are the dominant
-    # per-cycle cost on TPU (~0.3 ms per [N,L] traversal at N=100k); fusing
-    # them into one pass and avoiding integer division ([N,L] `%` is ~4x a
-    # whole traversal) is what the profile demanded.
-    ops_plane = (tape & OP_MASK).astype(jnp.int32)
-    shift1 = jnp.concatenate(
-        [ops_plane[:, 1:], jnp.zeros((n, 1), jnp.int32)], axis=1)
-    flags_plane = (tape >> 6).astype(jnp.int32)             # bit0 exec, bit1 copied
-    fetch_plane = ops_plane | (shift1 << 6) | (flags_plane << 12)
+    # Reductions over [N, L] are the dominant per-cycle cost on TPU.  The
+    # three single-site fetches (instruction at IP, at IP+1, at READ) are
+    # packed into ONE weighted reduction: each mask contributes the raw
+    # packed tape byte into its own 8-bit lane of a single int32
+    # (sum(tape32 * w) with w = m_ip + m_ip1<<8 + m_rp<<16; the masks each
+    # select exactly one column, so the byte lanes never carry).  The
+    # divide-viability flag counts pack into a second reduction, the label
+    # window needs two more (30 bits each) -- 4 passes total instead of 6,
+    # with no intermediate plane materialization and no [N,L] `%`.
+    tape32 = tape.astype(jnp.int32)
+    ops_plane = tape32 & 63
     inwin = cols[None, :] < mlen[:, None]
     rel0 = cols[None, :] - (ip + 1)[:, None]
     rel = rel0 + jnp.where(rel0 < 0, mlen[:, None], 0)      # (c - ip - 1) mod mlen
@@ -135,6 +134,7 @@ def micro_step(params, st, key, exec_mask):
     lab_lo_m = inwin & (rel < 5)
     lab_hi_m = inwin & (rel >= 5) & (rel < MAX_LABEL_SIZE)
     m_ip = cols[None, :] == ip[:, None]
+    m_ip1 = cols[None, :] == (ip + 1)[:, None]
     m_rp = cols[None, :] == rp[:, None]
     # divide viability zones (pre-step flag state; see adjustment below)
     parent_size = rp
@@ -147,16 +147,23 @@ def micro_step(params, st, key, exec_mask):
     def msum(mask, plane):
         return jnp.sum(jnp.where(mask, plane, 0), axis=1, dtype=jnp.int32)
 
-    s_ip = msum(m_ip, fetch_plane)
-    s_rp = msum(m_rp, ops_plane)
+    w1 = (m_ip.astype(jnp.int32) + (m_ip1.astype(jnp.int32) << 8)
+          + (m_rp.astype(jnp.int32) << 16))
+    r1 = jnp.sum(tape32 * w1, axis=1, dtype=jnp.int32)
+    flags_exec = (tape32 >> 6) & 1
+    flags_copied = tape32 >> 7
+    r2 = msum(in_parent, flags_exec) + (msum(copy_zone, flags_copied) << 16)
     lab_lo = msum(lab_lo_m, ops_plane << jnp.minimum(lab_sh, 30))
     lab_hi = msum(lab_hi_m, ops_plane << jnp.minimum(lab_sh, 30))
-    exec_count0 = msum(in_parent, flags_plane & 1)
-    copied_count = msum(copy_zone, flags_plane >> 1)
+    s_ip = r1 & 255                 # packed tape byte at IP
+    s_ip1 = (r1 >> 8) & 255         # packed tape byte at IP+1 (0 past end)
+    s_rp = (r1 >> 16) & 63          # opcode at READ head
+    exec_count0 = r2 & 0xFFFF
+    copied_count = r2 >> 16
     # ======================================================
 
     cur_op = jnp.clip(s_ip & 63, 0, num_insts - 1)
-    ip_exec_already = ((s_ip >> 12) & 1) != 0
+    ip_exec_already = ((s_ip >> 6) & 1) != 0
     sem = jnp.where(exec_mask, sem_t[cur_op], -1)
 
     def is_op(s):
@@ -165,7 +172,7 @@ def micro_step(params, st, key, exec_mask):
     # ---- operand resolution (FindModifiedRegister/Head, cc:1622,1663) ----
     next_pos = _adjust(ip + 1, mlen)
     op0 = (tape[:, 0] & OP_MASK).astype(jnp.int32)          # wrap target
-    next_op = jnp.where(ip == mlen - 1, op0, (s_ip >> 6) & 63)
+    next_op = jnp.where(ip == mlen - 1, op0, s_ip1 & 63)
     next_op = jnp.clip(next_op, 0, num_insts - 1)
     next_is_nop = is_nop_t[next_op]
     mod_kind = jnp.where(exec_mask, mod_kind_t[cur_op], MOD_NONE)

@@ -1,0 +1,1023 @@
+"""Pallas TPU kernel: a whole update's micro-cycles in one kernel launch.
+
+This is the performance core of the framework.  The XLA lockstep path
+(ops/interpreter.micro_step inside ops/update.update_step's while_loop)
+round-trips every [N, L] plane through HBM on every CPU cycle; at 100k
+organisms that costs ~1.7 ms/cycle and caps throughput far below the 1e8
+org-inst/s target.  This kernel instead runs ALL K cycles of an update for a
+block of B organisms with every byte of their state resident in VMEM:
+
+  HBM traffic per update  = 2 x state size        (one load, one store)
+  per-cycle work          = VMEM-resident VPU ops only
+
+Layout: organisms live on the LANE dimension (128-wide) --
+  tape_t : uint8[L, N]   memory planes, position on sublanes
+  ivec   : int32[NI, N]  every int32 per-organism scalar, one row each
+  fvec   : f32[NF, N]    float phenotype scalars
+so per-organism scalars are [1, B] lane vectors (2 vregs at B=256) and the
+tape reductions reduce over sublanes, producing lane vectors directly --
+no orientation changes anywhere in the cycle body.
+
+Semantics are the heads hardware exactly as ops/interpreter.micro_step
+implements it (same reference citations apply, cHardwareCPU.cc:908-1079);
+the only divergences are (a) the PRNG stream (pltpu.prng_random_bits
+instead of threefry -- RNG parity is impossible anyway, SURVEY.md §7 hard
+part 5) and (b) the fast path precondition below.
+
+Fast-path precondition (`eligible(params)`): reactions must not bind
+resources (stock logic-9 qualifies: all processes are infinite-resource).
+Then the cycle loop is per-organism pure and blocks are independent, so the
+kernel needs no cross-block communication.  Resource-bound environments fall
+back to the XLA path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from avida_tpu.models.heads import (
+    MOD_HEAD, MOD_LABEL, MOD_NONE, MOD_REG,
+    SEM_ADD, SEM_DEC, SEM_GET_HEAD, SEM_H_ALLOC, SEM_H_COPY, SEM_H_DIVIDE,
+    SEM_H_SEARCH, SEM_IF_LABEL, SEM_IF_LESS, SEM_IF_N_EQU, SEM_INC, SEM_IO,
+    SEM_JMP_HEAD, SEM_MOV_HEAD, SEM_NAND, SEM_POP, SEM_PUSH, SEM_SET_FLOW,
+    SEM_SHIFT_L, SEM_SHIFT_R, SEM_SUB, SEM_SWAP, SEM_SWAP_STK,
+    HEAD_IP, HEAD_READ, HEAD_WRITE, HEAD_FLOW, MAX_LABEL_SIZE,
+)
+
+# ---- ivec row layout ----
+IV_MEM_LEN = 0
+IV_ACTIVE_STACK = 1
+IV_READ_LABEL_LEN = 2
+IV_INPUT_PTR = 3
+IV_INPUT_BUF_N = 4
+IV_OUTPUT_BUF = 5
+IV_TIME_USED = 6
+IV_CPU_CYCLES = 7
+IV_GEST_START = 8
+IV_GEST_TIME = 9
+IV_EXEC_SIZE = 10
+IV_CHILD_COPIED = 11
+IV_GENERATION = 12
+IV_NUM_DIVIDES = 13
+IV_OFF_START = 14
+IV_OFF_LEN = 15
+IV_OFF_COPIED = 16
+IV_INSTS_EXEC = 17
+IV_FLAGS = 18            # bit0 mal_active, bit1 alive, bit2 divide_pending
+IV_GENOME_LEN = 19       # ro
+IV_MAX_EXEC = 20         # ro
+IV_GRANTED = 21          # ro
+IV_COPIED_SIZE = 22      # ro (merit calc input)
+IV_REGS = 23             # 3 rows
+IV_HEADS = 26            # 4 rows
+IV_SP = 30               # 2 rows
+IV_INPUT_BUF = 32        # 3 rows
+IV_INPUTS = 35           # 3 rows, ro
+IV_READ_LABEL = 38       # 10 rows
+IV_STACKS = 48           # 20 rows (stack-major: stack*10 + depth)
+IV_DYN = 68              # task/reaction rows start here
+
+FV_MERIT = 0
+FV_CUR_BONUS = 1
+FV_FITNESS = 2
+FV_LAST_BONUS = 3
+FV_LAST_MERIT_BASE = 4
+NF = 8
+
+FLAG_MAL, FLAG_ALIVE, FLAG_DIVPEND = 1, 2, 4
+
+DEFAULT_BLOCK = 256
+CHUNK = 8            # sublane rows per register-resident traversal chunk
+
+
+def eligible(params) -> bool:
+    """True when the per-organism fast path is semantically exact: no
+    reaction binds a resource (every process is infinite-resource), so one
+    update's cycles never couple organisms through shared pools."""
+    return all(r < 0 for r in params.proc_res_idx)
+
+
+def _ni(params) -> int:
+    R = params.num_reactions
+    ni = IV_DYN + 3 * R          # cur_task, cur_reaction, last_task
+    return (ni + 7) & ~7         # sublane-pad
+
+
+def _sel_table(op, table):
+    """table[op] for a [1,B] opcode vector via a static select chain (no
+    vector gather on TPU; the table is a trace-time tuple)."""
+    out = jnp.zeros_like(op)
+    for k, v in enumerate(table):
+        if v:
+            out = jnp.where(op == k, jnp.int32(int(v)), out)
+    return out
+
+
+def _bitmask_lookup(op, bits):
+    """bits[op] for a boolean table packed into two int32 masks (variable
+    per-lane shift -- O(1) in table size)."""
+    lo = 0
+    hi = 0
+    for k, b in enumerate(bits):
+        if b:
+            if k < 32:
+                lo |= 1 << k
+            else:
+                hi |= 1 << (k - 32)
+    lo_v = jnp.right_shift(jnp.uint32(lo),
+                           jnp.clip(op, 0, 31).astype(jnp.uint32)) & 1
+    if hi:
+        hi_v = jnp.right_shift(jnp.uint32(hi),
+                               jnp.clip(op - 32, 0, 31).astype(jnp.uint32)) & 1
+        return jnp.where(op < 32, lo_v, hi_v) == 1
+    return jnp.where(op < 32, lo_v, jnp.uint32(0)) == 1
+
+
+def _popcount32(x):
+    # unsigned SWAR popcount (int32 inputs may carry bit 31; arithmetic
+    # shifts would smear it, so everything runs in uint32)
+    x = x.astype(jnp.uint32)
+    x = x - ((x >> 1) & jnp.uint32(0x55555555))
+    x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
+    x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
+    return ((x * jnp.uint32(0x01010101)) >> 24).astype(jnp.int32)
+
+
+def _logic_id(i0, i1, i2, n_in, output):
+    """Port of tasks.compute_logic_id on [1,B] lane vectors using SWAR
+    popcounts instead of a [N,32,8] truth-table tensor (cTaskLib.cc:369)."""
+    lo_bits = []
+    ok = None
+    for c in range(8):
+        m0 = i0 if (c & 1) else ~i0
+        m1 = i1 if (c & 2) else ~i1
+        m2 = i2 if (c & 4) else ~i2
+        mask = m0 & m1 & m2
+        cnt = _popcount32(mask)
+        ones = _popcount32(mask & output)
+        consistent = (ones == 0) | (ones == cnt)
+        ok = consistent if ok is None else (ok & consistent)
+        lo_bits.append((ones > 0).astype(jnp.int32))
+    # fill rules for missing inputs (cTaskLib.cc:419-433)
+    lo_bits[1] = jnp.where(n_in < 1, lo_bits[0], lo_bits[1])
+    lo_bits[2] = jnp.where(n_in < 2, lo_bits[0], lo_bits[2])
+    lo_bits[3] = jnp.where(n_in < 2, lo_bits[1], lo_bits[3])
+    for c in range(4):
+        lo_bits[4 + c] = jnp.where(n_in < 3, lo_bits[c], lo_bits[4 + c])
+    logic = sum(lo_bits[c] << c for c in range(8))
+    return jnp.where(ok, logic, -1)
+
+
+def _task_performed(lid, logic_mask_row):
+    """logic_mask_row[lid] where logic_mask_row is a static bool[256]:
+    pack into 8 int32 words, select word by lid>>5, shift by lid&31."""
+    words = []
+    for w in range(8):
+        word = 0
+        for b in range(32):
+            if logic_mask_row[w * 32 + b]:
+                word |= 1 << b
+        words.append(word)
+    widx = lid >> 5
+    word_v = jnp.zeros_like(lid, dtype=jnp.uint32)
+    for w, word in enumerate(words):
+        if word:
+            word_v = jnp.where(widx == w, jnp.uint32(word), word_v)
+    return (jnp.right_shift(word_v, (lid & 31).astype(jnp.uint32)) & 1) == 1
+
+
+def _make_kernel(params, L, B, num_steps):
+    """Build the kernel body (params/L/B/num_steps are trace-time consts)."""
+    R = params.num_reactions
+    NI = _ni(params)
+    num_insts = params.num_insts
+    sem_tab = params.sem
+    mod_tab = params.mod_kind
+    def_tab = params.default_op
+    nop_tab = params.is_nop
+    nmod_tab = params.nop_mod
+    # default-layout fast path: nops are opcodes 0..2 with identity mods,
+    # turning every nop lookup into a single compare
+    nops_prefix = (all(bool(nop_tab[k]) == (k < 3) for k in range(num_insts))
+                   and tuple(int(x) for x in nmod_tab[:3]) == (0, 1, 2))
+    fdt = jnp.float32
+
+    def adjust(pos, mlen):
+        # cHeadCPU::fullAdjust: negative -> 0, >= len wraps modulo
+        return jnp.where(pos < 0, 0, pos % mlen)
+
+    def adjust1(pos, mlen):
+        # cheap adjust for pos guaranteed in [0, 2*mlen)
+        return jnp.where(pos >= mlen, pos - mlen, pos)
+
+    def kernel(seed_ref, tape_in, ivec_in, fvec_in,
+               tape_ref, ivec_ref, fvec_ref):
+        # work entirely on the (aliased) output blocks: copy once, mutate
+        # in VMEM across all cycles, write-back handled by the pipeline
+        tape_ref[...] = tape_in[...]
+        ivec_ref[...] = ivec_in[...]
+        fvec_ref[...] = fvec_in[...]
+        if params.copy_mut_prob > 0:
+            pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
+
+        granted = ivec_ref[IV_GRANTED, :][None, :]
+        # index planes (built in-kernel: closure constants are not allowed)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (L, B), 0)
+        reg_rows = jax.lax.broadcasted_iota(jnp.int32, (3, B), 0)
+        head_rows = jax.lax.broadcasted_iota(jnp.int32, (4, B), 0)
+        stk_rows = jax.lax.broadcasted_iota(jnp.int32, (20, B), 0)
+
+        def cycle_body(s, _):
+            mlen = jnp.maximum(ivec_ref[IV_MEM_LEN, :][None, :], 1)
+            flags = ivec_ref[IV_FLAGS, :][None, :]
+            alive = (flags & FLAG_ALIVE) != 0
+            mal_active = (flags & FLAG_MAL) != 0
+            divide_pending = (flags & FLAG_DIVPEND) != 0
+            exec_mask = alive & (s < granted) & ~divide_pending
+
+            # heads are maintained in [0, mlen) by every writer (division
+            # resets to 0, advances use adjust1, jumps use adjust), so the
+            # per-read re-adjust of the XLA path is a provable no-op here
+            heads = ivec_ref[pl.ds(IV_HEADS, 4), :]           # [4, B]
+            ip = heads[HEAD_IP, :][None, :]
+            rp = heads[HEAD_READ, :][None, :]
+            wp = heads[HEAD_WRITE, :][None, :]
+            parent_size = rp
+            child_end = jnp.where(wp == 0, mlen, wp)
+            child_size = child_end - parent_size
+
+            # ---- packed read traversal, CHUNKED over the position axis ----
+            # Whole-[L,B] intermediates spill every op to VMEM (the vector
+            # register file only holds a few [CH,B] tiles); accumulating over
+            # CH-row chunks keeps each chunk's op chain register-resident and
+            # makes the traversal compute-bound instead of VMEM-bound.
+            r1 = jnp.zeros((1, B), jnp.int32)
+            lab_lo = jnp.zeros((1, B), jnp.int32)
+            lab_hi = jnp.zeros((1, B), jnp.int32)
+            for c in range(L // CHUNK):
+                tc = tape_ref[pl.ds(c * CHUNK, CHUNK), :].astype(jnp.int32)
+                rows_c = (jax.lax.broadcasted_iota(jnp.int32, (CHUNK, B), 0)
+                          + c * CHUNK)
+                d = rows_c - ip
+                w1 = ((d == 0).astype(jnp.int32)
+                      + ((d == 1).astype(jnp.int32) << 8)
+                      + ((rows_c == rp).astype(jnp.int32) << 16))
+                r1 = r1 + jnp.sum(tc * w1, axis=0, keepdims=True)
+                # label window: positions (ip+1+k) mod mlen, k in [0,10)
+                rel = d - 1 + jnp.where(d < 1, mlen, 0)
+                sh = jnp.minimum(jnp.where(rel < 5, rel, rel - 5) * 6, 30)
+                inw = rows_c < mlen
+                sv = (tc & 63) << sh
+                lab_lo = lab_lo + jnp.sum(
+                    jnp.where(inw & (rel < 5), sv, 0), axis=0, keepdims=True)
+                lab_hi = lab_hi + jnp.sum(
+                    jnp.where(inw & (rel >= 5) & (rel < MAX_LABEL_SIZE), sv, 0),
+                    axis=0, keepdims=True)
+
+            s_ip = r1 & 255
+            s_ip1 = (r1 >> 8) & 255
+            s_rp = (r1 >> 16) & 63
+
+            cur_op = jnp.clip(s_ip & 63, 0, num_insts - 1)
+            ip_exec_already = ((s_ip >> 6) & 1) != 0
+            # one packed-metadata select chain replaces three table chains:
+            # meta = sem | mod_kind<<5 | default_op<<7
+            meta = jnp.zeros_like(cur_op)
+            for kk in range(num_insts):
+                mk = (int(sem_tab[kk]) | (int(mod_tab[kk]) << 5)
+                      | (int(def_tab[kk]) << 7))
+                if mk:
+                    meta = jnp.where(cur_op == kk, jnp.int32(mk), meta)
+            sem = jnp.where(exec_mask, meta & 31, -1)
+            mod_kind = jnp.where(exec_mask, (meta >> 5) & 3, MOD_NONE)
+            default_operand = (meta >> 7) & 3
+
+            def is_op(x):
+                return sem == x
+
+            # ---- divide-viability zone counts: a second chunked pass, run
+            # only on cycles where some lane actually executes h-divide ----
+            div_try = is_op(SEM_H_DIVIDE)
+
+            def zone_pass(_):
+                r2 = jnp.zeros((1, B), jnp.int32)
+                for c in range(L // CHUNK):
+                    tc = tape_ref[pl.ds(c * CHUNK, CHUNK), :].astype(jnp.int32)
+                    rows_c = (jax.lax.broadcasted_iota(
+                        jnp.int32, (CHUNK, B), 0) + c * CHUNK)
+                    in_p = rows_c < parent_size
+                    cz = (rows_c >= parent_size) & (rows_c < child_end)
+                    r2 = r2 + jnp.sum(
+                        jnp.where(in_p, (tc >> 6) & 1, 0)
+                        + (jnp.where(cz, tc >> 7, 0) << 16),
+                        axis=0, keepdims=True)
+                return r2
+
+            r2 = jax.lax.cond(jnp.any(div_try), zone_pass,
+                              lambda _: jnp.zeros((1, B), jnp.int32), None)
+            exec_count0 = r2 & 0xFFFF
+            copied_count = r2 >> 16
+
+            # ---- operand resolution ----
+            op0 = tape_ref[0, :][None, :].astype(jnp.int32) & 63
+            next_op = jnp.where(ip == mlen - 1, op0, s_ip1 & 63)
+            next_op = jnp.clip(next_op, 0, num_insts - 1)
+            if nops_prefix:
+                next_is_nop = next_op < 3
+                nmod_next = next_op
+            else:
+                next_is_nop = _bitmask_lookup(next_op, nop_tab)
+                nmod_next = _sel_table(next_op, nmod_tab)
+            wants_mod = (mod_kind == MOD_REG) | (mod_kind == MOD_HEAD)
+            has_mod = wants_mod & next_is_nop
+            operand = jnp.where(has_mod, nmod_next, default_operand)
+            consumed = has_mod.astype(jnp.int32)
+            next_pos = adjust1(ip + 1, mlen)
+
+            # ---- label decode ----
+            has_label = mod_kind == MOD_LABEL
+            lab_ops_l = [jnp.clip((lab_lo >> (6 * k)) & 63, 0, num_insts - 1)
+                         for k in range(5)]
+            lab_ops_l += [jnp.clip((lab_hi >> (6 * k)) & 63, 0, num_insts - 1)
+                          for k in range(5)]
+            run = jnp.ones_like(cur_op)
+            label_len = jnp.zeros_like(cur_op)
+            lab_vals = []
+            for k in range(MAX_LABEL_SIZE):
+                if nops_prefix:
+                    isn = lab_ops_l[k] < 3
+                    nv = lab_ops_l[k]   # identity for real nops; values at
+                    # non-nop positions are only ever used under k<label_len
+                else:
+                    isn = _bitmask_lookup(lab_ops_l[k], nop_tab)
+                    nv = _sel_table(lab_ops_l[k], nmod_tab)
+                in_range = (k + 1) <= (mlen - 1)
+                run = run * (isn & in_range).astype(jnp.int32)
+                label_len = label_len + run
+                lab_vals.append(nv)
+            label_len = jnp.where(has_label, label_len, 0)
+            consumed = jnp.where(has_label, label_len, consumed)
+            # complement rotation; wrap-by-subtract (values beyond 2 only
+            # occur at masked positions and never match a nop value)
+            lbl_c = [jnp.where(v >= 2, v - 2, v + 1) for v in lab_vals]
+
+            # ---- register reads ----
+            regs = ivec_ref[pl.ds(IV_REGS, 3), :]             # [3, B]
+            r_oh = reg_rows == operand
+            val = jnp.sum(jnp.where(r_oh, regs, 0), axis=0, keepdims=True)
+            nr = operand + 1
+            next_reg = jnp.where(nr >= 3, nr - 3, nr)
+            r2_oh = reg_rows == next_reg
+            val2 = jnp.sum(jnp.where(r2_oh, regs, 0), axis=0, keepdims=True)
+            bx = regs[1, :][None, :]
+            cx = regs[2, :][None, :]
+
+            # ---- PRNG (skipped entirely for mutation-free configs, which
+            # also lets interpret-mode tests run without TPU PRNG support) ----
+            if params.copy_mut_prob > 0:
+                bits = pltpu.bitcast(pltpu.prng_random_bits((2, B)), jnp.uint32)
+                # uint32 -> f32 casts are unsupported in Mosaic; the top 24
+                # bits fit an int32 exactly
+                u_copy = ((bits[0, :][None, :] >> 8).astype(jnp.int32)
+                          .astype(jnp.float32) * (1.0 / (1 << 24)))
+                rand_inst = ((bits[1, :][None, :] >> 1).astype(jnp.int32)
+                             % num_insts)
+            else:
+                u_copy = jnp.ones((1, B), jnp.float32)
+                rand_inst = jnp.zeros((1, B), jnp.int32)
+
+            # ---- stacks ----
+            a_stk = ivec_ref[IV_ACTIVE_STACK, :][None, :]
+            sp2 = ivec_ref[pl.ds(IV_SP, 2), :]                # [2, B]
+            spa = jnp.where(a_stk == 0, sp2[0, :][None, :], sp2[1, :][None, :])
+            push_m = is_op(SEM_PUSH)
+            pop_m = is_op(SEM_POP)
+            sp_push = jnp.where(spa == 0, 9, spa - 1)
+            stacks = ivec_ref[pl.ds(IV_STACKS, 20), :]        # [20, B]
+            cur_slot = stk_rows == (a_stk * 10 + spa)
+            push_slot = stk_rows == (a_stk * 10 + sp_push)
+            pop_val = jnp.sum(jnp.where(cur_slot, stacks, 0), axis=0,
+                              keepdims=True)
+            stacks = jnp.where(push_slot & push_m, val, stacks)
+            stacks = jnp.where(cur_slot & pop_m, 0, stacks)
+            new_spa = jnp.where(push_m, sp_push,
+                                jnp.where(pop_m,
+                                          jnp.where(spa == 9, 0, spa + 1),
+                                          spa))
+            sel0 = (a_stk == 0)
+            sp_out0 = jnp.where(sel0, new_spa, sp2[0, :][None, :])
+            sp_out1 = jnp.where(~sel0, new_spa, sp2[1, :][None, :])
+            active_stack = jnp.where(is_op(SEM_SWAP_STK), 1 - a_stk, a_stk)
+
+            # ---- h-search (gated on any lane searching) ----
+            srch = is_op(SEM_H_SEARCH)
+
+            def search_block(_):
+                clipped = jnp.clip(tape_ref[...].astype(jnp.int32) & 63,
+                                   0, num_insts - 1)
+                isnop_p = jnp.zeros_like(clipped, dtype=jnp.bool_)
+                nopval_p = jnp.full_like(clipped, -1)
+                for k in range(num_insts):
+                    if nop_tab[k]:
+                        hit = clipped == k
+                        isnop_p = isnop_p | hit
+                        nopval_p = jnp.where(hit, jnp.int32(int(nmod_tab[k])),
+                                             nopval_p)
+                match = jnp.ones((L, B), jnp.bool_)
+                for k in range(MAX_LABEL_SIZE):
+                    # nopval at position row+k (static shift down)
+                    if k == 0:
+                        shifted = nopval_p
+                    else:
+                        shifted = jnp.concatenate(
+                            [nopval_p[k:, :],
+                             jnp.full((k, B), -2, jnp.int32)], axis=0)
+                    mk = shifted == lbl_c[k]
+                    match = match & (mk | (k >= label_len))
+                match = match & ((rows + label_len) <= mlen) & (label_len > 0)
+                q = jnp.min(jnp.where(match, rows, L), axis=0, keepdims=True)
+                return q
+
+            q_found = jax.lax.cond(
+                jnp.any(srch), search_block,
+                lambda _: jnp.full((1, B), L, jnp.int32), None)
+            found = q_found < L
+            ip_after_label = adjust1(ip + label_len, mlen)
+            search_head = jnp.where(found, q_found + label_len - 1,
+                                    ip_after_label)
+            search_bx = search_head - ip_after_label
+            search_cx = label_len
+            new_flow_srch = adjust1(search_head + 1, mlen)
+
+            # ---- if-label ----
+            rl_len = ivec_ref[IV_READ_LABEL_LEN, :][None, :]
+            read_label = ivec_ref[pl.ds(IV_READ_LABEL, MAX_LABEL_SIZE), :]
+            rl_match = rl_len == label_len
+            for k in range(MAX_LABEL_SIZE):
+                rl_match = rl_match & (
+                    (read_label[k, :][None, :] == lbl_c[k])
+                    | (k >= label_len))
+
+            # ---- conditionals (boolean algebra: where() on bool vectors
+            # trips an unsupported i8->i1 truncation in Mosaic) ----
+            skip = ((is_op(SEM_IF_N_EQU) & (val == val2))
+                    | (is_op(SEM_IF_LESS) & (val >= val2))
+                    | (is_op(SEM_IF_LABEL) & ~rl_match))
+
+            # ---- h-alloc ----
+            alloc_m0 = is_op(SEM_H_ALLOC)
+            old_len = mlen
+            alloc_size = jnp.minimum(
+                (params.offspring_size_range
+                 * old_len.astype(jnp.float32)).astype(jnp.int32),
+                L - old_len)
+            alloc_ok = alloc_size >= 1
+            if params.require_allocate:
+                alloc_ok = alloc_ok & ~mal_active
+            alloc_ok = alloc_ok & (old_len <= (alloc_size.astype(jnp.float32)
+                                               * params.offspring_size_range
+                                               ).astype(jnp.int32))
+            alloc_ok = alloc_ok & ~divide_pending
+            alloc_m = alloc_m0 & alloc_ok
+            new_len_alloc = old_len + alloc_size
+            mem_len = jnp.where(alloc_m, new_len_alloc,
+                                ivec_ref[IV_MEM_LEN, :][None, :])
+            new_mal = mal_active | alloc_m
+
+            # ---- h-copy ----
+            copy_m = is_op(SEM_H_COPY)
+            read_inst = jnp.clip(s_rp, 0, num_insts - 1)
+            do_mut = copy_m & (u_copy < params.copy_mut_prob)
+            written = jnp.where(do_mut, rand_inst, read_inst)
+            if nops_prefix:
+                ri_isnop = read_inst < 3
+                ri_val = read_inst
+            else:
+                ri_isnop = _bitmask_lookup(read_inst, nop_tab)
+                ri_val = _sel_table(read_inst, nmod_tab)
+            ri_nop = ri_isnop & copy_m
+            ri_clear = (~ri_isnop) & copy_m
+            can_append = ri_nop & (rl_len < MAX_LABEL_SIZE)
+            rl_rows = jax.lax.broadcasted_iota(jnp.int32, (MAX_LABEL_SIZE, B), 0)
+            rl_slot = rl_rows == rl_len
+            read_label = jnp.where(
+                rl_slot & can_append, ri_val, read_label.astype(jnp.int32))
+            read_label_len = jnp.where(
+                ri_clear, 0, jnp.where(can_append, rl_len + 1, rl_len))
+
+            # ---- h-divide ----
+            div_try = is_op(SEM_H_DIVIDE)
+            gsize = ivec_ref[IV_GENOME_LEN, :][None, :]
+            fsize = gsize.astype(jnp.float32)
+            min_sz = jnp.maximum(params.min_genome_len,
+                                 (fsize / params.offspring_size_range
+                                  ).astype(jnp.int32))
+            max_sz = jnp.minimum(L, (fsize * params.offspring_size_range
+                                     ).astype(jnp.int32))
+            exec_count = exec_count0 + jnp.where(
+                div_try & ~ip_exec_already & (ip < parent_size), 1, 0)
+            viable = ((child_size >= min_sz) & (child_size <= max_sz) &
+                      (parent_size >= min_sz) & (parent_size <= max_sz) &
+                      (exec_count >= (parent_size.astype(jnp.float32)
+                                      * params.min_exe_lines).astype(jnp.int32)) &
+                      (copied_count >= (child_size.astype(jnp.float32)
+                                        * params.min_copied_lines).astype(jnp.int32)) &
+                      ~divide_pending)
+            div_m = div_try & viable
+            off_start = jnp.where(div_m, rp, ivec_ref[IV_OFF_START, :][None, :])
+            off_len = jnp.where(div_m, child_size,
+                                ivec_ref[IV_OFF_LEN, :][None, :])
+
+            # ---- IO + tasks (per-organism, infinite resources) ----
+            io_m = is_op(SEM_IO)
+            in_ptr = ivec_ref[IV_INPUT_PTR, :][None, :]
+            inputs3 = ivec_ref[pl.ds(IV_INPUTS, 3), :]
+            ptr_mod = in_ptr % 3
+            value_in = jnp.where(ptr_mod == 0, inputs3[0, :][None, :],
+                                 jnp.where(ptr_mod == 1, inputs3[1, :][None, :],
+                                           inputs3[2, :][None, :]))
+            ibuf = ivec_ref[pl.ds(IV_INPUT_BUF, 3), :]
+            ibuf_n = ivec_ref[IV_INPUT_BUF_N, :][None, :]
+            cur_bonus = fvec_ref[FV_CUR_BONUS, :][None, :]
+
+            def tasks_block(_):
+                i0 = jnp.where(ibuf_n > 0, ibuf[0, :][None, :], 0)
+                i1 = jnp.where(ibuf_n > 1, ibuf[1, :][None, :], 0)
+                i2 = jnp.where(ibuf_n > 2, ibuf[2, :][None, :], 0)
+                lid = _logic_id(i0, i1, i2, ibuf_n, val)
+                lid_ok = (lid >= 0) & io_m
+                lidc = jnp.clip(lid, 0, 255)
+
+                logic_mask = params.task_logic_mask   # tuple[R] of tuple[256]
+                min_tc = params.min_task_count
+                max_tc = params.max_task_count
+                req_m = params.req_reaction_mask
+                noreq_m = params.noreq_reaction_mask
+                val_t = params.proc_value
+                typ_t = params.proc_type
+
+                new_bonus = cur_bonus
+                performed_l = []
+                rewarded_l = []
+                add_sum = jnp.zeros((1, B), fdt)
+                for r in range(R):
+                    tc = ivec_ref[IV_DYN + r, :][None, :]
+                    performed = _task_performed(lidc, logic_mask[r]) & lid_ok
+                    in_window = (tc >= int(min_tc[r])) & (tc < int(max_tc[r]))
+                    req_ok = jnp.ones((1, B), jnp.bool_)
+                    for d in range(R):
+                        if req_m[r][d]:
+                            rc_d = ivec_ref[IV_DYN + R + d, :][None, :]
+                            req_ok = req_ok & (rc_d != 0)
+                        if noreq_m[r][d]:
+                            rc_d = ivec_ref[IV_DYN + R + d, :][None, :]
+                            req_ok = req_ok & (rc_d == 0)
+                    rewarded = performed & in_window & req_ok
+                    v = float(val_t[r])
+                    t = int(typ_t[r])
+                    if t == 2:      # pow: bonus *= 2^v
+                        new_bonus = jnp.where(rewarded, new_bonus * (2.0 ** v),
+                                              new_bonus)
+                    elif t == 1:    # mult
+                        if v != 0.0:
+                            new_bonus = jnp.where(rewarded, new_bonus * v,
+                                                  new_bonus)
+                    else:           # add
+                        add_sum = add_sum + jnp.where(rewarded,
+                                                      jnp.float32(v), 0.0)
+                    # i32, not bool: Mosaic rejects multi-i1-vector cond yields
+                    performed_l.append(performed.astype(jnp.int32))
+                    rewarded_l.append(rewarded.astype(jnp.int32))
+                return tuple([new_bonus + add_sum] + performed_l + rewarded_l)
+
+            def no_tasks(_):
+                f = jnp.zeros((1, B), jnp.int32)
+                return tuple([cur_bonus] + [f] * (2 * R))
+
+            # IO is absent from whole blocks for long stretches (the stock
+            # ancestor performs none); gate the ~400-op task pipeline on it
+            outs = jax.lax.cond(jnp.any(io_m), tasks_block, no_tasks, None)
+            new_bonus = outs[0]
+            performed_l = list(outs[1:1 + R])
+            rewarded_l = list(outs[1 + R:1 + 2 * R])
+
+            input_ptr = jnp.where(io_m, in_ptr + 1, in_ptr)
+            ibuf0 = jnp.where(io_m, value_in, ibuf[0, :][None, :])
+            ibuf1 = jnp.where(io_m, ibuf[0, :][None, :], ibuf[1, :][None, :])
+            ibuf2 = jnp.where(io_m, ibuf[1, :][None, :], ibuf[2, :][None, :])
+            input_buf_n = jnp.where(io_m, jnp.minimum(ibuf_n + 1, 3), ibuf_n)
+            output_buf = jnp.where(io_m, val,
+                                   ivec_ref[IV_OUTPUT_BUF, :][None, :])
+            cur_bonus = jnp.where(io_m, new_bonus, cur_bonus)
+
+            # ---- register writes ----
+            res = val
+            wrote = jnp.zeros((1, B), jnp.bool_)
+            for sm, v in ((SEM_SHIFT_R, val >> 1), (SEM_SHIFT_L, val << 1),
+                          (SEM_INC, val + 1), (SEM_DEC, val - 1),
+                          (SEM_ADD, bx + cx), (SEM_SUB, bx - cx),
+                          (SEM_NAND, ~(bx & cx)), (SEM_POP, pop_val),
+                          (SEM_IO, value_in), (SEM_SWAP, val2)):
+                res = jnp.where(is_op(sm), v, res)
+                wrote = wrote | is_op(sm)
+
+            regs_new = jnp.where((reg_rows == operand) & wrote, res, regs)
+            regs_new = jnp.where((reg_rows == next_reg) & is_op(SEM_SWAP),
+                                 val, regs_new)
+            hsel0 = jnp.where(mod_kind == MOD_HEAD, operand, HEAD_IP)
+            h_oh = head_rows == hsel0
+            head_sel = jnp.sum(jnp.where(h_oh, heads, 0), axis=0, keepdims=True)
+            # head_sel is in [0, mlen) by the head invariant; ip+consumed
+            # < 2*mlen (consumed <= mlen-1)
+            eff_head_pos = jnp.where(hsel0 == HEAD_IP,
+                                     adjust1(ip + consumed, mlen), head_sel)
+            regs_new = jnp.where((reg_rows == 2) & is_op(SEM_GET_HEAD),
+                                 eff_head_pos, regs_new)
+            regs_new = jnp.where((reg_rows == 0) & alloc_m, old_len, regs_new)
+            regs_new = jnp.where((reg_rows == 1) & srch, search_bx, regs_new)
+            regs_new = jnp.where((reg_rows == 2) & srch, search_cx, regs_new)
+            regs_new = jnp.where(div_m, 0, regs_new)
+
+            # ---- head writes ----
+            mov_m = is_op(SEM_MOV_HEAD)
+            jmp_m = is_op(SEM_JMP_HEAD)
+            setflow_m = is_op(SEM_SET_FLOW)
+            flow0 = heads[HEAD_FLOW, :][None, :]      # in-range by invariant
+            # the only TRUE modulo reductions left (arbitrary register
+            # offsets); jmp-head/set-flow are rare, so compute them under a
+            # block-activity gate
+            def rare_mods(_):
+                return (adjust(eff_head_pos + cx, mlen), adjust(val, mlen))
+
+            jmp_pos, setflow_pos = jax.lax.cond(
+                jnp.any(jmp_m | setflow_m), rare_mods,
+                lambda _: (jnp.zeros((1, B), jnp.int32),
+                           jnp.zeros((1, B), jnp.int32)), None)
+            new_hpos = jnp.where(mov_m, flow0, jmp_pos)
+            mv = mov_m | jmp_m
+            heads_new = jnp.where(h_oh & mv, new_hpos, heads)
+            new_flow = jnp.where(setflow_m, setflow_pos,
+                                 jnp.where(srch, new_flow_srch,
+                                           heads_new[HEAD_FLOW, :][None, :]))
+            heads_new = jnp.where(head_rows == HEAD_FLOW, new_flow, heads_new)
+            heads_new = jnp.where((head_rows == HEAD_READ) & copy_m,
+                                  adjust1(rp + 1, mlen), heads_new)
+            heads_new = jnp.where((head_rows == HEAD_WRITE) & copy_m,
+                                  adjust1(wp + 1, mlen), heads_new)
+
+            # ---- IP advance ----
+            mov_ip = mov_m & (hsel0 == HEAD_IP)
+            jmp_ip = jmp_m & (hsel0 == HEAD_IP)
+            # ip+consumed+skip+1 <= 2*mlen: two conditional subtracts
+            ip_seq = adjust1(adjust1(
+                ip + consumed + skip.astype(jnp.int32) + 1, mlen), mlen)
+            jmp_tgt = adjust1(jmp_pos + 1, mlen)
+            ip_new = jnp.where(jmp_ip, jmp_tgt, ip_seq)
+            ip_new = jnp.where(mov_ip, flow0, ip_new)
+            ip_new = jnp.where(div_m, 0, ip_new)
+            ip_new = jnp.where(exec_mask, ip_new, heads[HEAD_IP, :][None, :])
+            heads_new = jnp.where(head_rows == HEAD_IP, ip_new, heads_new)
+
+            # divide: CPU reset
+            mem_len = jnp.where(div_m, rp, mem_len)
+            heads_new = jnp.where(div_m, 0, heads_new)
+            stacks = jnp.where(div_m, 0, stacks)
+            sp_out0 = jnp.where(div_m, 0, sp_out0)
+            sp_out1 = jnp.where(div_m, 0, sp_out1)
+            active_stack = jnp.where(div_m, 0, active_stack)
+            read_label_len = jnp.where(div_m, 0, read_label_len)
+            new_mal = new_mal & ~div_m
+
+            # ---- phenotype DivideReset ----
+            copied_sz = ivec_ref[IV_COPIED_SIZE, :][None, :]
+            m = params.base_merit_method
+            if m == 0:
+                merit_base = jnp.full((1, B), float(params.base_const_merit), fdt)
+            elif m == 1:
+                merit_base = copied_sz.astype(fdt)
+            elif m == 2:
+                merit_base = exec_count.astype(fdt)
+            elif m == 3:
+                merit_base = gsize.astype(fdt)
+            elif m == 4:
+                merit_base = jnp.minimum(jnp.minimum(gsize, copied_sz),
+                                         exec_count).astype(fdt)
+            else:
+                least = jnp.minimum(jnp.minimum(gsize, copied_sz), exec_count)
+                merit_base = jnp.sqrt(least.astype(fdt))
+            new_merit = (merit_base * cur_bonus if params.inherit_merit
+                         else merit_base)
+            time_used0 = ivec_ref[IV_TIME_USED, :][None, :]
+            gest_start = ivec_ref[IV_GEST_START, :][None, :]
+            gestation = time_used0 + 1 - gest_start
+            new_fitness = new_merit / jnp.maximum(gestation, 1).astype(fdt)
+
+            merit = jnp.where(div_m, new_merit, fvec_ref[FV_MERIT, :][None, :])
+            fitness = jnp.where(div_m, new_fitness,
+                                fvec_ref[FV_FITNESS, :][None, :])
+            gest_time = jnp.where(div_m, gestation,
+                                  ivec_ref[IV_GEST_TIME, :][None, :])
+            last_bonus = jnp.where(div_m, cur_bonus,
+                                   fvec_ref[FV_LAST_BONUS, :][None, :])
+            last_mb = jnp.where(div_m, merit_base,
+                                fvec_ref[FV_LAST_MERIT_BASE, :][None, :])
+            exec_size = jnp.where(div_m, exec_count,
+                                  ivec_ref[IV_EXEC_SIZE, :][None, :])
+            child_copied = jnp.where(div_m, copied_count,
+                                     ivec_ref[IV_CHILD_COPIED, :][None, :])
+            cur_bonus = jnp.where(div_m, params.default_bonus, cur_bonus)
+            generation = ivec_ref[IV_GENERATION, :][None, :] + \
+                div_m.astype(jnp.int32)
+            num_divides = ivec_ref[IV_NUM_DIVIDES, :][None, :] + \
+                div_m.astype(jnp.int32)
+            off_copied = jnp.where(div_m, copied_count,
+                                   ivec_ref[IV_OFF_COPIED, :][None, :])
+
+            # ---- time + death ----
+            time_used = time_used0 + exec_mask.astype(jnp.int32)
+            cpu_cycles = ivec_ref[IV_CPU_CYCLES, :][None, :] + \
+                exec_mask.astype(jnp.int32)
+            gest_start = jnp.where(div_m, time_used, gest_start)
+            max_exec = ivec_ref[IV_MAX_EXEC, :][None, :]
+            died = exec_mask & (max_exec > 0) & (time_used >= max_exec)
+            alive = alive & ~died
+            insts_exec = ivec_ref[IV_INSTS_EXEC, :][None, :] + \
+                exec_mask.astype(jnp.int32)
+            divide_pending = divide_pending | div_m
+
+            # ---- the single tape write pass (chunked, register-resident) ----
+            lab0_exec = has_label & (label_len > 0)
+            nop_exec = has_mod | lab0_exec
+            exec_at_ip = exec_mask
+            wr_copy = copy_m
+            base_w = written | 128
+            for c in range(L // CHUNK):
+                tc = tape_ref[pl.ds(c * CHUNK, CHUNK), :].astype(jnp.int32)
+                rows_c = (jax.lax.broadcasted_iota(jnp.int32, (CHUNK, B), 0)
+                          + c * CHUNK)
+                exec_set = (((rows_c == ip) & exec_at_ip)
+                            | ((rows_c == next_pos) & nop_exec))
+                t = tc | jnp.where(exec_set, 64, 0)
+                t = jnp.where(alloc_m & (rows_c >= old_len)
+                              & (rows_c < new_len_alloc), 0, t)
+                t = jnp.where((rows_c == wp) & wr_copy, base_w | (t & 64), t)
+                t = jnp.where(div_m, t & 63, t)
+                tape_ref[pl.ds(c * CHUNK, CHUNK), :] = t.astype(jnp.uint8)
+
+            # ---- write back scalars ----
+            ivec_ref[IV_MEM_LEN, :] = mem_len[0]
+            ivec_ref[IV_ACTIVE_STACK, :] = active_stack[0]
+            ivec_ref[IV_READ_LABEL_LEN, :] = read_label_len[0]
+            ivec_ref[IV_INPUT_PTR, :] = input_ptr[0]
+            ivec_ref[IV_INPUT_BUF_N, :] = input_buf_n[0]
+            ivec_ref[IV_OUTPUT_BUF, :] = output_buf[0]
+            ivec_ref[IV_TIME_USED, :] = time_used[0]
+            ivec_ref[IV_CPU_CYCLES, :] = cpu_cycles[0]
+            ivec_ref[IV_GEST_START, :] = gest_start[0]
+            ivec_ref[IV_GEST_TIME, :] = gest_time[0]
+            ivec_ref[IV_EXEC_SIZE, :] = exec_size[0]
+            ivec_ref[IV_CHILD_COPIED, :] = child_copied[0]
+            ivec_ref[IV_GENERATION, :] = generation[0]
+            ivec_ref[IV_NUM_DIVIDES, :] = num_divides[0]
+            ivec_ref[IV_OFF_START, :] = off_start[0]
+            ivec_ref[IV_OFF_LEN, :] = off_len[0]
+            ivec_ref[IV_OFF_COPIED, :] = off_copied[0]
+            ivec_ref[IV_INSTS_EXEC, :] = insts_exec[0]
+            flags_new = (jnp.where(new_mal, FLAG_MAL, 0)
+                         | jnp.where(alive, FLAG_ALIVE, 0)
+                         | jnp.where(divide_pending, FLAG_DIVPEND, 0))
+            ivec_ref[IV_FLAGS, :] = flags_new[0]
+            ivec_ref[pl.ds(IV_REGS, 3), :] = regs_new
+            ivec_ref[pl.ds(IV_HEADS, 4), :] = heads_new
+            ivec_ref[IV_SP, :] = sp_out0[0]
+            ivec_ref[IV_SP + 1, :] = sp_out1[0]
+            ivec_ref[IV_INPUT_BUF, :] = ibuf0[0]
+            ivec_ref[IV_INPUT_BUF + 1, :] = ibuf1[0]
+            ivec_ref[IV_INPUT_BUF + 2, :] = ibuf2[0]
+            ivec_ref[pl.ds(IV_READ_LABEL, MAX_LABEL_SIZE), :] = read_label
+            ivec_ref[pl.ds(IV_STACKS, 20), :] = stacks
+            # task/reaction counters change only on IO or divide cycles
+            @pl.when(jnp.any(io_m) | jnp.any(div_m))
+            def _update_task_counts():
+                for r in range(R):
+                    tc = ivec_ref[IV_DYN + r, :][None, :]
+                    rc = ivec_ref[IV_DYN + R + r, :][None, :]
+                    ltc = ivec_ref[IV_DYN + 2 * R + r, :][None, :]
+                    tc_new = tc + performed_l[r]
+                    rc_new = rc + rewarded_l[r]
+                    ltc_new = jnp.where(div_m, tc_new, ltc)
+                    tc_new = jnp.where(div_m, 0, tc_new)
+                    rc_new = jnp.where(div_m, 0, rc_new)
+                    ivec_ref[IV_DYN + r, :] = tc_new[0]
+                    ivec_ref[IV_DYN + R + r, :] = rc_new[0]
+                    ivec_ref[IV_DYN + 2 * R + r, :] = ltc_new[0]
+            fvec_ref[FV_MERIT, :] = merit[0]
+            fvec_ref[FV_CUR_BONUS, :] = cur_bonus[0]
+            fvec_ref[FV_FITNESS, :] = fitness[0]
+            fvec_ref[FV_LAST_BONUS, :] = last_bonus[0]
+            fvec_ref[FV_LAST_MERIT_BASE, :] = last_mb[0]
+            return _
+
+        # run only as many cycles as this block's largest budget needs
+        block_max = jnp.minimum(jnp.max(granted), num_steps)
+
+        def cond(carry):
+            return carry[0] < block_max
+
+        def body(carry):
+            s, _ = carry
+            cycle_body(s, None)
+            return (s + 1, 0)
+
+        jax.lax.while_loop(cond, body, (jnp.int32(0), 0))
+
+    return kernel, NI
+
+
+def _dims(params, n, L0):
+    B = min(DEFAULT_BLOCK, max(128, 1 << (n - 1).bit_length()))
+    n_pad = ((n + B - 1) // B) * B
+    L = (L0 + 7) & ~7
+    return B, n_pad, L
+
+
+def pack_state(params, st, granted):
+    """PopulationState -> (tape_t, ivec, fvec) kernel layout (traced)."""
+    n, L0 = st.tape.shape
+    R = params.num_reactions
+    NI = _ni(params)
+    B, n_pad, L = _dims(params, n, L0)
+
+    def padn(x):
+        return jnp.pad(x, ((0, n_pad - n),) + ((0, 0),) * (x.ndim - 1))
+
+    # ---- pack ----
+    tape_t = jnp.pad(padn(st.tape), ((0, 0), (0, L - L0))).T   # [L, n_pad]
+    iv = [None] * NI
+
+    def setrow(i, x):
+        iv[i] = padn(x.astype(jnp.int32))
+
+    setrow(IV_MEM_LEN, st.mem_len)
+    setrow(IV_ACTIVE_STACK, st.active_stack)
+    setrow(IV_READ_LABEL_LEN, st.read_label_len)
+    setrow(IV_INPUT_PTR, st.input_ptr)
+    setrow(IV_INPUT_BUF_N, st.input_buf_n)
+    setrow(IV_OUTPUT_BUF, st.output_buf)
+    setrow(IV_TIME_USED, st.time_used)
+    setrow(IV_CPU_CYCLES, st.cpu_cycles)
+    setrow(IV_GEST_START, st.gestation_start)
+    setrow(IV_GEST_TIME, st.gestation_time)
+    setrow(IV_EXEC_SIZE, st.executed_size)
+    setrow(IV_CHILD_COPIED, st.child_copied_size)
+    setrow(IV_GENERATION, st.generation)
+    setrow(IV_NUM_DIVIDES, st.num_divides)
+    setrow(IV_OFF_START, st.off_start)
+    setrow(IV_OFF_LEN, st.off_len)
+    setrow(IV_OFF_COPIED, st.off_copied_size)
+    setrow(IV_INSTS_EXEC, st.insts_executed)
+    setrow(IV_FLAGS, (st.mal_active * FLAG_MAL + st.alive * FLAG_ALIVE
+                      + st.divide_pending * FLAG_DIVPEND))
+    setrow(IV_GENOME_LEN, st.genome_len)
+    setrow(IV_MAX_EXEC, st.max_executed)
+    setrow(IV_GRANTED, granted)
+    setrow(IV_COPIED_SIZE, st.copied_size)
+    for k in range(3):
+        setrow(IV_REGS + k, st.regs[:, k])
+    for k in range(4):
+        setrow(IV_HEADS + k, st.heads[:, k])
+    for k in range(2):
+        setrow(IV_SP + k, st.sp[:, k])
+    for k in range(3):
+        setrow(IV_INPUT_BUF + k, st.input_buf[:, k])
+    for k in range(3):
+        setrow(IV_INPUTS + k, st.inputs[:, k])
+    for k in range(MAX_LABEL_SIZE):
+        setrow(IV_READ_LABEL + k, st.read_label[:, k])
+    for s_ in range(2):
+        for d in range(10):
+            setrow(IV_STACKS + s_ * 10 + d, st.stacks[:, s_, d])
+    for r in range(R):
+        setrow(IV_DYN + r, st.cur_task_count[:, r])
+        setrow(IV_DYN + R + r, st.cur_reaction_count[:, r])
+        setrow(IV_DYN + 2 * R + r, st.last_task_count[:, r])
+    for i in range(NI):
+        if iv[i] is None:
+            iv[i] = jnp.zeros(n_pad, jnp.int32)
+    ivec = jnp.stack(iv, axis=0)                               # [NI, n_pad]
+
+    fv = [jnp.zeros(n_pad, jnp.float32)] * NF
+    fv[FV_MERIT] = padn(st.merit.astype(jnp.float32))
+    fv[FV_CUR_BONUS] = padn(st.cur_bonus.astype(jnp.float32))
+    fv[FV_FITNESS] = padn(st.fitness.astype(jnp.float32))
+    fv[FV_LAST_BONUS] = padn(st.last_bonus.astype(jnp.float32))
+    fv[FV_LAST_MERIT_BASE] = padn(st.last_merit_base.astype(jnp.float32))
+    fvec = jnp.stack(fv, axis=0)
+    return tape_t, ivec, fvec
+
+
+def run_packed(params, packed, key, num_steps):
+    """One kernel launch over the packed state triple (traced)."""
+    tape_t, ivec, fvec = packed
+    L, n_pad = tape_t.shape
+    NI = _ni(params)
+    B = min(DEFAULT_BLOCK, n_pad)
+
+    seed = jax.random.randint(key, (1,), 0, 2**31 - 1, dtype=jnp.int32)
+
+    kernel, _ = _make_kernel(params, L, B, num_steps)
+    interpret = jax.devices()[0].platform != "tpu"
+    grid = (n_pad // B,)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((L, B), lambda i: (0, i)),
+            pl.BlockSpec((NI, B), lambda i: (0, i)),
+            pl.BlockSpec((NF, B), lambda i: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((L, B), lambda i: (0, i)),
+            pl.BlockSpec((NI, B), lambda i: (0, i)),
+            pl.BlockSpec((NF, B), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((L, n_pad), jnp.uint8),
+            jax.ShapeDtypeStruct((NI, n_pad), jnp.int32),
+            jax.ShapeDtypeStruct((NF, n_pad), jnp.float32),
+        ],
+        input_output_aliases={1: 0, 2: 1, 3: 2},
+        interpret=interpret,
+    )(seed, tape_t, ivec, fvec)
+    return tuple(out)
+
+
+def unpack_state(params, st, packed):
+    """Kernel layout -> PopulationState, preserving untouched fields of
+    `st` (genome, breed_true, resources...) (traced)."""
+    tape_o, ivec_o, fvec_o = packed
+    n, L0 = st.tape.shape
+    R = params.num_reactions
+
+    # ---- unpack ----
+    def row(i):
+        return ivec_o[i, :n]
+
+    def frow(i):
+        return fvec_o[i, :n]
+
+    flags = row(IV_FLAGS)
+    return st.replace(
+        tape=tape_o.T[:n, :L0],
+        mem_len=row(IV_MEM_LEN),
+        regs=jnp.stack([row(IV_REGS + k) for k in range(3)], axis=1),
+        heads=jnp.stack([row(IV_HEADS + k) for k in range(4)], axis=1),
+        stacks=jnp.stack(
+            [jnp.stack([row(IV_STACKS + s_ * 10 + d) for d in range(10)],
+                       axis=1) for s_ in range(2)], axis=1),
+        sp=jnp.stack([row(IV_SP + k) for k in range(2)], axis=1),
+        active_stack=row(IV_ACTIVE_STACK),
+        read_label=jnp.stack([row(IV_READ_LABEL + k).astype(jnp.int8)
+                              for k in range(MAX_LABEL_SIZE)], axis=1),
+        read_label_len=row(IV_READ_LABEL_LEN),
+        mal_active=(flags & FLAG_MAL) != 0,
+        alive=(flags & FLAG_ALIVE) != 0,
+        input_ptr=row(IV_INPUT_PTR),
+        input_buf=jnp.stack([row(IV_INPUT_BUF + k) for k in range(3)], axis=1),
+        input_buf_n=row(IV_INPUT_BUF_N),
+        output_buf=row(IV_OUTPUT_BUF),
+        merit=frow(FV_MERIT), cur_bonus=frow(FV_CUR_BONUS),
+        cur_task_count=jnp.stack([row(IV_DYN + r) for r in range(R)], axis=1),
+        cur_reaction_count=jnp.stack([row(IV_DYN + R + r) for r in range(R)],
+                                     axis=1),
+        last_task_count=jnp.stack([row(IV_DYN + 2 * R + r) for r in range(R)],
+                                  axis=1),
+        time_used=row(IV_TIME_USED), cpu_cycles=row(IV_CPU_CYCLES),
+        gestation_start=row(IV_GEST_START), gestation_time=row(IV_GEST_TIME),
+        fitness=frow(FV_FITNESS), last_bonus=frow(FV_LAST_BONUS),
+        last_merit_base=frow(FV_LAST_MERIT_BASE),
+        executed_size=row(IV_EXEC_SIZE),
+        child_copied_size=row(IV_CHILD_COPIED),
+        generation=row(IV_GENERATION), num_divides=row(IV_NUM_DIVIDES),
+        divide_pending=(flags & FLAG_DIVPEND) != 0,
+        off_start=row(IV_OFF_START), off_len=row(IV_OFF_LEN),
+        off_copied_size=row(IV_OFF_COPIED),
+        insts_executed=row(IV_INSTS_EXEC),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4))
+def run_cycles(params, st, key, granted, num_steps):
+    """Run up to `num_steps` lockstep cycles with per-organism budgets
+    `granted` (int32[N]) through the VMEM-resident kernel.  Returns the new
+    PopulationState.  Caller must check `eligible(params)` first."""
+    packed = pack_state(params, st, granted)
+    packed = run_packed(params, packed, key, num_steps)
+    return unpack_state(params, st, packed)

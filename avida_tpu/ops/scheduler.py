@@ -39,7 +39,23 @@ def compute_budgets(params, st, key):
     p = jnp.where(total > 0, merit / jnp.maximum(total, 1e-30), 0.0)
 
     if params.slicing_method == 1:
-        k = jax.random.binomial(key, ud_size.astype(jnp.float32), p)
+        n = alive.shape[0]
+        if n >= 32768:
+            # Large populations: Binomial(UD, p_i) with UD huge and p_i tiny
+            # is Poisson(lam_i) to high accuracy, and lam_i ~ AVE_TIME_SLICE
+            # makes the normal approximation to the Poisson accurate to a
+            # relative skew of 1/sqrt(lam) ~ 0.18.  One normal draw per
+            # organism instead of an iterative binomial sampler (which
+            # dominated the update profile at 100k organisms).  Documented
+            # deviation stacked on the already-documented multinomial ->
+            # independent-binomials one; first-discovery statistics are
+            # unaffected (validated by the EQU-evolution harness).
+            lam = p * ud_size.astype(jnp.float32)
+            z = jax.random.normal(key, (n,))
+            k = jnp.round(lam + jnp.sqrt(jnp.maximum(lam, 0.0)) * z)
+            k = jnp.maximum(k, 0.0)
+        else:
+            k = jax.random.binomial(key, ud_size.astype(jnp.float32), p)
         k = jnp.where(alive, k, 0).astype(jnp.int32)
         return k
 
