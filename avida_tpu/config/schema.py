@@ -174,6 +174,10 @@ class AvidaConfig:
     TPU_MAX_STEPS_PER_UPDATE: int = 0
     # float dtype for merit/bonus math ("float32" is plenty: max bonus 2^25).
     TPU_FLOAT_DTYPE: str = "float32"
+    # Pallas VMEM-resident cycle kernel (ops/pallas_cycles.py): 0 = auto
+    # (use on TPU when the environment qualifies), 1 = force on (any
+    # backend; interpret mode off-TPU), 2 = off (always XLA micro-steps).
+    TPU_USE_PALLAS: int = 0
 
     extras: dict = field(default_factory=dict)
 

@@ -65,6 +65,7 @@ class WorldParams(struct.PyTreeNode):
     default_bonus: float = struct.field(pytree_node=False, default=1.0)
     inherit_merit: bool = struct.field(pytree_node=False, default=True)
     max_steps_per_update: int = struct.field(pytree_node=False, default=0)
+    use_pallas: int = struct.field(pytree_node=False, default=0)
     # death
     death_method: int = struct.field(pytree_node=False, default=2)
     age_limit: int = struct.field(pytree_node=False, default=20)
@@ -146,6 +147,7 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         default_bonus=cfg.DEFAULT_BONUS,
         inherit_merit=bool(cfg.INHERIT_MERIT),
         max_steps_per_update=cfg.TPU_MAX_STEPS_PER_UPDATE,
+        use_pallas=cfg.TPU_USE_PALLAS,
         death_method=cfg.DEATH_METHOD,
         age_limit=cfg.AGE_LIMIT,
         birth_method=cfg.BIRTH_METHOD,

@@ -22,9 +22,36 @@ import jax
 import jax.numpy as jnp
 
 from avida_tpu.ops import birth as birth_ops
+from avida_tpu.ops import pallas_cycles
 from avida_tpu.ops import resources as res_ops
 from avida_tpu.ops import scheduler as sched_ops
 from avida_tpu.ops.interpreter import micro_step
+
+
+def use_pallas_path(params) -> bool:
+    """Trace-time routing between the VMEM-resident Pallas cycle kernel
+    (ops/pallas_cycles.py) and the XLA micro-step loop.  TPU_USE_PALLAS:
+    0 = auto (kernel on a SINGLE real TPU chip when the environment
+    qualifies), 1 = force (kernel everywhere; interpret mode off-TPU --
+    tests use this; raises if the environment disqualifies the kernel),
+    2 = off.
+
+    Auto mode additionally requires a single visible device: pallas_call
+    registers no GSPMD partitioning rule, so a sharded multi-chip
+    update (parallel/mesh.py) must stay on the XLA while_loop path, which
+    GSPMD partitions cleanly."""
+    if params.use_pallas == 2:
+        return False
+    if params.use_pallas == 1:
+        if not pallas_cycles.eligible(params):
+            raise ValueError(
+                "TPU_USE_PALLAS=1 but the environment binds reactions to "
+                "resources, which the Pallas cycle kernel does not support "
+                "(ops/pallas_cycles.eligible); use TPU_USE_PALLAS=0 or 2")
+        return True
+    return (pallas_cycles.eligible(params)
+            and jax.device_count() == 1
+            and jax.devices()[0].platform == "tpu")
 
 
 @partial(jax.jit, static_argnums=0)
@@ -58,20 +85,28 @@ def update_step(params, st, key, neighbors, update_no):
 
     executed0 = st.insts_executed
 
-    def cond(carry):
-        s, _ = carry
-        return s < max_k
+    if use_pallas_path(params):
+        # whole-update cycle loop in one VMEM-resident kernel launch
+        # (ops/pallas_cycles.py); granted == min(budgets, cap) makes the
+        # per-block while_loop inside the kernel equivalent to the XLA
+        # while_loop below
+        st = pallas_cycles.run_cycles(params, st, k_steps, granted, int(cap))
+    else:
+        def cond(carry):
+            s, _ = carry
+            return s < max_k
 
-    def body(carry):
-        s, st = carry
-        # a freshly divided parent stalls until the end-of-update birth
-        # flush extracts its offspring from the tape (deferred h-divide;
-        # ops/interpreter.py header) -- it resumes next update
-        exec_mask = st.alive & (s < granted) & ~st.divide_pending
-        st = micro_step(params, st, jax.random.fold_in(k_steps, s), exec_mask)
-        return s + 1, st
+        def body(carry):
+            s, st = carry
+            # a freshly divided parent stalls until the end-of-update birth
+            # flush extracts its offspring from the tape (deferred h-divide;
+            # ops/interpreter.py header) -- it resumes next update
+            exec_mask = st.alive & (s < granted) & ~st.divide_pending
+            st = micro_step(params, st, jax.random.fold_in(k_steps, s),
+                            exec_mask)
+            return s + 1, st
 
-    _, st = jax.lax.while_loop(cond, body, (jnp.int32(0), st))
+        _, st = jax.lax.while_loop(cond, body, (jnp.int32(0), st))
     # bank whatever each organism earned but did not execute (cap or stall)
     executed_this = st.insts_executed - executed0
     carry = jnp.clip(budgets - executed_this, 0, 100 * params.ave_time_slice)
