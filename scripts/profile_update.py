@@ -1,0 +1,87 @@
+"""Breakdown of one update's wall time on the current backend.
+
+Times each stage of ops/update.update_step separately at bench scale:
+scheduler draw, pack, kernel launch, unpack, birth flush, and the fused
+whole update.  Run on TPU: `python scripts/profile_update.py [world]`.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+from bench import build  # noqa: E402
+
+
+def timeit(fn, *args, reps=10, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    from avida_tpu.ops import pallas_cycles, scheduler as sched_ops
+    from avida_tpu.ops import birth as birth_ops
+    from avida_tpu.ops.update import update_step
+
+    world = int(sys.argv[1]) if len(sys.argv) > 1 else 320
+    params, st, neighbors, key = build(world, world, 256, seed=100)
+    n = params.num_cells
+    cap = params.max_steps_per_update or 2 * params.ave_time_slice
+    print(f"world {world}x{world} = {n} cells, L={params.max_memory}, "
+          f"cap={cap}, platform={jax.devices()[0].platform}")
+
+    # advance a few updates so state is "typical"
+    for u in range(3):
+        key, k = jax.random.split(key)
+        st, _ = update_step(params, st, k, neighbors, jnp.int32(u))
+    jax.block_until_ready(st)
+
+    k_fixed = jax.random.key(42)
+
+    sched = jax.jit(lambda s, k: sched_ops.compute_budgets(params, s, k))
+    budgets = sched(st, k_fixed)
+    t_sched = timeit(sched, st, k_fixed)
+    granted = jnp.minimum(budgets, cap)
+
+    pack = jax.jit(lambda s, g: pallas_cycles.pack_state(params, s, g))
+    packed = pack(st, granted)
+    t_pack = timeit(pack, st, granted)
+
+    runp = jax.jit(lambda p, k: pallas_cycles.run_packed(params, p, k, cap))
+    t_kernel = timeit(runp, packed, k_fixed)
+
+    unpack = jax.jit(lambda s, p: pallas_cycles.unpack_state(params, s, p))
+    t_unpack = timeit(unpack, st, packed)
+
+    flush = jax.jit(lambda s, k: birth_ops.flush_births(
+        params, s, k, neighbors, jnp.int32(3)))
+    t_flush = timeit(flush, st, k_fixed)
+
+    t_full = timeit(
+        lambda s, k: update_step(params, s, k, neighbors, jnp.int32(3)),
+        st, k_fixed)
+
+    gsum = float(granted.sum())
+    print(f"scheduler: {t_sched*1e3:8.2f} ms")
+    print(f"pack:      {t_pack*1e3:8.2f} ms")
+    print(f"kernel:    {t_kernel*1e3:8.2f} ms   "
+          f"({gsum/t_kernel/1e6:.1f} M inst/s kernel-only)")
+    print(f"unpack:    {t_unpack*1e3:8.2f} ms")
+    print(f"flush:     {t_flush*1e3:8.2f} ms")
+    print(f"sum:       {(t_sched+t_pack+t_kernel+t_unpack+t_flush)*1e3:8.2f} ms")
+    print(f"full step: {t_full*1e3:8.2f} ms   "
+          f"({gsum/t_full/1e6:.1f} M inst/s end-to-end)")
+
+
+if __name__ == "__main__":
+    main()
