@@ -107,7 +107,7 @@ def flush_births(params, st, key, neighbors, update_no):
     # and is written directly at the target cell with no gather at all --
     # splitting these was worth ~2x on the whole birth flush at 100k cells.
     parent_updates = {
-        "tape": pack_tape(off_mem), "mem_len": off_len,
+        "mem_len": off_len,
         "genome": off_mem, "genome_len": off_len,
         "merit": st.merit,                       # parent post-DivideReset merit
         "last_task_count": st.last_task_count,   # inherited expectation
@@ -140,6 +140,11 @@ def flush_births(params, st, key, neighbors, update_no):
         dst = getattr(st, name)
         mask = births.reshape((n,) + (1,) * (src.ndim - 1))
         new_fields[name] = jnp.where(mask, src[parent_idx], dst)
+    # the newborn tape is the gathered offspring byte plane with flag bits
+    # clear: reuse the genome gather instead of gathering a second [N, L]
+    # plane
+    new_fields["tape"] = jnp.where(births[:, None],
+                                   pack_tape(new_fields["genome"]), st.tape)
     for name, val in const_updates.items():
         dst = getattr(st, name)
         mask = births.reshape((n,) + (1,) * (dst.ndim - 1))

@@ -93,7 +93,7 @@ NF = 8
 FLAG_MAL, FLAG_ALIVE, FLAG_DIVPEND = 1, 2, 4
 
 DEFAULT_BLOCK = 256
-CHUNK = 8            # sublane rows per register-resident traversal chunk
+CHUNK = 64           # sublane rows per register-resident traversal chunk
 
 
 def eligible(params) -> bool:
@@ -193,7 +193,12 @@ def _task_performed(lid, logic_mask_row):
 
 
 def _make_kernel(params, L, B, num_steps):
-    """Build the kernel body (params/L/B/num_steps are trace-time consts)."""
+    """Build the kernel body (params/L/B/num_steps are trace-time consts).
+
+    L is the CHUNK-padded tape height; semantic memory limits (h-alloc
+    growth cap, h-divide max offspring size) use the TRUE configured
+    max_memory so padding never changes physics."""
+    L0 = params.max_memory
     R = params.num_reactions
     NI = _ni(params)
     num_insts = params.num_insts
@@ -476,7 +481,7 @@ def _make_kernel(params, L, B, num_steps):
             alloc_size = jnp.minimum(
                 (params.offspring_size_range
                  * old_len.astype(jnp.float32)).astype(jnp.int32),
-                L - old_len)
+                L0 - old_len)
             alloc_ok = alloc_size >= 1
             if params.require_allocate:
                 alloc_ok = alloc_ok & ~mal_active
@@ -518,7 +523,7 @@ def _make_kernel(params, L, B, num_steps):
             min_sz = jnp.maximum(params.min_genome_len,
                                  (fsize / params.offspring_size_range
                                   ).astype(jnp.int32))
-            max_sz = jnp.minimum(L, (fsize * params.offspring_size_range
+            max_sz = jnp.minimum(L0, (fsize * params.offspring_size_range
                                      ).astype(jnp.int32))
             exec_count = exec_count0 + jnp.where(
                 div_try & ~ip_exec_already & (ip < parent_size), 1, 0)
@@ -843,7 +848,9 @@ def _make_kernel(params, L, B, num_steps):
 def _dims(params, n, L0):
     B = min(DEFAULT_BLOCK, max(128, 1 << (n - 1).bit_length()))
     n_pad = ((n + B - 1) // B) * B
-    L = (L0 + 7) & ~7
+    # L padded to a CHUNK multiple: every `range(L // CHUNK)` traversal in
+    # the kernel must cover the whole tape
+    L = ((L0 + CHUNK - 1) // CHUNK) * CHUNK
     return B, n_pad, L
 
 

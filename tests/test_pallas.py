@@ -30,7 +30,9 @@ def _mk_world(use_pallas: int) -> World:
     cfg = AvidaConfig()
     cfg.WORLD_X = 8
     cfg.WORLD_Y = 8
-    cfg.TPU_MAX_MEMORY = 256   # >= ~3x ancestor length: room for h-alloc
+    # >= ~3x ancestor length (room for h-alloc); deliberately NOT a multiple
+    # of the kernel CHUNK so the L-padding path in _dims is exercised
+    cfg.TPU_MAX_MEMORY = 200
     cfg.RANDOM_SEED = 11
     cfg.COPY_MUT_PROB = 0.0          # no PRNG inside the cycle loop
     cfg.DIVIDE_INS_PROB = 0.0
