@@ -48,8 +48,12 @@ def compute_budgets(params, st, key):
             # organism instead of an iterative binomial sampler (which
             # dominated the update profile at 100k organisms).  Documented
             # deviation stacked on the already-documented multinomial ->
-            # independent-binomials one; first-discovery statistics are
-            # unaffected (validated by the EQU-evolution harness).
+            # independent-binomials one.  The EQU-evolution harness
+            # (scripts/equ_harness.py, results in EQU_r03.json) measures
+            # first-discovery statistics under the full lockstep scheduler;
+            # note this normal-approximation branch only engages at n >=
+            # 32768, above the harness's 60x60 world -- at bench scale it
+            # changes per-update budgets by <1 cycle rms.
             lam = p * ud_size.astype(jnp.float32)
             z = jax.random.normal(key, (n,))
             k = jnp.round(lam + jnp.sqrt(jnp.maximum(lam, 0.0)) * z)

@@ -140,6 +140,31 @@ def _point_mutation_sweep(params, st, key):
     return st.replace(tape=jnp.where(hit, mutated, st.tape))
 
 
+@partial(jax.jit, static_argnums=(0, 2))
+def update_scan(params, st, chunk, run_key, neighbors, u0):
+    """Run `chunk` consecutive updates in ONE device program (lax.scan).
+
+    Per-update host dispatch costs dominate small worlds (and any remote
+    device path); the World driver batches event-free stretches through
+    this.  The per-update PRNG key is fold_in(run_key, update_no), making
+    the random stream a pure function of the seed and the update number --
+    trajectories are bit-identical however the driver chunks the run
+    (chunked vs single-step, any event schedule).  Returns the final state
+    plus per-update int32[chunk] vectors of executed instructions, births
+    and deaths, and f32[chunk] avida-time deltas and average generations
+    (all the host bookkeeping World needs, at update granularity)."""
+    def body(st, i):
+        k = jax.random.fold_in(run_key, u0 + i)
+        alive_before = st.alive.sum()
+        st, executed = update_step(params, st, k, neighbors, u0 + i)
+        ave_gest, ave_gen, n_alive, births = light_stats(params, st, u0 + i)
+        deaths = jnp.maximum(alive_before + births - n_alive, 0)
+        dt = jnp.where(ave_gest > 0, 1.0 / jnp.maximum(ave_gest, 1e-9), 0.0)
+        return st, (executed, births, deaths, dt, ave_gen, n_alive)
+    st, outs = jax.lax.scan(body, st, jnp.arange(chunk))
+    return st, outs
+
+
 @partial(jax.jit, static_argnums=0)
 def summarize(params, st, update_no=jnp.int32(-1)):
     """Device-side reduction of per-update stats (feeds cStats/.dat output;

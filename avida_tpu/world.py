@@ -23,7 +23,7 @@ from avida_tpu.config.events import Event, parse_event_line
 from avida_tpu.core.state import (init_population, make_world_params,
                                   PopulationState)
 from avida_tpu.ops import birth as birth_ops
-from avida_tpu.ops.update import update_step, summarize, light_stats
+from avida_tpu.ops.update import update_scan, summarize
 from avida_tpu.utils import output as output_mod
 
 # Reference default ancestor (support/config/default-heads.org): h-alloc,
@@ -88,6 +88,10 @@ class World:
 
         seed = cfg.RANDOM_SEED if cfg.RANDOM_SEED >= 0 else int.from_bytes(os.urandom(4), "little")
         self.key = jax.random.key(seed)
+        # the run stream: per-update keys are fold_in(_run_key, update_no),
+        # a pure function of the seed -- trajectories don't depend on how
+        # the driver chunks updates (ops/update.update_scan)
+        self.key, self._run_key = jax.random.split(self.key)
         self.update = 0
         self.state: PopulationState | None = None
         self._exit = False
@@ -170,9 +174,12 @@ class World:
         return self._summary_stats
 
     def _flush_exec(self) -> int:
-        """Drain queued per-update executed counts into the host total."""
+        """Drain queued per-update executed counts into the host total.
+        Entries are int32[k] device vectors; summing in int64 on the host
+        keeps long uncapped runs from overflowing."""
         if self._pending_exec:
-            self._cum_insts += int(sum(int(x) for x in self._pending_exec))
+            self._cum_insts += int(sum(
+                np.asarray(x, dtype=np.int64).sum() for x in self._pending_exec))
             self._pending_exec = []
         return self._cum_insts
 
@@ -324,25 +331,56 @@ class World:
     # ---- the master update loop (Avida2Driver::Run equivalent) ----
 
     def run_update(self):
-        assert self.state is not None, "no population injected"
-        self.key, k = jax.random.split(self.key)
-        self.state, executed = update_step(
-            self.params, self.state, k, self.neighbors, jnp.int32(self.update))
+        """Run ONE update (does not advance self.update; callers do).
+        Device-side bookkeeping lives in ops/update.update_scan -- this is
+        the chunk-of-1 case plus the per-update systematics feed."""
+        executed = self._scan_updates(1)
         if self.systematics is not None:
             self._feed_systematics()
+        return executed
+
+    def run_updates(self, k: int):
+        """Run k consecutive updates as one device program (ops/update.py
+        update_scan) -- no per-update host dispatch.  Only valid when no
+        event is due inside the window and systematics is off (the
+        phylogeny needs per-update newborn attribution); World.run picks
+        the stretch length.  Advances self.update by k."""
+        executed = self._scan_updates(k)
+        self.update += k
+        return executed
+
+    def _scan_updates(self, k: int):
+        """Common device path: returns the per-update executed-count vector
+        (int32[k] device array; host sums in int64 at flush time)."""
+        assert self.state is not None, "no population injected"
+        self.state, (executed, births, deaths, dts, ave_gens, n_alive) = \
+            update_scan(self.params, self.state, k, self._run_key,
+                        self.neighbors, jnp.int32(self.update))
         # avida time advances by 1/ave_gestation per update (the reference's
         # cStats::ProcessUpdate bookkeeping).  All accumulators stay device-
         # side scalars -- no host sync in the update loop.
-        ave_gest, self._last_ave_gen, n_alive, births = light_stats(
-            self.params, self.state, jnp.int32(self.update))
-        self._avida_time = self._avida_time + jnp.where(
-            ave_gest > 0, 1.0 / jnp.maximum(ave_gest, 1e-9), 0.0)
-        if self._prev_alive is not None:
-            # deaths this update = prev alive + births - now alive
-            self._deaths_this = jnp.maximum(
-                self._prev_alive + births - n_alive, 0)
-        self._prev_alive = n_alive
+        self._avida_time = self._avida_time + dts.sum()
+        self._last_ave_gen = ave_gens[-1]
+        self._deaths_this = deaths[-1]
+        self._prev_alive = n_alive[-1]
         return executed
+
+    def _next_event_due(self) -> float:
+        """Earliest update > self.update at which any update-trigger event
+        fires (inf if none).  Generation/immediate triggers are handled by
+        the caller (they force per-update stepping)."""
+        nxt = float("inf")
+        for ev in self.events:
+            if ev.trigger != "update":
+                continue
+            if self.update < ev.start:
+                nxt = min(nxt, ev.start)
+            elif ev.interval > 0:
+                k = (self.update - ev.start) // ev.interval
+                cand = ev.start + (k + 1) * ev.interval
+                if cand <= ev.stop:
+                    nxt = min(nxt, cand)
+        return nxt
 
     def _feed_systematics(self):
         """Hand this update's newborn rows to the host-side phylogeny.
@@ -372,18 +410,35 @@ class World:
             if self.state is None:
                 self.inject()
         start_insts = self._cum_insts
+        # event-free stretches run as one device program; anything needing
+        # per-update host work (systematics, generation triggers) forces
+        # single stepping
+        can_chunk = (self.systematics is None and
+                     not any(ev.trigger == "generation" for ev in self.events))
         while not self._exit:
             if max_updates is not None and self.update >= max_updates:
                 break
             self.process_events()
             if self._exit:
                 break
-            executed = self.run_update()
-            # queue the device scalar; host-sync only at report boundaries
-            self._pending_exec.append(executed)
+            stretch = 1
+            if can_chunk:
+                due = self._next_event_due()
+                if max_updates is not None:
+                    due = min(due, max_updates)
+                gap = int(max(1.0, min(due - self.update, 128.0)))
+                # power-of-two stretch buckets: at most 8 compiled variants
+                # of the scanned update program instead of one per distinct
+                # gap length
+                stretch = 1 << (gap.bit_length() - 1)
+            if stretch > 1:
+                self._pending_exec.append(self.run_updates(stretch))
+            else:
+                # queue the device vector; host-sync at report boundaries
+                self._pending_exec.append(self.run_update())
+                self.update += 1
             if len(self._pending_exec) >= 256:
                 self._flush_exec()
-            self.update += 1
             if self.systematics is not None and self.update % 100 == 0:
                 self.systematics.prune_extinct(keep_ancestry=True)
         for f in self._files.values():

@@ -1,0 +1,35 @@
+"""CI-runnable EQU-harness variant: the task ladder must progress.
+
+Small-world, capped-updates version of scripts/equ_harness.py (the
+north-star correctness harness, BASELINE.json "matching CPU
+updates-to-EQU").  Asserts that evolution actually works end to end: from
+a single default ancestor, copy-mutations + merit-proportional scheduling
++ logic-9 rewards must discover multiple logic tasks within a bounded
+number of updates.  Full-scale numbers (60x60, 5 seeds, EQU) are recorded
+in EQU_r03.json by the script; the reference's own golden window
+(heads_default_100u expected/data/tasks.dat) is all zeros through update
+100, so ladder progression is the only CI-scale observable.
+"""
+
+from __future__ import annotations
+
+import sys
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+from equ_harness import run_seed  # noqa: E402
+
+
+def test_task_ladder_progresses():
+    # copy_mut above stock (0.02 vs 0.0075) compresses the discovery
+    # timescale so the ladder moves within a CPU-friendly update budget;
+    # stock-rate physics is exercised by the full-scale script on TPU
+    r = run_seed(seed=1009, world=24, max_updates=1500, check_every=150,
+                 uncapped=False, copy_mut=0.02)
+    first = r["first_task_update"]
+    assert first["not"] is not None or first["nand"] is not None, (
+        f"no first-tier logic task discovered in 1200 updates: {first}")
+    assert r["tasks_discovered"] >= 2, (
+        f"task ladder did not progress past one task: {first}")
+    assert r["final_organisms"] > 100, "population failed to fill the world"
