@@ -114,11 +114,23 @@ _HEADS_DEFAULT_NAMES = [
 
 def default_instset() -> InstSet:
     """The stock heads_default set (ref support/config/instset-heads.cfg)."""
-    n = len(_HEADS_DEFAULT_NAMES)
+    return _make_set("heads_default", _HEADS_DEFAULT_NAMES)
+
+
+def heads_sex_instset() -> InstSet:
+    """The heads_sex set: heads_default with h-divide replaced by
+    divide-sex (ref support/config/instset-heads-sex.cfg)."""
+    names = ["divide-sex" if n == "h-divide" else n
+             for n in _HEADS_DEFAULT_NAMES]
+    return _make_set("heads_sex", names)
+
+
+def _make_set(name: str, names) -> InstSet:
+    n = len(names)
     ones = np.ones(n)
     zeros = np.zeros(n)
     return InstSet(
-        name="heads_default", hw_type=0, inst_names=list(_HEADS_DEFAULT_NAMES),
+        name=name, hw_type=0, inst_names=list(names),
         redundancy=ones.copy(), cost=zeros.astype(np.int32),
         ft_cost=zeros.astype(np.int32), energy_cost=zeros.copy(),
         prob_fail=zeros.copy(), addl_time_cost=zeros.astype(np.int32),

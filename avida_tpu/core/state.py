@@ -54,6 +54,7 @@ class WorldParams(struct.PyTreeNode):
     point_mut_prob: float = struct.field(pytree_node=False, default=0.0)
     # divide restrictions
     offspring_size_range: float = struct.field(pytree_node=False, default=2.0)
+    recombination_prob: float = struct.field(pytree_node=False, default=1.0)
     min_copied_lines: float = struct.field(pytree_node=False, default=0.5)
     min_exe_lines: float = struct.field(pytree_node=False, default=0.5)
     require_allocate: bool = struct.field(pytree_node=False, default=True)
@@ -137,6 +138,7 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         div_mut_prob=cfg.DIV_MUT_PROB,
         point_mut_prob=cfg.POINT_MUT_PROB,
         offspring_size_range=cfg.OFFSPRING_SIZE_RANGE,
+        recombination_prob=cfg.RECOMBINATION_PROB,
         min_copied_lines=cfg.MIN_COPIED_LINES,
         min_exe_lines=cfg.MIN_EXE_LINES,
         require_allocate=bool(cfg.REQUIRE_ALLOCATE),
@@ -255,6 +257,16 @@ class PopulationState(struct.PyTreeNode):
     off_start: jax.Array      # int32[N]   offspring start position on tape
     off_len: jax.Array        # int32[N]
     off_copied_size: jax.Array  # int32[N]
+    off_sex: jax.Array        # bool[N]    offspring awaits a mate (divide-sex;
+                              # ref cPhenotype divide_sex + cBirthChamber)
+
+    # --- birth chamber waiting store (ref cBirthChamber mate storage,
+    # cBirthGlobalHandler): ONE waiting sexual offspring; greedy in-update
+    # pairing guarantees at most one leftover per flush ---
+    bc_mem: jax.Array         # int8[L]    waiting offspring genome
+    bc_len: jax.Array         # int32[]    its length
+    bc_merit: jax.Array       # f32[]      submitting parent's merit
+    bc_valid: jax.Array       # bool[]     entry occupied
 
     # --- systematics hooks ---
     genotype_id: jax.Array    # int32[N]    host-assigned genotype ids (-1 unknown)
@@ -308,7 +320,9 @@ def zeros_population(n: int, L: int, R: int, n_global_res: int = 0,
         breed_true=jnp.zeros(n, bool),
         divide_pending=jnp.zeros(n, bool),
         off_start=i32(n), off_len=i32(n),
-        off_copied_size=i32(n),
+        off_copied_size=i32(n), off_sex=jnp.zeros(n, bool),
+        bc_mem=jnp.zeros(L, jnp.int8), bc_len=jnp.zeros((), jnp.int32),
+        bc_merit=jnp.zeros((), jnp.float32), bc_valid=jnp.zeros((), bool),
         genotype_id=jnp.full(n, -1, jnp.int32), parent_id=jnp.full(n, -1, jnp.int32),
         birth_update=jnp.full(n, -1, jnp.int32),
         insts_executed=i32(n),

@@ -48,9 +48,10 @@ MOD_NONE, MOD_REG, MOD_HEAD, MOD_LABEL = 0, 1, 2, 3
     SEM_ADD, SEM_SUB, SEM_NAND,
     SEM_H_COPY, SEM_H_ALLOC, SEM_H_DIVIDE,
     SEM_IO, SEM_H_SEARCH,
-) = range(26)
+    SEM_H_DIVIDE_SEX,
+) = range(27)
 
-NUM_SEMANTIC_OPS = 26
+NUM_SEMANTIC_OPS = 27
 
 
 @dataclass(frozen=True)
@@ -104,6 +105,11 @@ INSTRUCTIONS = {
                         "extend memory by OFFSPRING_SIZE_RANGE*len; AX<-old len (cc:3294)"),
     "h-divide": InstSpec("h-divide", SEM_H_DIVIDE, MOD_NONE, 0,
                          "divide at READ..WRITE (cc:6961,1775)"),
+    "divide-sex": InstSpec(
+        "divide-sex", SEM_H_DIVIDE_SEX, MOD_NONE, 0,
+        "h-divide with sexual offspring: SetDivideSex(true)+CrossNum(1) "
+        "then Divide_Main (Inst_HeadDivideSex, cc:7019-7023); offspring "
+        "recombine in the birth chamber (cBirthChamber.cc:443)"),
     "IO": InstSpec("IO", SEM_IO, MOD_REG, REG_BX,
                    "output ?BX?, check tasks, input next (cc:4188)"),
     "h-search": InstSpec("h-search", SEM_H_SEARCH, MOD_LABEL, 0,
@@ -115,6 +121,7 @@ ALIASES = {
     "nop-a": "nop-A", "nop-b": "nop-B", "nop-c": "nop-C",
     "nop-x": "nop-A",  # placeholder; nop-X is a true no-op in extended sets
     "io": "IO",
+    "div-sex": "divide-sex",   # cHardwareCPU.cc:394 registers both names
 }
 
 

@@ -20,6 +20,164 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from avida_tpu.models.heads import SEM_H_DIVIDE_SEX
+
+
+def has_divide_sex(params) -> bool:
+    """Static: does the loaded instruction set contain divide-sex?"""
+    return any(int(s) == SEM_H_DIVIDE_SEX for s in params.sem)
+
+
+def _roll_right(plane, r, L):
+    """Per-row circular roll: out[n, q] = plane[n, (q - r[n]) mod L], as
+    log2(L) static jnp.roll steps (no per-row gather)."""
+    r = r % L
+    out = plane
+    k, b = 1, 0
+    while k < L:
+        bit = (r >> b) & 1
+        out = jnp.where((bit == 1)[:, None], jnp.roll(out, k, axis=1), out)
+        k <<= 1
+        b += 1
+    return out
+
+
+def recombine_sexual(params, st, key, off_mem, off_len, pending):
+    """Birth-chamber mate pairing + one-region crossover, lockstep style.
+
+    The reference stores a sexual offspring in the birth chamber until a
+    mate arrives, then swaps a random region between the two genomes and
+    mixes merits by the cut fraction (cBirthChamber::SubmitOffspring
+    cc:443, DoBasicRecombination cc:290, RegionSwap cc:178).  Lockstep
+    model: all sexual offspring pending at flush time -- the waiting store
+    entry first, then cells in index order -- pair consecutively (rank r
+    mates rank r^1).  Greedy pairing leaves at most ONE leftover, which
+    moves INTO the single-entry store and its parent resumes (no stall --
+    exactly the reference's waiting semantics).  Each paired parent row
+    builds the child that keeps its own genome's flanks; the merit mix
+    follows the content (stay/cut weighting), which reproduces the
+    reference's majority-rule GenomeSwap pairing of genome and merit.  The
+    row paired WITH the store is a dual parent: it also carries the
+    store-flank child, which flush_births places as a second birth.
+    Documented deviation: children are placed near their flank parent (the
+    store child near its mate's parent) rather than both near the
+    chamber-submitting parent.
+
+    Returns (off_mem, off_len, child_merit, placeable_pending, dual, and
+    the dual store-child fields (mem, len, merit), plus the updated store
+    tuple (bc_mem, bc_len, bc_merit, bc_valid)).
+    """
+    n, L = off_mem.shape
+    rows = jnp.arange(n)
+    sexp = pending & st.off_sex
+    has_store = st.bc_valid
+
+    # rank sexual rows by cell index, shifted by 1 when the store entry is
+    # occupied (the store is rank 0); rank r mates rank r^1
+    rank = jnp.cumsum(sexp) - 1 + has_store.astype(jnp.int32)
+    total = sexp.sum() + has_store.astype(jnp.int32)
+    mate_rank = rank ^ 1
+    paired = sexp & (mate_rank < total)
+    store_paired = sexp & paired & (mate_rank == 0) & has_store  # <=1 row
+    rank_to_row = jnp.zeros(n, jnp.int32).at[
+        jnp.where(sexp, rank, n)].set(rows.astype(jnp.int32), mode="drop")
+    mate_row = rank_to_row[jnp.clip(mate_rank, 0, n - 1)]
+
+    # mate genome/length/merit come from the store for the store-paired row
+    mate_mem = jnp.where(store_paired[:, None], st.bc_mem[None, :].astype(jnp.int8),
+                         off_mem[mate_row])
+    mate_len = jnp.where(store_paired, st.bc_len,
+                         jnp.where(paired, off_len[mate_row], 1))
+    mate_len = jnp.maximum(mate_len, 1)
+    mate_merit = jnp.where(store_paired, st.bc_merit.astype(st.merit.dtype),
+                           st.merit[mate_row])
+    own_len = jnp.maximum(off_len, 1)
+
+    # per-pair draws: both members must see identical randomness, so draw
+    # per-row and read the pair representative's values (the store-paired
+    # row is its own representative)
+    k_rec, k_s, k_e = jax.random.split(key, 3)
+    pair_lo = jnp.where(store_paired, rows, jnp.minimum(rows, mate_row))
+    u_rec = jax.random.uniform(k_rec, (n,))[pair_lo]
+    f0 = jax.random.uniform(k_s, (n,))[pair_lo]
+    f1 = jax.random.uniform(k_e, (n,))[pair_lo]
+    start_frac = jnp.minimum(f0, f1)
+    end_frac = jnp.maximum(f0, f1)
+    cut_frac = end_frac - start_frac
+
+    s0 = (start_frac * own_len.astype(jnp.float32)).astype(jnp.int32)
+    e0 = (end_frac * own_len.astype(jnp.float32)).astype(jnp.int32)
+    s1 = (start_frac * mate_len.astype(jnp.float32)).astype(jnp.int32)
+    e1 = (end_frac * mate_len.astype(jnp.float32)).astype(jnp.int32)
+    size0 = e0 - s0
+    size1 = e1 - s1
+    new_len = off_len - size0 + size1
+    new_len_mate = mate_len - size1 + size0
+    # RegionSwap refuses illegal offspring on either side (cc:193-196)
+    legal = ((new_len >= params.min_genome_len) & (new_len <= L) &
+             (new_len_mate >= params.min_genome_len) & (new_len_mate <= L))
+    do_rec = paired & (u_rec < params.recombination_prob) & legal
+
+    # own-flank child = own[:s0] ++ mate[s1:e1] ++ own[e0:]
+    cols = jnp.arange(L)
+    mate_shifted = _roll_right(mate_mem, s0 - s1, L)
+    own_shifted = _roll_right(off_mem, s0 + size1 - e0, L)
+    child = jnp.where(cols[None, :] < s0[:, None], off_mem,
+                      jnp.where(cols[None, :] < (s0 + size1)[:, None],
+                                mate_shifted, own_shifted))
+    child = jnp.where(cols[None, :] < new_len[:, None], child, jnp.int8(0))
+
+    # store-flank child (only meaningful on the dual row) =
+    # mate[:s1] ++ own[s0:e0] ++ mate[e1:]
+    own_shifted2 = _roll_right(off_mem, s1 - s0, L)
+    mate_shifted2 = _roll_right(mate_mem, s1 + size0 - e1, L)
+    child2 = jnp.where(cols[None, :] < s1[:, None], mate_mem,
+                       jnp.where(cols[None, :] < (s1 + size0)[:, None],
+                                 own_shifted2, mate_shifted2))
+    child2 = jnp.where(cols[None, :] < new_len_mate[:, None], child2,
+                       jnp.int8(0))
+    dual = store_paired
+    dual_mem = jnp.where(do_rec[:, None], child2, mate_mem)
+    dual_len = jnp.where(do_rec, new_len_mate, mate_len)
+
+    stay = 1.0 - cut_frac
+    # merit mixing: merit' = own*stay + mate*cut (DoBasicRecombination)
+    child_merit = jnp.where(
+        do_rec,
+        (st.merit * stay + mate_merit * cut_frac).astype(st.merit.dtype),
+        st.merit)
+    dual_merit = jnp.where(
+        do_rec, (mate_merit * stay + st.merit * cut_frac).astype(st.merit.dtype),
+        mate_merit)
+
+    off_mem = jnp.where(do_rec[:, None], child, off_mem)
+    off_len = jnp.where(do_rec, new_len, off_len)
+
+    # the odd one out (rank == total-1 with total odd) moves into the store
+    # and its parent resumes
+    leftover = sexp & ~paired                              # <=1 row
+    any_left = leftover.any()
+    left_sel = leftover[:, None]
+    new_bc_mem = jnp.where(any_left,
+                           jnp.sum(jnp.where(left_sel, off_mem, 0), axis=0,
+                                   dtype=jnp.int32).astype(jnp.int8),
+                           st.bc_mem)
+    new_bc_len = jnp.where(any_left,
+                           jnp.sum(jnp.where(leftover, off_len, 0)),
+                           st.bc_len)
+    new_bc_merit = jnp.where(
+        any_left,
+        jnp.sum(jnp.where(leftover, st.merit, 0)).astype(jnp.float32),
+        st.bc_merit.astype(jnp.float32))
+    # store consumed when something paired with it; (re)filled by leftover
+    new_bc_valid = jnp.where(any_left, True,
+                             has_store & ~store_paired.any())
+
+    placeable = pending & ~leftover
+    store = (new_bc_mem, new_bc_len, new_bc_merit, new_bc_valid)
+    return (off_mem, off_len, child_merit, placeable,
+            dual, dual_mem, dual_len, dual_merit, store)
+
 
 def neighbor_table(world_x: int, world_y: int, geometry: int) -> np.ndarray:
     """Static [N, 8] neighbor cell ids (ref cPopulation::SetupCellGrid
@@ -53,10 +211,32 @@ def flush_births(params, st, key, neighbors, update_no):
     """Place pending offspring.  neighbors: int32[N, 8] static table."""
     n, L = st.tape.shape
     rows = jnp.arange(n)
-    k_place, k_inputs, k_off = jax.random.split(key, 3)
+    k_place, k_inputs, k_off, k_sex = jax.random.split(key, 4)
     # a parent that died while its offspring waited loses the offspring too
     # (the reference's pending birth dies with the parent's cell state)
     pending = st.divide_pending & st.alive
+
+    # materialize offspring genomes (deferred h-divide half + divide
+    # mutations; ops/interpreter.extract_offspring)
+    from avida_tpu.core.state import make_cell_inputs
+    from avida_tpu.ops.interpreter import extract_offspring, pack_tape
+    off_mem, off_len = extract_offspring(params, st, k_off)
+    fresh_inputs = make_cell_inputs(k_inputs, n)
+
+    # sexual offspring pair + recombine in the birth chamber BEFORE
+    # placement (mutations precede SubmitOffspring in the reference too);
+    # the odd one out moves into the waiting store and leaves `pending`
+    child_merit = st.merit
+    sexual = has_divide_sex(params)
+    leftover = jnp.zeros(n, bool)
+    dual = jnp.zeros(n, bool)
+    dual_mem = dual_len = dual_merit = None
+    store = None
+    if sexual:
+        (off_mem, off_len, child_merit, pending,
+         dual, dual_mem, dual_len, dual_merit, store) = recombine_sexual(
+            params, st, k_sex, off_mem, off_len, pending)
+        leftover = (st.divide_pending & st.alive) & ~pending
 
     # ---- target selection (PositionOffspring, cc:5185; BIRTH_METHOD 0) ----
     cand = neighbors                                  # [N, 8]
@@ -84,13 +264,6 @@ def flush_births(params, st, key, neighbors, update_no):
     parent_idx = jnp.clip(claim, 0, n - 1)  # int[N]: who fathered it
     won = pending & (claim[target] == rows)
 
-    # materialize offspring genomes (deferred h-divide half + divide
-    # mutations; ops/interpreter.extract_offspring)
-    from avida_tpu.core.state import make_cell_inputs
-    from avida_tpu.ops.interpreter import extract_offspring, pack_tape
-    off_mem, off_len = extract_offspring(params, st, k_off)
-    fresh_inputs = make_cell_inputs(k_inputs, n)
-
     # breed-true: offspring genome identical to parent's birth genome
     # (ref cPhenotype copy_true; feeds count.dat/average.dat breed stats)
     cols = jnp.arange(L)
@@ -109,7 +282,9 @@ def flush_births(params, st, key, neighbors, update_no):
     parent_updates = {
         "mem_len": off_len,
         "genome": off_mem, "genome_len": off_len,
-        "merit": st.merit,                       # parent post-DivideReset merit
+        "merit": child_merit,                    # parent post-DivideReset
+                                                 # merit; recombination-mixed
+                                                 # for sexual pairs
         "last_task_count": st.last_task_count,   # inherited expectation
         "gestation_time": st.gestation_time,     # parent's (SetupOffspring)
         "fitness": st.fitness, "last_bonus": st.last_bonus,
@@ -153,10 +328,83 @@ def flush_births(params, st, key, neighbors, update_no):
     # inherited -- indexed by target cell, so no gather either)
     new_fields["inputs"] = jnp.where(births[:, None], fresh_inputs, st.inputs)
 
+    if sexual:
+        # second child of the store-paired dual row: place at another of
+        # the dual parent's neighbor cells, avoiding every cell already
+        # claimed this flush (at most one dual row exists, so dual
+        # placements never conflict with each other)
+        claimed2 = births[cand]                           # [N, C]
+        score2 = u - jnp.where(claimed2, 100.0, 0.0) \
+            - jnp.where(jnp.arange(ncand)[None, :] == choice[:, None],
+                        200.0, 0.0)
+        if params.prefer_empty:
+            score2 = score2 + jnp.where(~occupied, 10.0, 0.0)
+        choice2 = jnp.argmax(score2, axis=1)
+        target2 = cand[rows, choice2]
+        dual_born = dual & won & ~births[target2]
+        b2 = jnp.zeros(n, bool).at[jnp.where(dual_born, target2, n)].set(
+            True, mode="drop")
+        p2 = jnp.full(n, 0, jnp.int32).at[
+            jnp.where(dual_born, target2, n)].set(rows.astype(jnp.int32),
+                                                  mode="drop")
+
+        def apply_dual(nf):
+            parent2 = {
+                "mem_len": dual_len, "genome": dual_mem,
+                "genome_len": dual_len, "merit": dual_merit,
+                "last_task_count": st.last_task_count,
+                "gestation_time": st.gestation_time, "fitness": st.fitness,
+                "last_bonus": st.last_bonus,
+                "last_merit_base": st.last_merit_base,
+                "executed_size": st.executed_size,
+                "copied_size": st.child_copied_size,
+                "generation": st.generation,
+                "max_executed": jnp.where(
+                    params.death_method == 2, params.age_limit * dual_len,
+                    jnp.where(params.death_method == 1, params.age_limit,
+                              2**30)),
+                "breed_true": jnp.zeros(n, bool),
+                "parent_id": rows.astype(jnp.int32),
+            }
+            nf = dict(nf)
+            for name, srca in parent2.items():
+                dst = nf[name]
+                mask = b2.reshape((n,) + (1,) * (srca.ndim - 1))
+                nf[name] = jnp.where(mask, srca[p2], dst)
+            nf["tape"] = jnp.where(
+                b2[:, None], pack_tape(nf["genome"]), nf["tape"])
+            for name, val in const_updates.items():
+                dst = nf[name]
+                mask = b2.reshape((n,) + (1,) * (dst.ndim - 1))
+                nf[name] = jnp.where(mask, jnp.asarray(val, dst.dtype), dst)
+            nf["inputs"] = jnp.where(b2[:, None], fresh_inputs, nf["inputs"])
+            return nf
+
+        # the dual merge doubles the flush's field writes; gate it on a
+        # dual birth actually happening this flush (usually absent)
+        new_fields = jax.lax.cond(dual_born.any(), apply_dual,
+                                  lambda nf: dict(nf), new_fields)
+        births = births | b2
+
     st = st.replace(**new_fields)
-    # winners' (and dead parents') pending flags clear; living losers retry
-    # next update; a parent cell overwritten by a newborn is already governed
-    # by the newborn state
-    cleared = jnp.where(won | ~st.alive, False, st.divide_pending)
-    st = st.replace(divide_pending=cleared)
+    if sexual:
+        bc_mem, bc_len, bc_merit, bc_valid = store
+        # transactional store: if the dual row existed but its store child
+        # could not be placed (placement conflict), the original waiting
+        # entry is NOT consumed -- unless a new leftover already took the
+        # single slot (bounded-store drop, documented)
+        restore = dual.any() & ~b2.any() & ~bc_valid
+        bc_mem = jnp.where(restore, st.bc_mem, bc_mem)
+        bc_len = jnp.where(restore, st.bc_len, bc_len)
+        bc_merit = jnp.where(restore, st.bc_merit, bc_merit)
+        bc_valid = bc_valid | restore
+        st = st.replace(bc_mem=bc_mem, bc_len=bc_len, bc_merit=bc_merit,
+                        bc_valid=bc_valid)
+    # winners' (and dead parents') pending flags clear; a leftover sexual
+    # offspring moved into the birth-chamber store, so its parent resumes
+    # too; living losers retry next update; a parent cell overwritten by a
+    # newborn is already governed by the newborn state
+    cleared = jnp.where(won | leftover | ~st.alive, False, st.divide_pending)
+    st = st.replace(divide_pending=cleared,
+                    off_sex=st.off_sex & cleared)
     return st

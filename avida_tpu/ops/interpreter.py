@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from avida_tpu.models.heads import (
     MOD_HEAD, MOD_LABEL, MOD_NONE, MOD_REG,
     SEM_ADD, SEM_DEC, SEM_GET_HEAD, SEM_H_ALLOC, SEM_H_COPY, SEM_H_DIVIDE,
+    SEM_H_DIVIDE_SEX,
     SEM_H_SEARCH, SEM_IF_LABEL, SEM_IF_LESS, SEM_IF_N_EQU, SEM_INC, SEM_IO,
     SEM_JMP_HEAD, SEM_MOV_HEAD, SEM_NAND, SEM_POP, SEM_PUSH, SEM_SET_FLOW,
     SEM_SHIFT_L, SEM_SHIFT_R, SEM_SUB, SEM_SWAP, SEM_SWAP_STK,
@@ -340,8 +341,11 @@ def micro_step(params, st, key, exec_mask):
     read_label_len = jnp.where(ri_clear, 0,
                                jnp.where(can_append, rl_len + 1, rl_len))
 
-    # ---- h-divide (Inst_HeadDivide cc:6961 -> Divide_Main cc:1775) ----
-    div_try = is_op(SEM_H_DIVIDE)
+    # ---- h-divide (Inst_HeadDivide cc:6961 -> Divide_Main cc:1775);
+    # divide-sex (Inst_HeadDivideSex cc:7019) is the same division with the
+    # offspring flagged sexual -- it waits for a mate in the birth engine ----
+    div_sex_try = is_op(SEM_H_DIVIDE_SEX)
+    div_try = is_op(SEM_H_DIVIDE) | div_sex_try
     div_point = rp
     gsize = st.genome_len
     fsize = gsize.astype(jnp.float32)
@@ -527,6 +531,7 @@ def micro_step(params, st, key, exec_mask):
         divide_pending=st.divide_pending | div_m,
         off_start=off_start, off_len=off_len,
         off_copied_size=jnp.where(div_m, copied_count, st.off_copied_size),
+        off_sex=jnp.where(div_m, div_sex_try, st.off_sex),
         insts_executed=insts_executed,
         resources=resources, res_grid=res_grid,
     )

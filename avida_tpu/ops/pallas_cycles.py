@@ -99,7 +99,12 @@ CHUNK = 64           # sublane rows per register-resident traversal chunk
 def eligible(params) -> bool:
     """True when the per-organism fast path is semantically exact: no
     reaction binds a resource (every process is infinite-resource), so one
-    update's cycles never couple organisms through shared pools."""
+    update's cycles never couple organisms through shared pools, and the
+    instruction set contains no semantics the kernel doesn't implement
+    (divide-sex needs the off_sex flag the packed layout doesn't carry)."""
+    from avida_tpu.models.heads import SEM_H_DIVIDE_SEX
+    if any(int(s) == SEM_H_DIVIDE_SEX for s in params.sem):
+        return False
     return all(r < 0 for r in params.proc_res_idx)
 
 

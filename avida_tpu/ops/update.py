@@ -45,9 +45,10 @@ def use_pallas_path(params) -> bool:
     if params.use_pallas == 1:
         if not pallas_cycles.eligible(params):
             raise ValueError(
-                "TPU_USE_PALLAS=1 but the environment binds reactions to "
-                "resources, which the Pallas cycle kernel does not support "
-                "(ops/pallas_cycles.eligible); use TPU_USE_PALLAS=0 or 2")
+                "TPU_USE_PALLAS=1 but this configuration disqualifies the "
+                "Pallas cycle kernel (ops/pallas_cycles.eligible): either a "
+                "reaction binds a resource, or the instruction set contains "
+                "divide-sex; use TPU_USE_PALLAS=0 or 2")
         return True
     return (pallas_cycles.eligible(params)
             and jax.device_count() == 1

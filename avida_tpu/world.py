@@ -16,8 +16,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from avida_tpu.config import (AvidaConfig, load_avida_cfg, load_instset,
-                              default_instset, load_organism,
-                              load_environment, load_events)
+                              default_instset, heads_sex_instset,
+                              load_organism, load_environment, load_events)
 from avida_tpu.config.environment import default_logic9_environment
 from avida_tpu.config.events import Event, parse_event_line
 from avida_tpu.core.state import (init_population, make_world_params,
@@ -38,7 +38,12 @@ _DEFAULT_ANCESTOR_NAMES = (
 
 def default_ancestor(instset) -> np.ndarray:
     name_to_op = {n: i for i, n in enumerate(instset.inst_names)}
-    return np.asarray([name_to_op[n] for n in _DEFAULT_ANCESTOR_NAMES], np.int8)
+    names = _DEFAULT_ANCESTOR_NAMES
+    if "h-divide" not in name_to_op and "divide-sex" in name_to_op:
+        # sexual ancestor: same replicator with divide-sex
+        # (ref support/config/default-heads-sex.org)
+        names = ["divide-sex" if n == "h-divide" else n for n in names]
+    return np.asarray([name_to_op[n] for n in names], np.int8)
 
 
 class World:
@@ -57,6 +62,8 @@ class World:
         # instruction set (cHardwareManager::LoadInstSets equivalent)
         if config_dir and cfg.INST_SET not in ("-", ""):
             self.instset = load_instset(os.path.join(config_dir, cfg.INST_SET))
+        elif "sex" in cfg.INST_SET:
+            self.instset = heads_sex_instset()
         else:
             self.instset = default_instset()
 
@@ -142,8 +149,10 @@ class World:
             fresh = init_population(self.params, genome, k, inject_cell=cell)
             c = cell
             # overwrite only per-organism arrays (cell axis = dim 0);
-            # world-level resource state is untouched by an Inject
-            world_fields = {"resources", "res_grid"}
+            # world-level state (resources, birth-chamber store) is
+            # untouched by an Inject
+            world_fields = {"resources", "res_grid",
+                            "bc_mem", "bc_len", "bc_merit", "bc_valid"}
             updates = {
                 name: getattr(self.state, name).at[c].set(
                     getattr(fresh, name)[c])

@@ -29,7 +29,7 @@ def test_task_ladder_progresses():
                  uncapped=False, copy_mut=0.02)
     first = r["first_task_update"]
     assert first["not"] is not None or first["nand"] is not None, (
-        f"no first-tier logic task discovered in 1200 updates: {first}")
+        f"no first-tier logic task discovered in 1500 updates: {first}")
     assert r["tasks_discovered"] >= 2, (
         f"task ladder did not progress past one task: {first}")
     assert r["final_organisms"] > 100, "population failed to fill the world"

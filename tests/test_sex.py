@@ -1,0 +1,142 @@
+"""Sexual reproduction: divide-sex, birth-chamber pairing, crossover.
+
+Covers BASELINE.json config 3 (heads-sex + recombination).  Reference
+semantics: cBirthChamber::SubmitOffspring (cBirthChamber.cc:443) stores a
+sexual offspring until a mate arrives, DoBasicRecombination (cc:290) swaps
+one random region between the two genomes (RegionSwap cc:178) and mixes
+merits by the cut fraction; modeled on the reference `sex` test scenario.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from avida_tpu.config import AvidaConfig, heads_sex_instset
+from avida_tpu.core.state import make_world_params, zeros_population
+from avida_tpu.ops import birth as birth_ops
+from avida_tpu.world import World, default_ancestor
+
+
+def _sex_params(n_side=4, L=64):
+    cfg = AvidaConfig()
+    cfg.WORLD_X = n_side
+    cfg.WORLD_Y = n_side
+    cfg.TPU_MAX_MEMORY = L
+    cfg.RANDOM_SEED = 3
+    cfg.DIVIDE_INS_PROB = 0.0     # keep offspring content deterministic
+    cfg.DIVIDE_DEL_PROB = 0.0
+    cfg.COPY_MUT_PROB = 0.0
+    from avida_tpu.config.environment import default_logic9_environment
+    return make_world_params(cfg, heads_sex_instset(),
+                             default_logic9_environment())
+
+
+def _pending_pair_state(params, len_a=40, len_b=40):
+    """Two alive organisms with pending sexual offspring of known content:
+    parent 0's offspring is all opcode 1, parent 5's is all opcode 2."""
+    n, L, R = params.num_cells, params.max_memory, params.num_reactions
+    st = zeros_population(n, L, R)
+    tape = np.zeros((n, L), np.uint8)
+    # offspring bytes live on the tape after the divide point (off_start)
+    tape[0, :len_a] = 1
+    tape[5, :len_b] = 2
+    return st.replace(
+        tape=jnp.asarray(tape),
+        genome=jnp.asarray(tape.astype(np.int8)),
+        alive=jnp.zeros(n, bool).at[0].set(True).at[5].set(True),
+        merit=jnp.zeros(n, jnp.float32).at[0].set(100.0).at[5].set(300.0),
+        divide_pending=jnp.zeros(n, bool).at[0].set(True).at[5].set(True),
+        off_sex=jnp.zeros(n, bool).at[0].set(True).at[5].set(True),
+        off_start=jnp.zeros(n, jnp.int32),
+        off_len=jnp.zeros(n, jnp.int32).at[0].set(len_a).at[5].set(len_b),
+        mem_len=jnp.zeros(n, jnp.int32).at[0].set(len_a).at[5].set(len_b),
+        genome_len=jnp.zeros(n, jnp.int32).at[0].set(len_a).at[5].set(len_b),
+    )
+
+
+def test_paired_offspring_are_two_parent_recombinants():
+    params = _sex_params()
+    st = _pending_pair_state(params)
+    pending = st.divide_pending & st.alive
+    off_mem = st.genome
+    off_len = st.off_len
+    (off_mem, off_len, child_merit, placeable, dual, dual_mem, dual_len,
+     dual_merit, store) = birth_ops.recombine_sexual(
+        params, st, jax.random.key(7), off_mem, off_len, pending)
+
+    c0 = np.asarray(off_mem[0])[: int(off_len[0])]
+    c5 = np.asarray(off_mem[5])[: int(off_len[5])]
+    # both children carry material from BOTH parents (opcodes 1 and 2)
+    assert set(np.unique(c0)) == {1, 2}, c0
+    assert set(np.unique(c5)) == {1, 2}, c5
+    # child 0 keeps parent-0 flanks, child 5 keeps parent-5 flanks
+    assert c0[0] == 1 and c0[-1] == 1
+    assert c5[0] == 2 and c5[-1] == 2
+    # the swapped region is complementary: counts of foreign material match
+    assert (c0 == 2).sum() == int(off_len[0]) - (c0 == 1).sum()
+    # lengths complementary: total material conserved
+    assert int(off_len[0]) + int(off_len[5]) == 80
+    # merit mixing moves both toward the other parent
+    m0, m5 = float(child_merit[0]), float(child_merit[5])
+    assert 100.0 <= m0 <= 300.0 and 100.0 <= m5 <= 300.0
+    assert abs((m0 + m5) - 400.0) < 1e-3      # merit conserved
+    # both were paired, nothing waits
+    assert bool(placeable[0]) and bool(placeable[5])
+    assert not bool(store[3])                  # store empty
+
+
+def test_odd_offspring_waits_in_store_and_parent_resumes():
+    params = _sex_params()
+    st = _pending_pair_state(params)
+    # only parent 0 divides this flush
+    st = st.replace(divide_pending=st.divide_pending.at[5].set(False),
+                    off_sex=st.off_sex.at[5].set(False))
+    neighbors = jnp.asarray(birth_ops.neighbor_table(
+        params.world_x, params.world_y, params.geometry))
+    st2 = birth_ops.flush_births(params, st, jax.random.key(1), neighbors,
+                                 jnp.int32(0))
+    # offspring moved into the chamber store; parent resumed (not pending)
+    assert bool(st2.bc_valid)
+    assert int(st2.bc_len) == 40
+    assert not bool(st2.divide_pending[0])
+    # nothing was born yet
+    assert int(st2.alive.sum()) == 2
+    # a second sexual offspring now pairs WITH the store: seed parent 5
+    st3 = st2.replace(
+        divide_pending=st2.divide_pending.at[5].set(True),
+        off_sex=st2.off_sex.at[5].set(True))
+    st4 = birth_ops.flush_births(params, st3, jax.random.key(2), neighbors,
+                                 jnp.int32(1))
+    # two children born from the pair (dual placement), store drained
+    assert int(st4.alive.sum()) == 4
+    assert not bool(st4.bc_valid)
+    born = np.asarray(st4.alive & (st4.birth_update == 1))
+    cells = np.nonzero(born)[0]
+    assert len(cells) == 2
+    kids = [np.asarray(st4.genome[c])[: int(st4.genome_len[c])]
+            for c in cells]
+    # with RECOMBINATION_PROB=1 both children are two-parent recombinants
+    assert all(set(np.unique(k)) == {1, 2} for k in kids), kids
+
+
+def test_sexual_world_sustains_population():
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 10
+    cfg.WORLD_Y = 10
+    cfg.TPU_MAX_MEMORY = 320
+    cfg.RANDOM_SEED = 17
+    cfg.INST_SET = "heads_sex"
+    cfg.AVE_TIME_SLICE = 100
+    cfg.TPU_MAX_STEPS_PER_UPDATE = 100
+    cfg.set("TPU_SYSTEMATICS", 0)
+    w = World(cfg=cfg)
+    assert "divide-sex" in w.instset.inst_names
+    w.inject()
+    w.run(max_updates=30)
+    # a lone sexual ancestor must not deadlock: its first offspring waits
+    # in the chamber, the parent resumes, the second offspring mates with
+    # the first, and the population grows
+    assert w.num_organisms > 2, w.num_organisms
