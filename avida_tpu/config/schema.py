@@ -155,6 +155,10 @@ class AvidaConfig:
     DEMES_USE_GERMLINE: int = 0
     DEMES_COMPETITION_STYLE: int = 0
     DEMES_TOURNAMENT_SIZE: int = 0
+    GERMLINE_COPY_MUT: float = 0.0075
+    DEMES_MAX_AGE: int = 500
+    DEMES_MAX_BIRTHS: int = 100
+    DEMES_MIGRATION_RATE: float = 0.0
 
     # --- Energy model (off by default) ---
     ENERGY_ENABLED: int = 0

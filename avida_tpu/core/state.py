@@ -70,6 +70,13 @@ class WorldParams(struct.PyTreeNode):
     # death
     death_method: int = struct.field(pytree_node=False, default=2)
     age_limit: int = struct.field(pytree_node=False, default=20)
+    # demes (cDeme / cPopulation::CompeteDemes; SURVEY §2d)
+    num_demes: int = struct.field(pytree_node=False, default=1)
+    demes_use_germline: int = struct.field(pytree_node=False, default=0)
+    germline_copy_mut: float = struct.field(pytree_node=False, default=0.0075)
+    demes_max_age: int = struct.field(pytree_node=False, default=500)
+    demes_max_births: int = struct.field(pytree_node=False, default=100)
+    demes_migration_rate: float = struct.field(pytree_node=False, default=0.0)
     # birth
     birth_method: int = struct.field(pytree_node=False, default=0)
     prefer_empty: bool = struct.field(pytree_node=False, default=True)
@@ -150,6 +157,12 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         inherit_merit=bool(cfg.INHERIT_MERIT),
         max_steps_per_update=cfg.TPU_MAX_STEPS_PER_UPDATE,
         use_pallas=cfg.TPU_USE_PALLAS,
+        num_demes=cfg.NUM_DEMES,
+        demes_use_germline=cfg.DEMES_USE_GERMLINE,
+        germline_copy_mut=cfg.GERMLINE_COPY_MUT,
+        demes_max_age=cfg.DEMES_MAX_AGE,
+        demes_max_births=cfg.DEMES_MAX_BIRTHS,
+        demes_migration_rate=cfg.DEMES_MIGRATION_RATE,
         death_method=cfg.DEATH_METHOD,
         age_limit=cfg.AGE_LIMIT,
         birth_method=cfg.BIRTH_METHOD,
@@ -268,6 +281,13 @@ class PopulationState(struct.PyTreeNode):
     bc_merit: jax.Array       # f32[]      submitting parent's merit
     bc_valid: jax.Array       # bool[]     entry occupied
 
+    # --- demes (ref cDeme: per-group counters + germline; cells map to
+    # demes as contiguous bands, deme = cell // (N // D)) ---
+    deme_birth_count: jax.Array  # int32[D]  births since deme reset
+    deme_age: jax.Array          # int32[D]  updates since deme reset
+    germ_mem: jax.Array          # int8[D, L] germline genome (cGermline)
+    germ_len: jax.Array          # int32[D]
+
     # --- systematics hooks ---
     genotype_id: jax.Array    # int32[N]    host-assigned genotype ids (-1 unknown)
     parent_id: jax.Array      # int32[N]    parent cell index at birth (-1 seed)
@@ -296,7 +316,8 @@ class PopulationState(struct.PyTreeNode):
 
 
 def zeros_population(n: int, L: int, R: int, n_global_res: int = 0,
-                     n_spatial_res: int = 0) -> PopulationState:
+                     n_spatial_res: int = 0, n_demes: int = 1
+                     ) -> PopulationState:
     i32 = partial(jnp.zeros, dtype=jnp.int32)
     f32 = partial(jnp.zeros, dtype=jnp.float32)
     return PopulationState(
@@ -323,6 +344,8 @@ def zeros_population(n: int, L: int, R: int, n_global_res: int = 0,
         off_copied_size=i32(n), off_sex=jnp.zeros(n, bool),
         bc_mem=jnp.zeros(L, jnp.int8), bc_len=jnp.zeros((), jnp.int32),
         bc_merit=jnp.zeros((), jnp.float32), bc_valid=jnp.zeros((), bool),
+        deme_birth_count=i32(n_demes), deme_age=i32(n_demes),
+        germ_mem=jnp.zeros((n_demes, L), jnp.int8), germ_len=i32(n_demes),
         genotype_id=jnp.full(n, -1, jnp.int32), parent_id=jnp.full(n, -1, jnp.int32),
         birth_update=jnp.full(n, -1, jnp.int32),
         insts_executed=i32(n),
@@ -348,7 +371,7 @@ def init_population(params: WorldParams, seed_genome: np.ndarray,
     copied = executed = length)."""
     n, L, R = params.num_cells, params.max_memory, params.num_reactions
     st = zeros_population(n, L, R, params.num_global_res,
-                          params.num_spatial_res)
+                          params.num_spatial_res, params.num_demes)
     k_inputs, key = jax.random.split(key)
     st = st.replace(inputs=make_cell_inputs(k_inputs, n),
                     resources=jnp.asarray(params.res_initial, jnp.float32),
@@ -377,4 +400,11 @@ def init_population(params: WorldParams, seed_genome: np.ndarray,
             params.age_limit * glen if params.death_method == 2
             else (params.age_limit if params.death_method == 1 else 2**30)),
     )
+    if params.demes_use_germline:
+        # every deme's germline starts at the ancestor (cGermline seeded at
+        # world setup)
+        st = st.replace(
+            germ_mem=jnp.broadcast_to(jnp.asarray(g)[None, :],
+                                      (params.num_demes, L)).astype(jnp.int8),
+            germ_len=jnp.full(params.num_demes, glen, jnp.int32))
     return st

@@ -240,6 +240,14 @@ def flush_births(params, st, key, neighbors, update_no):
 
     # ---- target selection (PositionOffspring, cc:5185; BIRTH_METHOD 0) ----
     cand = neighbors                                  # [N, 8]
+    if params.num_demes > 1:
+        # deme-local placement: candidates in a different deme collapse to
+        # the parent cell (births stay inside the group; cross-deme birth
+        # happens only through migration below).  Bands align with shards,
+        # so this also keeps placement traffic on-device (ops/demes.py).
+        cpd = params.num_cells // params.num_demes
+        same_deme = (cand // cpd) == (rows // cpd)[:, None]
+        cand = jnp.where(same_deme, cand, rows[:, None])
     if params.allow_parent:
         cand = jnp.concatenate([cand, rows[:, None]], axis=1)   # [N, 9]
     ncand = cand.shape[1]
@@ -250,6 +258,22 @@ def flush_births(params, st, key, neighbors, update_no):
         score = score + jnp.where(~occupied, 10.0, 0.0)
     choice = jnp.argmax(score, axis=1)
     target = cand[rows, choice]                       # [N]
+    if params.num_demes > 1 and params.demes_migration_rate > 0:
+        # DEMES_MIGRATION_RATE: offspring born into a random cell of a
+        # random other deme (cPopulation deme migration / cMigrationMatrix
+        # uniform case)
+        k_mig, k_mcell = jax.random.split(jax.random.fold_in(k_place, 1))
+        migrate = (jax.random.uniform(k_mig, (n,))
+                   < params.demes_migration_rate) & pending
+        cpd = params.num_cells // params.num_demes
+        # uniform over the n - cpd cells OUTSIDE the home deme: draw in
+        # [0, n-cpd) and shift draws at/after the home band up by one band
+        mig_cell = jax.random.randint(k_mcell, (n,), 0, n - cpd,
+                                      dtype=jnp.int32)
+        home_start = (rows // cpd) * cpd
+        mig_cell = jnp.where(mig_cell >= home_start, mig_cell + cpd,
+                             mig_cell)
+        target = jnp.where(migrate, mig_cell, target)
 
     # ---- conflict resolution: lowest parent index claims the cell ----
     # claim[j] = min index of a pending parent targeting cell j (BIG if none).
@@ -385,6 +409,13 @@ def flush_births(params, st, key, neighbors, update_no):
         new_fields = jax.lax.cond(dual_born.any(), apply_dual,
                                   lambda nf: dict(nf), new_fields)
         births = births | b2
+
+    if params.num_demes > 1:
+        # per-deme birth tally (cDeme::IncBirthCount; feeds CompeteDemes
+        # competition_type 1 and the BIRTHS replication trigger)
+        cpd = params.num_cells // params.num_demes
+        db = births.reshape(params.num_demes, cpd).sum(axis=1)
+        new_fields["deme_birth_count"] = st.deme_birth_count + db
 
     st = st.replace(**new_fields)
     if sexual:

@@ -1,0 +1,299 @@
+"""Demes: group structure, competition, replication, germlines.
+
+TPU-native re-expression of the reference deme machinery (cDeme,
+avida-core/source/main/cDeme.h:52; cPopulation::CompeteDemes
+cPopulation.cc ~4800, ReplicateDemes / ReplaceDeme; germlines
+main/cGermline.h:31).  Demes are CONTIGUOUS cell bands -- deme d owns
+cells [d*C, (d+1)*C) with C = num_cells // num_demes -- so every per-deme
+reduction is a reshape to [D, C] plus an axis-1 reduction, and deme
+replacement is a block gather on the leading axis.  The band layout is
+also the shard layout (parallel/mesh.py shards the cell axis in
+contiguous bands), so deme boundaries coincide with shard boundaries
+whenever num_demes % n_devices == 0: deme-local placement then produces
+ZERO cross-device traffic outside migration and compete/replicate events
+(SURVEY §2g.4: demes are the natural shard axis).
+
+Organism copies during deme replacement follow the reference's
+InjectClone semantics (cPopulation.cc:7377): same genome and merit, fresh
+hardware and lifetime state.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from avida_tpu.core.state import make_cell_inputs
+
+# ReplicateDemes triggers (cPopulation::ReplicateDemes switch order)
+TRIGGER_ALL, TRIGGER_FULL, TRIGGER_CORNERS, TRIGGER_AGE, TRIGGER_BIRTHS = \
+    range(5)
+
+
+def cells_per_deme(params) -> int:
+    n, d = params.num_cells, params.num_demes
+    if n % d:
+        raise ValueError(f"num_cells {n} not divisible by NUM_DEMES {d}")
+    return n // d
+
+
+def deme_of_cells(params):
+    """int32[N]: deme index of every cell."""
+    return jnp.arange(params.num_cells) // cells_per_deme(params)
+
+
+def _deme_mean(params, alive, x):
+    """Per-deme mean of x over living organisms -> f32[D]."""
+    D = params.num_demes
+    C = cells_per_deme(params)
+    xa = jnp.where(alive, x.astype(jnp.float32), 0.0).reshape(D, C)
+    cnt = alive.reshape(D, C).sum(axis=1)
+    return xa.sum(axis=1) / jnp.maximum(cnt, 1), cnt
+
+
+def deme_fitness(params, st, competition_type):
+    """f32[D] deme fitness (cPopulation::CompeteDemes switch,
+    competition_type 0-6; 3 (mutation-rate) is per-organism div-type and
+    degenerates to constant here, 5/6 use the same last-gestation fitness
+    as 2/4 -- the repo keeps one fitness notion)."""
+    D = params.num_demes
+    if competition_type == 0:
+        return jnp.ones(D, jnp.float32)
+    if competition_type == 1:
+        return st.deme_birth_count.astype(jnp.float32)
+    if competition_type in (2, 5):
+        mean, _ = _deme_mean(params, st.alive, st.fitness)
+        return mean
+    if competition_type in (4, 6):
+        mean, _ = _deme_mean(params, st.alive, st.fitness)
+        # rank k (1 = best) -> fitness 2^-k
+        rank = 1 + (mean[:, None] < mean[None, :]).sum(axis=1)
+        return jnp.exp2(-rank.astype(jnp.float32))
+    if competition_type == 3:
+        return jnp.ones(D, jnp.float32)
+    raise NotImplementedError(f"CompeteDemes competition_type {competition_type}")
+
+
+def _blockify(params, x):
+    """Reshape a per-cell array to [D, C, ...]."""
+    D = params.num_demes
+    C = cells_per_deme(params)
+    return x.reshape((D, C) + x.shape[1:])
+
+
+def _replace_blocks(params, st, src, replaced, key):
+    """Rebuild per-cell state so deme d's block is an InjectClone copy of
+    deme src[d]'s block where replaced[d]; untouched demes keep their
+    state.  Copies genome + merit; hardware/lifetime state is newborn-
+    fresh (InjectClone / SetupClone semantics)."""
+    n, L = st.tape.shape
+    D = params.num_demes
+
+    def blk_gather(x):
+        b = _blockify(params, x)
+        g = b[src]
+        sel = replaced.reshape((D,) + (1,) * (g.ndim - 1))
+        return jnp.where(sel, g, b).reshape(x.shape)
+
+    genome = blk_gather(st.genome)
+    genome_len = blk_gather(st.genome_len)
+    alive = blk_gather(st.alive)
+    merit = blk_gather(st.merit)
+    gestation = blk_gather(st.gestation_time)
+    fitness = blk_gather(st.fitness)
+    generation = blk_gather(st.generation)
+
+    rep_cells = replaced[deme_of_cells(params)]        # bool[N]
+    updates = _clone_reset(params, st, rep_cells, genome, genome_len, alive,
+                           merit, key)
+    # clones inherit the source organisms' last-gestation history
+    # (InjectClone -> SetupClone keeps merit/fitness/gestation context)
+    for name, val in (("gestation_time", gestation), ("fitness", fitness),
+                      ("generation", generation)):
+        dst = getattr(st, name)
+        updates[name] = jnp.where(rep_cells, val, dst)
+    return st.replace(**updates)
+
+
+# Per-cell fields a freshly (re)seeded organism zeroes.  Keyed off one list
+# so deme replacement and germline seeding can't drift apart; new
+# PopulationState per-cell fields with newborn-zero semantics go HERE.
+_ZERO_FIELDS = [
+    "regs", "heads", "stacks", "sp", "active_stack", "read_label",
+    "read_label_len", "input_ptr", "input_buf", "input_buf_n",
+    "output_buf", "cur_task_count", "cur_reaction_count",
+    "last_task_count", "time_used", "cpu_cycles", "gestation_start",
+    "child_copied_size", "num_divides", "off_start", "off_len",
+    "off_copied_size", "insts_executed", "budget_carry",
+    "last_bonus", "last_merit_base",
+]
+_FALSE_FIELDS = ["mal_active", "breed_true", "divide_pending", "off_sex"]
+
+
+def _clone_reset(params, st, sel_cells, genome, genome_len, alive, merit,
+                 key):
+    """Field updates installing `genome`/`merit` at sel_cells with fresh
+    hardware + lifetime state (InjectClone / SetupClone semantics,
+    cPopulation.cc:7377).  Returns the updates dict for st.replace."""
+    n = st.tape.shape[0]
+    max_exec = jnp.where(
+        params.death_method == 2, params.age_limit * genome_len,
+        jnp.where(params.death_method == 1, params.age_limit, 2**30))
+    fresh = {
+        "genome": genome, "genome_len": genome_len, "alive": alive,
+        "merit": merit,
+        "tape": (genome.astype(jnp.uint8) & jnp.uint8(0x3F)),
+        "mem_len": genome_len,
+        "executed_size": genome_len, "copied_size": genome_len,
+        "max_executed": max_exec,
+    }
+    updates = {}
+    for name, val in fresh.items():
+        dst = getattr(st, name)
+        sel = sel_cells.reshape((n,) + (1,) * (dst.ndim - 1))
+        updates[name] = jnp.where(sel, val, dst)
+    for name in _ZERO_FIELDS:
+        dst = getattr(st, name)
+        sel = sel_cells.reshape((n,) + (1,) * (dst.ndim - 1))
+        updates[name] = jnp.where(sel, jnp.zeros_like(dst), dst)
+    for name in _FALSE_FIELDS:
+        updates[name] = jnp.where(sel_cells, False, getattr(st, name))
+    updates["cur_bonus"] = jnp.where(
+        sel_cells, jnp.asarray(params.default_bonus, st.cur_bonus.dtype),
+        st.cur_bonus)
+    updates["genotype_id"] = jnp.where(sel_cells, -1, st.genotype_id)
+    updates["parent_id"] = jnp.where(sel_cells, -1, st.parent_id)
+    updates["birth_update"] = jnp.where(sel_cells, -1, st.birth_update)
+    updates["inputs"] = jnp.where(sel_cells[:, None],
+                                  make_cell_inputs(key, n), st.inputs)
+    return updates
+
+
+def compete_demes(params, st, key, competition_type):
+    """Fitness-proportional deme selection + wholesale replacement
+    (cPopulation::CompeteDemes tail: roulette draw per slot, then copy)."""
+    D = params.num_demes
+    k_pick, k_inputs = jax.random.split(key)
+    fit = deme_fitness(params, st, competition_type)
+    total = fit.sum()
+    p = jnp.where(total > 0, fit / jnp.maximum(total, 1e-30),
+                  jnp.full(D, 1.0 / D))
+    src = jax.random.choice(k_pick, D, shape=(D,), p=p)
+    replaced = src != jnp.arange(D)
+    st = _replace_blocks(params, st, src, replaced, k_inputs)
+    # germlines follow their deme (cGermline copied on deme replication)
+    if params.demes_use_germline:
+        sel = replaced
+        st = st.replace(
+            germ_mem=jnp.where(sel[:, None], st.germ_mem[src], st.germ_mem),
+            germ_len=jnp.where(sel, st.germ_len[src], st.germ_len))
+    # all demes reset their counters after competition
+    return st.replace(deme_birth_count=jnp.zeros(D, jnp.int32),
+                      deme_age=jnp.zeros(D, jnp.int32))
+
+
+def _mutate_germline(params, germ_mem, germ_len, key):
+    """Per-site germline copy mutations (GERMLINE_COPY_MUT,
+    ReplaceDeme's germline mutation step)."""
+    D, L = germ_mem.shape
+    u = jax.random.uniform(key, (D, L))
+    r = jax.random.randint(jax.random.fold_in(key, 1), (D, L), 0,
+                           params.num_insts, dtype=jnp.int32).astype(jnp.int8)
+    in_g = jnp.arange(L)[None, :] < germ_len[:, None]
+    hit = (u < params.germline_copy_mut) & in_g
+    return jnp.where(hit, r, germ_mem)
+
+
+def replicate_demes(params, st, key, rep_trigger):
+    """Replicate triggered demes into random target demes
+    (cPopulation::ReplicateDemes -> ReplicateDeme -> ReplaceDeme).
+
+    Trigger 0=all non-empty, 1=full, 2=corners occupied, 3=age >=
+    DEMES_MAX_AGE, 4=births >= DEMES_MAX_BIRTHS.  Each triggered source
+    picks a random other deme; conflicts resolve lowest-source-wins
+    (lockstep semantic).  With germlines (DEMES_USE_GERMLINE=1) the
+    target is cleared and seeded at its center cell with a mutated copy
+    of the source germline, which becomes both demes' new germline;
+    without, the target becomes an InjectClone copy of the source.
+    Source counters reset either way."""
+    D = params.num_demes
+    C = cells_per_deme(params)
+    k_t, k_m, k_inputs, k_seed = jax.random.split(key, 4)
+
+    occ = st.alive.reshape(D, C)
+    cnt = occ.sum(axis=1)
+    if rep_trigger == TRIGGER_ALL:
+        trig = cnt > 0
+    elif rep_trigger == TRIGGER_FULL:
+        trig = cnt == C
+    elif rep_trigger == TRIGGER_CORNERS:
+        trig = occ[:, 0] & occ[:, C - 1]
+    elif rep_trigger == TRIGGER_AGE:
+        trig = st.deme_age >= params.demes_max_age
+    elif rep_trigger == TRIGGER_BIRTHS:
+        trig = st.deme_birth_count >= params.demes_max_births
+    else:
+        raise NotImplementedError(f"ReplicateDemes trigger {rep_trigger}")
+
+    # random target != source; lowest triggered source claims a target
+    off = jax.random.randint(k_t, (D,), 1, max(D, 2), dtype=jnp.int32)
+    tgt = (jnp.arange(D) + off) % D
+    BIG = jnp.int32(2**30)
+    claim = jnp.full(D, BIG, jnp.int32).at[
+        jnp.where(trig, tgt, D)].min(
+        jnp.where(trig, jnp.arange(D), BIG), mode="drop")
+    replaced = claim < BIG
+    src = jnp.clip(claim, 0, D - 1)
+    # a source that is itself replaced by a lower-index source this round
+    # still counts as having replicated (counters reset below)
+
+    if params.demes_use_germline:
+        germ = _mutate_germline(params, st.germ_mem[src], st.germ_len[src],
+                                k_m)
+        st = _clear_and_seed(params, st, replaced, germ, st.germ_len[src],
+                             k_inputs)
+        # the mutated germline becomes BOTH demes' germline (ReplaceDeme
+        # installs it in source and target)
+        src_updated = jnp.zeros(D, bool).at[
+            jnp.where(replaced, src, D)].set(True, mode="drop")
+        back = jnp.zeros(D, jnp.int32).at[
+            jnp.where(replaced, src, D)].set(jnp.arange(D), mode="drop")
+        germ_of = jnp.where(replaced[:, None], germ,
+                            jnp.where(src_updated[:, None],
+                                      germ[back], st.germ_mem))
+        len_of = jnp.where(replaced, st.germ_len[src],
+                           jnp.where(src_updated, st.germ_len[src][back],
+                                     st.germ_len))
+        st = st.replace(germ_mem=germ_of, germ_len=len_of)
+    else:
+        st = _replace_blocks(params, st, src, replaced, k_inputs)
+
+    fired = trig | replaced
+    return st.replace(
+        deme_birth_count=jnp.where(fired, 0, st.deme_birth_count),
+        deme_age=jnp.where(fired, 0, st.deme_age))
+
+
+def _clear_and_seed(params, st, replaced, seed_mem, seed_len, key):
+    """Kill every organism in replaced demes and inject the seed genome at
+    each deme's center cell (germline seeding, ReplaceDeme + SeedDeme)."""
+    n, L = st.tape.shape
+    D = params.num_demes
+    C = cells_per_deme(params)
+    rep_cells = replaced[deme_of_cells(params)]
+    center = (jnp.arange(n) % C) == (C // 2)
+    seed_cell = rep_cells & center
+    d_of = deme_of_cells(params)
+    seed_genome = seed_mem[d_of]            # [N, L] (selects its deme's seed)
+    seed_length = seed_len[d_of]
+
+    # seed genome/merit live only at the center cell; every other cell in
+    # the band is cleared (alive=False makes the rest of its fresh state
+    # irrelevant); germline seeds also zero gestation history
+    updates = _clone_reset(params, st, rep_cells, seed_genome, seed_length,
+                           seed_cell, seed_length.astype(st.merit.dtype),
+                           key)
+    for name in ("gestation_time", "fitness", "generation"):
+        dst = getattr(st, name)
+        updates[name] = jnp.where(rep_cells, jnp.zeros_like(dst), dst)
+    return st.replace(**updates)

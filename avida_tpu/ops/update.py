@@ -121,6 +121,9 @@ def update_step(params, st, key, neighbors, update_no):
 
     st = birth_ops.flush_births(params, st, k_birth, neighbors, update_no)
 
+    if params.num_demes > 1:
+        st = st.replace(deme_age=st.deme_age + 1)   # cDeme::IncAge per update
+
     if params.point_mut_prob > 0:
         st = _point_mutation_sweep(params, st, jax.random.fold_in(k_steps, 0x7FFFFFFF))
 

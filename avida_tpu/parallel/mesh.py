@@ -47,7 +47,12 @@ def population_sharding(mesh: Mesh) -> NamedSharding:
 _FIELD_SPECS = {"res_grid": P(None, CELL_AXIS), "resources": P(),
                 # birth-chamber store: world-level, replicated
                 "bc_mem": P(), "bc_len": P(), "bc_merit": P(),
-                "bc_valid": P()}
+                "bc_valid": P(),
+                # deme-axis state: small, replicated (the cell bands
+                # themselves are the sharded axis; deme counters/germlines
+                # ride along)
+                "deme_birth_count": P(), "deme_age": P(),
+                "germ_mem": P(), "germ_len": P()}
 
 
 def shard_population(st, mesh: Mesh):

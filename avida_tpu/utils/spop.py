@@ -97,7 +97,8 @@ def restore_population(params, orgs, key, neighbors=None):
     from avida_tpu.ops.interpreter import micro_step
 
     n, L, R = params.num_cells, params.max_memory, params.num_reactions
-    st = zeros_population(n, L, R, params.num_global_res, params.num_spatial_res)
+    st = zeros_population(n, L, R, params.num_global_res,
+                          params.num_spatial_res, params.num_demes)
     k_in, key = jax.random.split(key)
     st = st.replace(
         inputs=make_cell_inputs(k_in, n),
@@ -135,6 +136,24 @@ def restore_population(params, orgs, key, neighbors=None):
                      else (params.age_limit if params.death_method == 1 else 2**30),
                      0).astype(np.int32)),
     )
+
+    if params.demes_use_germline and len(orgs):
+        # .spop carries no germline section (format parity with the
+        # reference, which stores germlines only in Avida-ED freezers);
+        # re-seed each deme's germline from its lowest-index live organism,
+        # falling back to the overall first (documented approximation)
+        D = params.num_demes
+        germ = np.zeros((D, L), np.int8)
+        glen = np.zeros(D, np.int32)
+        cpd = n // D
+        first = orgs[0]
+        for d in range(D):
+            in_deme = [o for o in orgs if o["cell"] // cpd == d]
+            src = min(in_deme, key=lambda o: o["cell"]) if in_deme else first
+            g = src["genome"]
+            germ[d, :len(g)] = g
+            glen[d] = len(g)
+        st = st.replace(germ_mem=jnp.asarray(germ), germ_len=jnp.asarray(glen))
 
     # fast-forward: organism i executes offs[i] cycles
     offs_j = jnp.asarray(offs)

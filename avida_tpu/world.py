@@ -291,6 +291,29 @@ class World:
                         jnp.full(n, level / n, jnp.float32)))
                 return
 
+    def _action_CompeteDemes(self, args):
+        """CompeteDemes [competition_type] (ref cPopulation::CompeteDemes;
+        action cActionCompeteDemes).  Fitness-proportional deme selection +
+        wholesale replacement."""
+        from avida_tpu.ops import demes as deme_ops
+        ctype = int(args[0]) if args else self.cfg.DEMES_COMPETITION_STYLE
+        self.key, k = jax.random.split(self.key)
+        self.state = deme_ops.compete_demes(self.params, self.state, k, ctype)
+
+    _REP_TRIGGERS = {"all": 0, "full_deme": 1, "full": 1, "corners": 2,
+                     "deme-age": 3, "age": 3, "births": 4}
+
+    def _action_ReplicateDemes(self, args):
+        """ReplicateDemes [trigger] (ref cPopulation::ReplicateDemes)."""
+        from avida_tpu.ops import demes as deme_ops
+        trig = args[0] if args else "full"
+        trig = self._REP_TRIGGERS.get(str(trig), None) \
+            if not str(trig).isdigit() else int(trig)
+        if trig is None:
+            raise ValueError(f"unknown ReplicateDemes trigger {args[0]!r}")
+        self.key, k = jax.random.split(self.key)
+        self.state = deme_ops.replicate_demes(self.params, self.state, k, trig)
+
     def _action_SavePopulation(self, args):
         from avida_tpu.utils import spop
         os.makedirs(self.data_dir, exist_ok=True)

@@ -1,0 +1,149 @@
+"""Demes: group structure, deme-local placement, competition, germlines.
+
+Covers BASELINE.json config 5 (multi-deme group selection).  Reference:
+cDeme (main/cDeme.h:52), cPopulation::CompeteDemes / ReplicateDemes /
+ReplaceDeme, germlines (main/cGermline.h:31); scenarios modeled on the
+reference demes_* golden tests.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from avida_tpu.config import AvidaConfig
+from avida_tpu.config.environment import default_logic9_environment
+from avida_tpu.config.instset import default_instset
+from avida_tpu.core.state import make_world_params, zeros_population
+from avida_tpu.ops import demes as deme_ops
+from avida_tpu.world import World
+
+
+def _params(num_demes=2, side=8, L=64, **kw):
+    cfg = AvidaConfig()
+    cfg.WORLD_X = side
+    cfg.WORLD_Y = side
+    cfg.TPU_MAX_MEMORY = L
+    cfg.NUM_DEMES = num_demes
+    cfg.RANDOM_SEED = 5
+    for k, v in kw.items():
+        cfg.set(k, v)
+    return make_world_params(cfg, default_instset(),
+                             default_logic9_environment())
+
+
+def test_deme_local_placement():
+    """An offspring of a deme-0 parent on the deme boundary never lands in
+    deme 1 (without migration)."""
+    from avida_tpu.ops import birth as birth_ops
+    params = _params(num_demes=2)
+    n, L = params.num_cells, params.max_memory
+    cpd = n // 2
+    st = zeros_population(n, L, params.num_reactions, n_demes=2)
+    # parent on the last row of deme 0 (boundary cells)
+    parent = cpd - 4
+    tape = jnp.zeros((n, L), jnp.uint8).at[parent, :20].set(3)
+    st = st.replace(
+        tape=tape, genome=tape.astype(jnp.int8),
+        alive=st.alive.at[parent].set(True),
+        merit=st.merit.at[parent].set(10.0),
+        divide_pending=st.divide_pending.at[parent].set(True),
+        off_len=st.off_len.at[parent].set(20),
+        mem_len=st.mem_len.at[parent].set(20),
+        genome_len=st.genome_len.at[parent].set(20),
+    )
+    neighbors = jnp.asarray(birth_ops.neighbor_table(
+        params.world_x, params.world_y, params.geometry))
+    for s in range(12):
+        st2 = birth_ops.flush_births(params, st, jax.random.key(s),
+                                     neighbors, jnp.int32(0))
+        born = np.nonzero(np.asarray(st2.alive))[0]
+        assert all(b < cpd for b in born), f"birth crossed deme: {born}"
+    assert int(st2.deme_birth_count[0]) == 1
+    assert int(st2.deme_birth_count[1]) == 0
+
+
+def test_compete_demes_birth_count_fitness():
+    """competition_type 1: the deme with all the births takes over."""
+    params = _params(num_demes=4, side=8)
+    n, L = params.num_cells, params.max_memory
+    st = zeros_population(n, L, params.num_reactions, n_demes=4)
+    cpd = n // 4
+    # deme 2 is populated with marked genomes and has all the births
+    tape = np.zeros((n, L), np.uint8)
+    alive = np.zeros(n, bool)
+    for c in range(2 * cpd, 3 * cpd):
+        tape[c, :10] = 7
+        alive[c] = True
+    st = st.replace(
+        tape=jnp.asarray(tape), genome=jnp.asarray(tape.astype(np.int8)),
+        genome_len=jnp.where(jnp.asarray(alive), 10, 0),
+        mem_len=jnp.where(jnp.asarray(alive), 10, 0),
+        alive=jnp.asarray(alive),
+        merit=jnp.where(jnp.asarray(alive), 5.0, 0.0).astype(jnp.float32),
+        deme_birth_count=jnp.asarray([0, 0, 50, 0], jnp.int32),
+        time_used=jnp.full(n, 99, jnp.int32),   # must reset on clone
+    )
+    st2 = deme_ops.compete_demes(params, st, jax.random.key(0), 1)
+    alive2 = np.asarray(st2.alive).reshape(4, cpd)
+    # every deme is now a copy of deme 2's block
+    assert alive2.all(axis=1).any() or alive2.any(axis=1).all()
+    for d in range(4):
+        assert alive2[d].sum() == cpd, f"deme {d} not fully cloned"
+    g = np.asarray(st2.genome)
+    assert (g[0, :10] == 7).all()              # genome copied
+    assert int(st2.time_used[0]) == 0          # hardware state fresh
+    assert np.asarray(st2.deme_birth_count).sum() == 0   # counters reset
+
+
+def test_replicate_demes_germline():
+    """Germline replication: target deme cleared, center-seeded with the
+    (possibly mutated) source germline; both germlines updated."""
+    params = _params(num_demes=2, side=8, DEMES_USE_GERMLINE=1,
+                     GERMLINE_COPY_MUT=0.0, DEMES_MAX_BIRTHS=3)
+    n, L = params.num_cells, params.max_memory
+    cpd = n // 2
+    st = zeros_population(n, L, params.num_reactions, n_demes=2)
+    germ = np.zeros((2, L), np.int8)
+    germ[0, :15] = 4
+    st = st.replace(
+        alive=(jnp.arange(n) < cpd),          # deme 0 fully occupied
+        genome_len=jnp.where(jnp.arange(n) < cpd, 15, 0),
+        mem_len=jnp.where(jnp.arange(n) < cpd, 15, 0),
+        germ_mem=jnp.asarray(germ), germ_len=jnp.asarray([15, 0], jnp.int32),
+        deme_birth_count=jnp.asarray([5, 0], jnp.int32),
+    )
+    st2 = deme_ops.replicate_demes(params, st, jax.random.key(1),
+                                   deme_ops.TRIGGER_BIRTHS)
+    alive2 = np.asarray(st2.alive)
+    # deme 1 now holds exactly one organism: the germline seed at center
+    assert alive2[cpd:].sum() == 1
+    seed_cell = cpd + np.nonzero(alive2[cpd:])[0][0]
+    assert (np.asarray(st2.genome[seed_cell])[:15] == 4).all()
+    assert int(st2.germ_len[1]) == 15
+    assert (np.asarray(st2.germ_mem[1])[:15] == 4).all()
+    assert int(st2.deme_birth_count[0]) == 0   # source counters reset
+
+
+def test_multi_deme_world_with_competition():
+    """End-to-end: multi-deme world runs with periodic CompeteDemes and
+    sustains its population (reference demes scenarios)."""
+    from avida_tpu.config.events import parse_event_line
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 12
+    cfg.WORLD_Y = 12
+    cfg.TPU_MAX_MEMORY = 320
+    cfg.NUM_DEMES = 4
+    cfg.RANDOM_SEED = 23
+    cfg.AVE_TIME_SLICE = 100
+    cfg.TPU_MAX_STEPS_PER_UPDATE = 100
+    cfg.set("TPU_SYSTEMATICS", 0)
+    w = World(cfg=cfg)
+    w.events = [parse_event_line("u 10:10:end CompeteDemes 1")]
+    w.inject()                                  # ancestor in deme 2 (center)
+    w.run(max_updates=40)
+    assert w.num_organisms > 4, w.num_organisms
+    # competition replicated the seeded deme's lineage into other demes
+    alive = np.asarray(w.state.alive).reshape(4, -1)
+    assert (alive.sum(axis=1) > 0).sum() >= 2, alive.sum(axis=1)

@@ -17,7 +17,7 @@ import numpy as np
 import pytest
 
 
-def _build(world_x, world_y, seed=11):
+def _build(world_x, world_y, seed=11, **overrides):
     from avida_tpu.config import AvidaConfig
     from avida_tpu.core.state import init_population
     from avida_tpu.ops import birth as birth_ops
@@ -28,6 +28,8 @@ def _build(world_x, world_y, seed=11):
     cfg.WORLD_Y = world_y
     cfg.TPU_MAX_MEMORY = 200
     cfg.RANDOM_SEED = seed
+    for k, v in overrides.items():
+        cfg.set(k, v)
     w = World(cfg=cfg)
     st = init_population(w.params, default_ancestor(w.instset), jax.random.key(seed))
     neighbors = jnp.asarray(
@@ -75,6 +77,60 @@ def test_sharded_matches_unsharded_bitexact():
 
     # sanity: the run did something (organisms executed, and some divided)
     assert np.asarray(ref.insts_executed).sum() > 0
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_multi_deme_sharded_bitexact_with_boundary_births():
+    """Deme-aligned sharding (BASELINE config 5): an 8-deme world sharded
+    one deme per device, run long enough for births to occur, with deme
+    migration ON so offspring actually cross shard boundaries, plus a
+    CompeteDemes block replacement mid-run.  Sharded == unsharded
+    bit-for-bit (the migration analogue of cMultiProcessWorld's
+    deterministic migrant ordering, cMultiProcessWorld.cc:294-310)."""
+    from avida_tpu.ops import demes as deme_ops
+    from avida_tpu.ops.update import update_step
+    from avida_tpu.parallel import (make_mesh, shard_neighbors,
+                                    shard_population)
+
+    # 8x16 world, 8 demes of 2 rows = one deme per device; fast updates
+    params, st0, neighbors = _build(
+        8, 16, NUM_DEMES=8, DEMES_MIGRATION_RATE=0.3,
+        AVE_TIME_SLICE=100, TPU_MAX_STEPS_PER_UPDATE=100)
+
+    def run(params, st, neighbors, n_updates):
+        key = jax.random.key(3)
+        pre_compete = None
+        for u in range(n_updates):
+            key, k = jax.random.split(key)
+            st, _ = update_step(params, st, k, neighbors, jnp.int32(u))
+            if u == 14:       # deme competition mid-run (block replacement)
+                pre_compete = st.alive        # snapshot BEFORE replacement
+                st = deme_ops.compete_demes(params, st, jax.random.key(99), 1)
+        jax.block_until_ready(st)
+        return st, pre_compete
+
+    ref, ref_pre = run(params, st0, neighbors, 22)
+
+    mesh = make_mesh(jax.devices()[:8])
+    got, _ = run(params, shard_population(st0, mesh),
+                 shard_neighbors(neighbors, mesh), 22)
+
+    ref_a, got_a = _state_arrays(ref), _state_arrays(got)
+    for name in ref_a:
+        np.testing.assert_array_equal(
+            ref_a[name], got_a[name],
+            err_msg=f"sharded/unsharded mismatch in field {name}")
+
+    # the run must actually have exercised cross-deme traffic: offspring
+    # born outside the seed deme BEFORE the compete event replicated the
+    # seed deme's block (only migration can put them there -- the compete
+    # itself would make this assertion vacuous)
+    cpd = params.num_cells // 8
+    seed_deme = (params.num_cells // 2) // cpd
+    alive_per_deme = np.asarray(ref_pre).reshape(8, cpd).sum(axis=1)
+    others = [alive_per_deme[d] for d in range(8) if d != seed_deme]
+    assert sum(others) > 0, (
+        f"no birth ever crossed a deme/shard boundary: {alive_per_deme}")
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
