@@ -117,6 +117,26 @@ def default_instset() -> InstSet:
     return _make_set("heads_default", _HEADS_DEFAULT_NAMES)
 
 
+_TRANSSMT_NAMES = [
+    "Nop-A", "Nop-B", "Nop-C", "Nop-D",
+    "Val-Shift-R", "Val-Shift-L", "Val-Nand", "Val-Add", "Val-Sub",
+    "Val-Mult", "Val-Div", "Val-Mod", "Val-Inc", "Val-Dec",
+    "SetMemory", "Inst-Read", "Inst-Write",
+    "If-Equal", "If-Not-Equal", "If-Less", "If-Greater",
+    "Head-Push", "Head-Pop", "Head-Move", "Search",
+    "Push-Next", "Push-Prev", "Push-Comp",
+    "Val-Delete", "Val-Copy", "IO", "Inject", "Divide-Erase", "Divide",
+]
+
+
+def transsmt_instset() -> InstSet:
+    """The stock transsmt set (ref support/config/instset-transsmt.cfg,
+    hw_type 2)."""
+    s = _make_set("transsmt", _TRANSSMT_NAMES)
+    s.hw_type = 2
+    return s
+
+
 def heads_sex_instset() -> InstSet:
     """The heads_sex set: heads_default with h-divide replaced by
     divide-sex (ref support/config/instset-heads-sex.cfg)."""

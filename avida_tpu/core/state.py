@@ -29,6 +29,10 @@ class WorldParams(struct.PyTreeNode):
     Everything here is a Python scalar / tuple, marked as pytree metadata, so
     a config change triggers recompilation (acceptable: configs are per-run).
     """
+    # hardware backend (cHardwareManager factory; models/registry.py)
+    hw_type: int = struct.field(pytree_node=False, default=0)
+    # parasites (TransSMT; cHardwareTransSMT.cc:218-248)
+    parasite_virulence: float = struct.field(pytree_node=False, default=-1.0)
     # world shape
     world_x: int = struct.field(pytree_node=False, default=60)
     world_y: int = struct.field(pytree_node=False, default=60)
@@ -127,6 +131,8 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         return tuple(map(tuple, a)) if a.ndim == 2 else tuple(a.tolist())
 
     return WorldParams(
+        hw_type=instset.hw_type,
+        parasite_virulence=cfg.PARASITE_VIRULENCE,
         world_x=cfg.WORLD_X, world_y=cfg.WORLD_Y, geometry=cfg.WORLD_GEOMETRY,
         max_memory=cfg.TPU_MAX_MEMORY,
         min_genome_len=8,
@@ -288,6 +294,26 @@ class PopulationState(struct.PyTreeNode):
     germ_mem: jax.Array          # int8[D, L] germline genome (cGermline)
     germ_len: jax.Array          # int32[D]
 
+    # --- TransSMT hardware (hw_type 2; empty (size-0 axes) on heads
+    # hardware).  Threads: 0 = host, 1 = parasite.  Memory spaces per
+    # thread: base space (host base = the packed `tape`) + ONE auxiliary
+    # write buffer.  Heads carry (space, position); spaces index
+    # 0=tape, 1=aux[.,0], 2=pmem, 3=aux[.,1]. ---
+    smt_aux: jax.Array        # uint8[N, T, Ls]  write buffers (space 1/3)
+    smt_aux_len: jax.Array    # int32[N, T]
+    pmem: jax.Array           # uint8[N, Ls]     parasite code (space 2)
+    pmem_len: jax.Array       # int32[N]
+    parasite_active: jax.Array  # bool[N]        thread 1 running
+    smt_stacks: jax.Array     # int32[N, T, 3, 10]  local stacks AX/BX/CX
+    smt_sp: jax.Array         # int32[N, T, 3]
+    gstack: jax.Array         # int32[N, 10]     global stack DX
+    gsp: jax.Array            # int32[N]
+    smt_head_pos: jax.Array   # int32[N, T, 4]
+    smt_head_space: jax.Array  # int32[N, T, 4]
+    inject_pending: jax.Array  # bool[N]   parasite offspring awaiting flush
+    inj_mem: jax.Array        # uint8[N, Ls]  pending injection code
+    inj_len: jax.Array        # int32[N]
+
     # --- systematics hooks ---
     genotype_id: jax.Array    # int32[N]    host-assigned genotype ids (-1 unknown)
     parent_id: jax.Array      # int32[N]    parent cell index at birth (-1 seed)
@@ -316,10 +342,12 @@ class PopulationState(struct.PyTreeNode):
 
 
 def zeros_population(n: int, L: int, R: int, n_global_res: int = 0,
-                     n_spatial_res: int = 0, n_demes: int = 1
-                     ) -> PopulationState:
+                     n_spatial_res: int = 0, n_demes: int = 1,
+                     smt: bool = False) -> PopulationState:
     i32 = partial(jnp.zeros, dtype=jnp.int32)
     f32 = partial(jnp.zeros, dtype=jnp.float32)
+    T = 2 if smt else 0          # SMT thread axis (host, parasite)
+    Ls = L if smt else 0         # SMT memory-space width
     return PopulationState(
         tape=jnp.zeros((n, L), jnp.uint8), mem_len=i32(n),
         regs=i32((n, 3)), heads=i32((n, 4)),
@@ -346,6 +374,14 @@ def zeros_population(n: int, L: int, R: int, n_global_res: int = 0,
         bc_merit=jnp.zeros((), jnp.float32), bc_valid=jnp.zeros((), bool),
         deme_birth_count=i32(n_demes), deme_age=i32(n_demes),
         germ_mem=jnp.zeros((n_demes, L), jnp.int8), germ_len=i32(n_demes),
+        smt_aux=jnp.zeros((n, T, Ls), jnp.uint8), smt_aux_len=i32((n, T)),
+        pmem=jnp.zeros((n, Ls), jnp.uint8), pmem_len=i32(n),
+        parasite_active=jnp.zeros(n, bool),
+        smt_stacks=i32((n, T, 3, 10)), smt_sp=i32((n, T, 3)),
+        gstack=i32((n, 10 if smt else 0)), gsp=i32(n),
+        smt_head_pos=i32((n, T, 4)), smt_head_space=i32((n, T, 4)),
+        inject_pending=jnp.zeros(n, bool),
+        inj_mem=jnp.zeros((n, Ls), jnp.uint8), inj_len=i32(n),
         genotype_id=jnp.full(n, -1, jnp.int32), parent_id=jnp.full(n, -1, jnp.int32),
         birth_update=jnp.full(n, -1, jnp.int32),
         insts_executed=i32(n),
@@ -371,7 +407,8 @@ def init_population(params: WorldParams, seed_genome: np.ndarray,
     copied = executed = length)."""
     n, L, R = params.num_cells, params.max_memory, params.num_reactions
     st = zeros_population(n, L, R, params.num_global_res,
-                          params.num_spatial_res, params.num_demes)
+                          params.num_spatial_res, params.num_demes,
+                          smt=(params.hw_type in (1, 2)))
     k_inputs, key = jax.random.split(key)
     st = st.replace(inputs=make_cell_inputs(k_inputs, n),
                     resources=jnp.asarray(params.res_initial, jnp.float32),
@@ -407,4 +444,9 @@ def init_population(params: WorldParams, seed_genome: np.ndarray,
             germ_mem=jnp.broadcast_to(jnp.asarray(g)[None, :],
                                       (params.num_demes, L)).astype(jnp.int8),
             germ_len=jnp.full(params.num_demes, glen, jnp.int32))
+    if params.hw_type in (1, 2):
+        # SMT thread base spaces: host thread at space 0, parasite at 2
+        base = jnp.asarray([[0, 0, 0, 0], [2, 2, 2, 2]], jnp.int32)
+        st = st.replace(smt_head_space=jnp.broadcast_to(
+            base[None], (n, 2, 4)))
     return st

@@ -7,12 +7,19 @@ types).  Each entry maps a hardware type id to a module exposing
 name.  New hardware (transsmt, experimental, ...) registers here.
 """
 
-from avida_tpu.models import heads
+from avida_tpu.models import heads, transsmt
 
 HARDWARE_REGISTRY = {
-    0: {"name": "heads", "module": heads, "default_instset": "instset-heads.cfg"},
-    # 1: transsmt (host-parasite stack machine) -- planned
-    # 2: experimental, 3: bcr, 4: gp8 -- planned
+    0: {"name": "heads", "module": heads,
+        "default_instset": "instset-heads.cfg"},
+    # reference numbering (core/Definitions.h eHARDWARE_TYPE): transsmt is
+    # HARDWARE_TYPE 1 in the enum but instset files declare hw_type=2
+    # (cHardwareManager::loadInstSet switch) -- accept both
+    1: {"name": "transsmt", "module": transsmt,
+        "default_instset": "instset-transsmt.cfg"},
+    2: {"name": "transsmt", "module": transsmt,
+        "default_instset": "instset-transsmt.cfg"},
+    # experimental, bcr, gp8 -- planned
 }
 
 

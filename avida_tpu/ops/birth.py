@@ -332,6 +332,12 @@ def flush_births(params, st, key, neighbors, update_no):
         "divide_pending": False, "off_start": 0, "off_len": 0,
         "off_copied_size": 0, "genotype_id": -1,
         "birth_update": update_no, "insts_executed": 0, "budget_carry": 0,
+        # TransSMT state (size-0 axes on heads hardware; writes are no-ops)
+        "smt_aux": jnp.uint8(0), "smt_aux_len": 0,
+        "pmem": jnp.uint8(0), "pmem_len": 0, "parasite_active": False,
+        "smt_stacks": 0, "smt_sp": 0, "gstack": 0, "gsp": 0,
+        "smt_head_pos": 0, "inject_pending": False,
+        "inj_mem": jnp.uint8(0), "inj_len": 0,
     }
 
     new_fields = {}
@@ -351,6 +357,12 @@ def flush_births(params, st, key, neighbors, update_no):
     # fresh per-cell input stream for the newborn (cell property, not
     # inherited -- indexed by target cell, so no gather either)
     new_fields["inputs"] = jnp.where(births[:, None], fresh_inputs, st.inputs)
+    if params.hw_type in (1, 2):
+        # newborn SMT thread bases: host at space 0, parasite at space 2
+        base = jnp.asarray([[0, 0, 0, 0], [2, 2, 2, 2]],
+                           st.smt_head_space.dtype)
+        new_fields["smt_head_space"] = jnp.where(
+            births[:, None, None], base[None], st.smt_head_space)
 
     if sexual:
         # second child of the store-paired dual row: place at another of
@@ -438,4 +450,56 @@ def flush_births(params, st, key, neighbors, update_no):
     cleared = jnp.where(won | leftover | ~st.alive, False, st.divide_pending)
     st = st.replace(divide_pending=cleared,
                     off_sex=st.off_sex & cleared)
+    if params.hw_type in (1, 2):
+        # a winning SMT parent's offspring buffer resets to the 1-inst
+        # blank (Divide_Main tail, cHardwareTransSMT.cc:485)
+        st = st.replace(
+            smt_aux=st.smt_aux.at[:, 0].set(
+                jnp.where(won[:, None], jnp.uint8(0), st.smt_aux[:, 0])),
+            smt_aux_len=st.smt_aux_len.at[:, 0].set(
+                jnp.where(won, 1, st.smt_aux_len[:, 0])))
+        st = flush_injections(params, st, jax.random.fold_in(key, 17),
+                              neighbors)
+    return st
+
+
+def flush_injections(params, st, key, neighbors):
+    """Parasite transmission: each organism with a staged injection
+    (inject_pending from Inst_Inject) targets a random neighbor; infection
+    succeeds when the target is alive and not already parasitized
+    (ParasiteInfectHost, cHardwareTransSMT.cc:375-417: inject fails on an
+    occupied memory-space label -- our single parasite slot is the
+    equivalent).  Conflicts resolve lowest-injector-wins; a failed
+    injection loses the parasite (as in the reference).  The new parasite
+    thread starts at (space 2, position 0)."""
+    n, L = st.tape.shape
+    rows = jnp.arange(n)
+    pend = st.inject_pending & st.alive
+    choice = jax.random.randint(key, (n,), 0, neighbors.shape[1],
+                                dtype=jnp.int32)
+    target = neighbors[rows, choice]
+    ok = pend & st.alive[target] & ~st.parasite_active[target]
+
+    BIG = jnp.int32(2**30)
+    claim = jnp.full(n, BIG, jnp.int32)
+    claim = claim.at[jnp.where(ok, target, rows)].min(
+        jnp.where(ok, rows, BIG))
+    infected = (claim < BIG) & st.alive & ~st.parasite_active
+    src = jnp.clip(claim, 0, n - 1)
+
+    st = st.replace(
+        pmem=jnp.where(infected[:, None], st.inj_mem[src], st.pmem),
+        pmem_len=jnp.where(infected, st.inj_len[src], st.pmem_len),
+        parasite_active=st.parasite_active | infected,
+        smt_head_pos=st.smt_head_pos.at[:, 1].set(
+            jnp.where(infected[:, None], 0, st.smt_head_pos[:, 1])),
+        smt_head_space=st.smt_head_space.at[:, 1].set(
+            jnp.where(infected[:, None], 2, st.smt_head_space[:, 1])),
+        smt_stacks=st.smt_stacks.at[:, 1].set(
+            jnp.where(infected[:, None, None], 0, st.smt_stacks[:, 1])),
+        smt_sp=st.smt_sp.at[:, 1].set(
+            jnp.where(infected[:, None], 0, st.smt_sp[:, 1])),
+        # every staged injection is consumed, success or not
+        inject_pending=jnp.where(pend, False, st.inject_pending),
+    )
     return st

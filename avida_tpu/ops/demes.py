@@ -126,8 +126,12 @@ _ZERO_FIELDS = [
     "child_copied_size", "num_divides", "off_start", "off_len",
     "off_copied_size", "insts_executed", "budget_carry",
     "last_bonus", "last_merit_base",
+    # TransSMT hardware state (size-0 axes on heads hardware)
+    "smt_aux", "smt_aux_len", "pmem", "pmem_len", "smt_stacks", "smt_sp",
+    "gstack", "gsp", "smt_head_pos", "inj_mem", "inj_len",
 ]
-_FALSE_FIELDS = ["mal_active", "breed_true", "divide_pending", "off_sex"]
+_FALSE_FIELDS = ["mal_active", "breed_true", "divide_pending", "off_sex",
+                 "parasite_active", "inject_pending"]
 
 
 def _clone_reset(params, st, sel_cells, genome, genome_len, alive, merit,
@@ -166,6 +170,11 @@ def _clone_reset(params, st, sel_cells, genome, genome_len, alive, merit,
     updates["birth_update"] = jnp.where(sel_cells, -1, st.birth_update)
     updates["inputs"] = jnp.where(sel_cells[:, None],
                                   make_cell_inputs(key, n), st.inputs)
+    if params.hw_type in (1, 2):
+        base = jnp.asarray([[0, 0, 0, 0], [2, 2, 2, 2]],
+                           st.smt_head_space.dtype)
+        updates["smt_head_space"] = jnp.where(
+            sel_cells[:, None, None], base[None], st.smt_head_space)
     return updates
 
 

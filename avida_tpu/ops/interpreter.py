@@ -544,13 +544,20 @@ def extract_offspring(params, st, key):
     single deletion; stock rates 0/0.05/0.05).
 
     Runs once per update in the birth engine -- the deferred half of
-    h-divide.  Returns (off int8[N, L], off_len int32[N])."""
+    h-divide.  Returns (off int8[N, L], off_len int32[N]).
+
+    TransSMT hardware divides off the host write buffer instead of a tape
+    suffix (Divide_Main, cHardwareTransSMT.cc:438); the divide-mutation
+    machinery below is shared."""
     n, L = st.tape.shape
     rows = jnp.arange(n)
     cols = jnp.arange(L)
-    ops = tape_ops(st.tape).astype(jnp.int8)
-    off = barrel_shift_left(ops, st.off_start, L)
     off_len = st.off_len
+    if params.hw_type in (1, 2):
+        off = st.smt_aux[:, 0].astype(jnp.int8)
+    else:
+        ops = tape_ops(st.tape).astype(jnp.int8)
+        off = barrel_shift_left(ops, st.off_start, L)
     off = jnp.where(cols[None, :] < off_len[:, None], off, jnp.int8(0))
 
     gsize = st.genome_len.astype(jnp.float32)

@@ -1,0 +1,580 @@
+"""Lockstep interpreter for the TransSMT hardware (models/transsmt.py).
+
+One SMT CPU cycle for the whole population as masked tensor ops, mirroring
+cHardwareTransSMT::SingleProcess (avida-core/source/cpu/cHardwareTransSMT.cc
+~255-330): pick the executing thread (host or parasite, by virulence),
+fetch from the thread IP's (memory_space, position), resolve the nop
+modifier, dispatch on semantic opcode.
+
+Memory-space model (see models/transsmt.py header): 4 spaces per organism
+  0: the genome tape (packed, shares PopulationState.tape)
+  1: host write buffer    (smt_aux[:, 0])
+  2: parasite code        (pmem)
+  3: parasite write buffer (smt_aux[:, 1])
+Thread 0 (host) starts at (0, 0); thread 1 (parasite) at (2, 0).
+SetMemory points FLOW at the calling thread's write buffer.
+
+Divide (host thread) submits smt_aux[:,0][:wpos] as offspring through the
+shared birth engine; Inject (either thread) stages its write buffer into
+inj_mem for flush-time infection of a neighbor (Inst_Inject cc:1657,
+ParasiteInfectHost cc:375).  PARASITE_VIRULENCE is the per-cycle
+probability the parasite thread runs (cc:242-249); -1 = fair alternation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from avida_tpu.models.transsmt import (
+    HEAD_FLOW, HEAD_IP, HEAD_READ, HEAD_WRITE, MAX_LABEL_SIZE,
+    SEM_ADD, SEM_DEC, SEM_DIV, SEM_DIVIDE, SEM_HEAD_MOVE, SEM_HEAD_POP,
+    SEM_HEAD_PUSH, SEM_IF_EQU, SEM_IF_GTR, SEM_IF_LESS, SEM_IF_NEQU,
+    SEM_INC, SEM_INJECT, SEM_IO, SEM_MOD, SEM_MULT, SEM_NAND, SEM_NOP,
+    SEM_PUSH_COMP, SEM_PUSH_NEXT, SEM_PUSH_PREV, SEM_READ, SEM_SEARCH,
+    SEM_SET_MEMORY, SEM_SHIFT_L, SEM_SHIFT_R, SEM_SUB, SEM_VAL_COPY,
+    SEM_VAL_DELETE, SEM_WRITE,
+    STACK_AX, STACK_BX,
+)
+from avida_tpu.ops import tasks as tasks_ops
+
+MIN_INJECT_SIZE = 8      # nHardwareTransSMT MIN_INJECT_SIZE
+
+
+def _space_planes(st):
+    """The four memory-space opcode planes + their sizes."""
+    planes = [
+        (st.tape & jnp.uint8(0x3F)).astype(jnp.int32),
+        st.smt_aux[:, 0].astype(jnp.int32),
+        st.pmem.astype(jnp.int32),
+        st.smt_aux[:, 1].astype(jnp.int32),
+    ]
+    sizes = [st.mem_len, st.smt_aux_len[:, 0], st.pmem_len,
+             st.smt_aux_len[:, 1]]
+    return planes, sizes
+
+
+def _read_at(planes, sizes, space, pos):
+    """opcode at (space, pos) per organism; 0 beyond the space's length."""
+    n = space.shape[0]
+    L = planes[0].shape[1]
+    cols = jnp.arange(L)
+    out = jnp.zeros(n, jnp.int32)
+    for k, (pl, sz) in enumerate(zip(planes, sizes)):
+        m = (space == k)[:, None] & (cols[None, :] == pos[:, None]) \
+            & (cols[None, :] < sz[:, None])
+        out = out + jnp.sum(jnp.where(m, pl, 0), axis=1)
+    return out
+
+
+def _space_size(sizes, space):
+    out = jnp.zeros_like(space)
+    for k, sz in enumerate(sizes):
+        out = jnp.where(space == k, sz, out)
+    return out
+
+
+def micro_step_smt(params, st, key, exec_mask):
+    """One TransSMT CPU cycle for every organism where exec_mask is set."""
+    n, L = st.tape.shape
+    cols = jnp.arange(L)
+    sem_t = jnp.asarray(params.sem, jnp.int32)
+    is_nop_t = jnp.asarray(params.is_nop, bool)
+    nop_mod_t = jnp.asarray(params.nop_mod, jnp.int32)
+    num_insts = params.num_insts
+
+    k_thr, k_mut, k_inst = jax.random.split(key, 3)
+
+    # ---- thread selection (virulence; cc:242-249) ----
+    v = params.parasite_virulence if params.parasite_virulence >= 0 else 0.5
+    run_parasite = st.parasite_active & (
+        jax.random.uniform(k_thr, (n,)) < v) & exec_mask
+    t = run_parasite.astype(jnp.int32)            # active thread id [N]
+
+    def thr(x):
+        """Select the active thread's row of an [N, T, ...] field."""
+        return jnp.where(
+            (t == 1).reshape((n,) + (1,) * (x.ndim - 2)), x[:, 1], x[:, 0])
+
+    planes, sizes = _space_planes(st)
+    head_pos = thr(st.smt_head_pos)               # [N, 4]
+    head_space = thr(st.smt_head_space)           # [N, 4]
+    ip_s = head_space[:, HEAD_IP]
+    ip_sz = jnp.maximum(_space_size(sizes, ip_s), 1)
+    ip_p = head_pos[:, HEAD_IP] % ip_sz
+
+    cur_op = jnp.clip(_read_at(planes, sizes, ip_s, ip_p), 0, num_insts - 1)
+    sem = jnp.where(exec_mask, sem_t[cur_op], -1)
+
+    def is_op(s):
+        return sem == s
+
+    # ---- nop modifier (FindModifiedStack/Head, cc:... inline helpers) ----
+    nxt_p = (ip_p + 1) % ip_sz
+    next_op = jnp.clip(_read_at(planes, sizes, ip_s, nxt_p), 0, num_insts - 1)
+    has_mod = is_nop_t[next_op]
+    mod_val = nop_mod_t[next_op]                  # 0-3
+    consumed = has_mod.astype(jnp.int32)
+
+    # per-semantic default stacks/heads
+    dflt = jnp.zeros(n, jnp.int32) + STACK_BX
+    for s in (SEM_IF_EQU, SEM_IF_NEQU, SEM_IF_LESS, SEM_IF_GTR):
+        dflt = jnp.where(is_op(s), STACK_AX, dflt)
+    dflt = jnp.where(is_op(SEM_PUSH_NEXT), STACK_AX, dflt)
+    operand = jnp.where(has_mod, mod_val, dflt)
+
+    head_dflt = jnp.full(n, HEAD_IP, jnp.int32)
+    head_dflt = jnp.where(is_op(SEM_READ), HEAD_READ, head_dflt)
+    head_dflt = jnp.where(is_op(SEM_WRITE), HEAD_WRITE, head_dflt)
+    head_op = jnp.where(has_mod & (mod_val < 4), mod_val, head_dflt)
+
+    # ---- label read for Search/SetMemory (<=3 nops after IP): the run of
+    # nops IS the label (ReadLabel cc:1521) ----
+    lp = ip_p
+    lab_len = jnp.zeros(n, jnp.int32)
+    running = jnp.ones(n, bool)
+    lab_vals = []
+    for k in range(MAX_LABEL_SIZE):
+        lp = (lp + 1) % ip_sz
+        op_k = jnp.clip(_read_at(planes, sizes, ip_s, lp), 0, num_insts - 1)
+        isn = is_nop_t[op_k] & running
+        lab_vals.append(jnp.where(isn, nop_mod_t[op_k], -1))
+        lab_len = lab_len + isn.astype(jnp.int32)
+        running = running & is_nop_t[op_k]
+    has_label_sem = is_op(SEM_SEARCH) | is_op(SEM_SET_MEMORY) \
+        | is_op(SEM_INJECT)
+    consumed = jnp.where(has_label_sem, lab_len, consumed)
+
+    # ---- stacks: unified [N, 4, 10] view (3 local of active thread +
+    # global) ----
+    local = thr(st.smt_stacks)                    # [N, 3, 10]
+    local_sp = thr(st.smt_sp)                     # [N, 3]
+    stacks = jnp.concatenate([local, st.gstack[:, None, :]], axis=1)
+    sps = jnp.concatenate([local_sp, st.gsp[:, None]], axis=1)  # [N, 4]
+
+    def top(stk_idx):
+        slot = (jnp.arange(4)[None, :, None] == stk_idx[:, None, None]) & \
+            (jnp.arange(10)[None, None, :] ==
+             jnp.sum(jnp.where(jnp.arange(4)[None, :] == stk_idx[:, None],
+                               sps, 0), axis=1)[:, None, None])
+        return jnp.sum(jnp.where(slot, stacks, 0), axis=(1, 2))
+
+    def sp_of(stk_idx):
+        return jnp.sum(jnp.where(jnp.arange(4)[None, :] == stk_idx[:, None],
+                                 sps, 0), axis=1)
+
+    # operand stacks
+    src1 = operand
+    nxt_stack = (operand + 1) % 4
+    prv_stack = (operand + 3) % 4
+    op2 = jnp.where(has_mod, nxt_stack, (dflt + 1) % 4)
+
+    v1 = top(src1)
+    v2 = top(op2)
+
+    # ---- PRNG ----
+    u_mut = jax.random.uniform(k_mut, (n,))
+    rand_inst = jax.random.randint(k_inst, (n,), 0, num_insts,
+                                   dtype=jnp.int32)
+
+    # ---- compute push/pop plan ----
+    # Each instruction does at most one pop from `pop_stack` and one push of
+    # `push_val` onto `push_stack` (-1 = none).
+    pop_stack = jnp.full(n, -1, jnp.int32)
+    push_stack = jnp.full(n, -1, jnp.int32)
+    push_val = jnp.zeros(n, jnp.int32)
+
+    def plan(mask, pops, pushes, val=None):
+        """Record this instruction's (at most one) pop and push."""
+        nonlocal pop_stack, push_stack, push_val
+        if pops is not None:
+            pop_stack = jnp.where(mask, pops, pop_stack)
+        if pushes is not None:
+            push_stack = jnp.where(mask, pushes, push_stack)
+            push_val = jnp.where(mask, val, push_val)
+
+    # Val unary ops: pop src (== dst), push f(value)   (cc:983-1028)
+    for s, f in ((SEM_SHIFT_R, lambda x: x >> 1), (SEM_SHIFT_L, lambda x: x << 1),
+                 (SEM_INC, lambda x: x + 1), (SEM_DEC, lambda x: x - 1)):
+        m = is_op(s)
+        plan(m, src1, src1, f(v1))
+    # Val binary ops: push f(op1.top, op2.top) onto dst=op1 (no pop; cc:919)
+    z2 = jnp.where(v2 == 0, 1, v2)
+    for s, val in ((SEM_NAND, ~(v1 & v2)), (SEM_ADD, v1 + v2),
+                   (SEM_SUB, v1 - v2), (SEM_MULT, v1 * v2),
+                   (SEM_DIV, v1 // z2), (SEM_MOD, v1 % z2)):
+        m = is_op(s)
+        if s in (SEM_DIV, SEM_MOD):
+            m = m & (v2 != 0)
+        plan(m, None, src1, val)
+    # Val-Copy: push src.top onto dst (dst=?BX?, src=?dst?) -- both resolve
+    # to the same modified stack in the common case
+    plan(is_op(SEM_VAL_COPY), None, src1, v1)
+    # Val-Delete: pop
+    plan(is_op(SEM_VAL_DELETE), src1, None)
+    # Push-Next / Push-Prev / Push-Comp (cc:1197-1225): the modifier
+    # selects the SOURCE (already in src1); dst = next/prev of it.
+    # Push-Comp's no-second-nop fallback is FindPreviousStack in the
+    # reference too (FindModifiedComplementStack's else branch) -- a
+    # faithful quirk, not a bug here.
+    plan(is_op(SEM_PUSH_NEXT), src1, nxt_stack, v1)
+    plan(is_op(SEM_PUSH_PREV), src1, prv_stack, v1)
+    plan(is_op(SEM_PUSH_COMP), src1, prv_stack, v1)
+    # Head-Push: push pos of ?IP? head onto BX (single-modifier model: a
+    # nop selects the HEAD; dst stays STACK_BX)
+    hsel = jnp.sum(jnp.where(jnp.arange(4)[None, :] == head_op[:, None],
+                             head_pos, 0), axis=1)
+    plan(is_op(SEM_HEAD_PUSH), None, jnp.full(n, STACK_BX), hsel)
+    # Head-Pop: pop ?BX?, head write happens below
+    headpop_val = v1
+    plan(is_op(SEM_HEAD_POP), src1, None)
+
+    # ---- Search (cc:1172): complement label (rotate +2 mod 4) in IP space
+    srch = is_op(SEM_SEARCH)
+    lbl_c = [jnp.where(x >= 0, (x + 2) % 4, -2) for x in lab_vals]
+
+    def search_block(_):
+        # match positions in the IP's space
+        found = jnp.full(n, -1, jnp.int32)
+        best = jnp.full(n, L + 1, jnp.int32)
+        # scan each space plane for the complement label, positions after IP
+        for k, (pl, sz) in enumerate(zip(planes, sizes)):
+            clipped = jnp.clip(pl, 0, num_insts - 1)
+            nv = jnp.where(is_nop_t[clipped], nop_mod_t[clipped], -3)
+            match = jnp.ones((n, L), bool)
+            for q in range(MAX_LABEL_SIZE):
+                shifted = jnp.concatenate(
+                    [nv[:, q:], jnp.full((n, q), -4, jnp.int32)], axis=1) \
+                    if q else nv
+                match = match & (
+                    (shifted == lbl_c[q][:, None]) | (q >= lab_len)[:, None])
+            match = match & (cols[None, :] < sz[:, None]) & \
+                (lab_len > 0)[:, None] & (ip_s == k)[:, None]
+            # circular search forward from IP: rank positions by distance
+            dist = (cols[None, :] - ip_p[:, None]) % jnp.maximum(
+                sz[:, None], 1)
+            dist = jnp.where(match, dist, L + 1)
+            dmin = dist.min(axis=1)
+            pos = jnp.argmin(dist, axis=1)
+            better = dmin < best
+            found = jnp.where(better, pos, found)
+            best = jnp.where(better, dmin, best)
+        return found
+
+    found_pos = jax.lax.cond(srch.any(), search_block,
+                             lambda _: jnp.full(n, -1, jnp.int32), None)
+    srch_hit = srch & (found_pos >= 0) & (found_pos != ip_p)
+    srch_miss = srch & ~srch_hit
+
+    # ---- SetMemory (cc:1567): FLOW <- (write buffer of thread, 0);
+    # empty label -> (base space, 0)
+    setmem = is_op(SEM_SET_MEMORY)
+    aux_space = jnp.where(t == 1, 3, 1)
+    base_space = jnp.where(t == 1, 2, 0)
+    setmem_space = jnp.where(lab_len > 0, aux_space, base_space)
+
+    # ---- Inst-Read (cc:1304) ----
+    read_m = is_op(SEM_READ)
+    r_space = jnp.sum(jnp.where(jnp.arange(4)[None, :] == head_op[:, None],
+                                head_space, 0), axis=1)
+    r_sz = jnp.maximum(_space_size(sizes, r_space), 1)
+    r_pos = jnp.sum(jnp.where(jnp.arange(4)[None, :] == head_op[:, None],
+                              head_pos, 0), axis=1) % r_sz
+    read_inst = _read_at(planes, sizes, r_space, r_pos)
+    do_mut = read_m & (u_mut < params.copy_mut_prob) & (t == 0)
+    read_val = jnp.where(do_mut, rand_inst, read_inst)
+    # single-modifier model: the nop selects the HEAD (first FindModified*
+    # call in Inst_HeadRead); the stack keeps its STACK_AX default
+    plan(read_m, None, jnp.full(n, STACK_AX), read_val)
+
+    # ---- Inst-Write (cc:1341) ----
+    write_m = is_op(SEM_WRITE)
+    w_space = jnp.where(write_m,
+                        jnp.sum(jnp.where(jnp.arange(4)[None, :] ==
+                                          head_op[:, None], head_space, 0),
+                                axis=1), 0)
+    w_sz0 = _space_size(sizes, w_space)
+    w_pos = jnp.sum(jnp.where(jnp.arange(4)[None, :] == head_op[:, None],
+                              head_pos, 0), axis=1)
+    # grow-by-one then adjust (write buffer extension)
+    grow = write_m & (w_pos >= w_sz0 - 1) & (w_sz0 < L)
+    w_sz = jnp.where(grow, w_sz0 + 1, jnp.maximum(w_sz0, 1))
+    w_pos = w_pos % jnp.maximum(w_sz, 1)
+    w_stack = jnp.full(n, STACK_AX)    # modifier selects the head, not src
+    w_val0 = top(w_stack)
+    w_val = jnp.where((w_val0 < 0) | (w_val0 >= num_insts), 0, w_val0)
+    plan(write_m, w_stack, None)
+
+    # ---- IO (cc:1231): host thread only updates phenotype/tasks ----
+    io_m = is_op(SEM_IO)
+    io_stack = jnp.where(has_mod, mod_val, jnp.full(n, STACK_BX))
+    value_out = top(io_stack)
+    in_slot = jnp.arange(3)[None, :] == (st.input_ptr % 3)[:, None]
+    value_in = jnp.sum(jnp.where(in_slot, st.inputs, 0), axis=1)
+    plan(io_m, None, io_stack, value_in)
+    io_host = io_m & (t == 0)
+
+    def io_block(_):
+        env_tables = tasks_ops.env_tables_to_device(params)
+        logic_id = tasks_ops.compute_logic_id(st.input_buf, st.input_buf_n,
+                                              value_out)
+        return tasks_ops.apply_reactions(
+            params, env_tables, io_host, logic_id, st.cur_bonus,
+            st.cur_task_count, st.cur_reaction_count,
+            st.resources, st.res_grid)[:5]
+
+    new_bonus, new_tc, new_rc, resources, res_grid = jax.lax.cond(
+        io_host.any(), io_block,
+        lambda _: (st.cur_bonus, st.cur_task_count, st.cur_reaction_count,
+                   st.resources, st.res_grid), None)
+    input_ptr = jnp.where(io_m, st.input_ptr + 1, st.input_ptr)
+    input_buf = jnp.where(io_m[:, None],
+                          jnp.stack([value_in, st.input_buf[:, 0],
+                                     st.input_buf[:, 1]], axis=1),
+                          st.input_buf)
+    input_buf_n = jnp.where(io_m, jnp.minimum(st.input_buf_n + 1, 3),
+                            st.input_buf_n)
+    cur_bonus = jnp.where(io_host, new_bonus, st.cur_bonus)
+    cur_task_count = jnp.where(io_host[:, None], new_tc, st.cur_task_count)
+    cur_reaction_count = jnp.where(io_host[:, None], new_rc,
+                                   st.cur_reaction_count)
+
+    # ---- conditionals (skip next on false) ----
+    skip = ((is_op(SEM_IF_EQU) & (v1 != v2))
+            | (is_op(SEM_IF_NEQU) & (v1 == v2))
+            | (is_op(SEM_IF_LESS) & (v1 >= v2))
+            | (is_op(SEM_IF_GTR) & (v1 <= v2)))
+
+    # ---- Divide (host thread; Divide_Main cc:438) ----
+    div_try = is_op(SEM_DIVIDE) & (t == 0)
+    wh_space = head_space[:, HEAD_WRITE]
+    wh_pos = head_pos[:, HEAD_WRITE]
+    child_size = wh_pos
+    psize = jnp.maximum(st.mem_len, 1)
+    fsize = psize.astype(jnp.float32)
+    min_sz = jnp.maximum(params.min_genome_len,
+                         (fsize / params.offspring_size_range)
+                         .astype(jnp.int32))
+    max_sz = jnp.minimum(L, (fsize * params.offspring_size_range)
+                         .astype(jnp.int32))
+    div_m = (div_try & (wh_space == 1)
+             & (child_size >= min_sz) & (child_size <= max_sz)
+             & ~st.divide_pending)
+
+    # ---- Inject (either thread; cc:1657) ----
+    inj_try = is_op(SEM_INJECT)
+    inj_space_ok = jnp.where(t == 1, wh_space == 3, wh_space == 1)
+    inj_m = (inj_try & inj_space_ok & (wh_pos >= MIN_INJECT_SIZE)
+             & ~st.inject_pending)
+    inj_src = jnp.where((t == 1)[:, None], st.smt_aux[:, 1],
+                        st.smt_aux[:, 0])
+    inj_mem = jnp.where(inj_m[:, None], inj_src, st.inj_mem)
+    inj_len = jnp.where(inj_m, wh_pos, st.inj_len)
+    # the injecting thread's write buffer resets (cc:1693)
+    aux_reset_inj = inj_m
+
+    # ---- apply stack plan ----
+    slot_idx = jnp.arange(10)[None, None, :]
+    stk_idx = jnp.arange(4)[None, :, None]
+    # pop first (Val-Inc pops then pushes; Push-* pop src push dst)
+    do_pop = exec_mask & (pop_stack >= 0)
+    pop_sp = sp_of(jnp.clip(pop_stack, 0, 3))
+    pop_slot = (stk_idx == pop_stack[:, None, None]) & \
+        (slot_idx == pop_sp[:, None, None]) & do_pop[:, None, None]
+    stacks = jnp.where(pop_slot, 0, stacks)
+    sps = jnp.where((jnp.arange(4)[None, :] == pop_stack[:, None]) &
+                    do_pop[:, None], (sps + 1) % 10, sps)
+    # then push
+    do_push = exec_mask & (push_stack >= 0)
+    push_sp = (sp_of(jnp.clip(push_stack, 0, 3)) + 9) % 10
+    push_slot = (stk_idx == push_stack[:, None, None]) & \
+        (slot_idx == push_sp[:, None, None]) & do_push[:, None, None]
+    stacks = jnp.where(push_slot, push_val[:, None, None], stacks)
+    sps = jnp.where((jnp.arange(4)[None, :] == push_stack[:, None]) &
+                    do_push[:, None], push_sp[:, None], sps)
+
+    # ---- head updates ----
+    onehot_h = jnp.arange(4)[None, :] == head_op[:, None]
+    new_pos = head_pos
+    new_space = head_space
+    # Head-Move: ?IP? <- FLOW; FLOW itself just advances (cc:1151)
+    mv = is_op(SEM_HEAD_MOVE)
+    mv_flow = mv & (head_op == HEAD_FLOW)
+    mv_other = mv & ~mv_flow
+    new_pos = jnp.where(onehot_h & mv_other[:, None],
+                        head_pos[:, HEAD_FLOW][:, None], new_pos)
+    new_space = jnp.where(onehot_h & mv_other[:, None],
+                          head_space[:, HEAD_FLOW][:, None], new_space)
+    new_pos = new_pos.at[:, HEAD_FLOW].set(
+        jnp.where(mv_flow, head_pos[:, HEAD_FLOW] + 1,
+                  new_pos[:, HEAD_FLOW]))
+    # Head-Pop: ?IP? <- (popped value, same space)
+    hp = is_op(SEM_HEAD_POP)
+    new_pos = jnp.where(onehot_h & hp[:, None], headpop_val[:, None],
+                        new_pos)
+    # Search results -> FLOW (cc:1172)
+    new_pos = new_pos.at[:, HEAD_FLOW].set(
+        jnp.where(srch_hit, found_pos,
+                  jnp.where(srch_miss, ip_p + 1,
+                            new_pos[:, HEAD_FLOW])))
+    new_space = new_space.at[:, HEAD_FLOW].set(
+        jnp.where(srch, ip_s, new_space[:, HEAD_FLOW]))
+    # Search pushes: hit -> BX=dist+len+1, AX=len; miss -> BX=0
+    srch_size = (found_pos - ip_p) % jnp.maximum(ip_sz, 1) + lab_len + 1
+    sps, stacks = _push2(stacks, sps, srch_hit, STACK_BX, srch_size,
+                         exec_mask)
+    sps, stacks = _push2(stacks, sps, srch_hit, STACK_AX, lab_len, exec_mask)
+    sps, stacks = _push2(stacks, sps, srch_miss, STACK_BX,
+                         jnp.zeros(n, jnp.int32), exec_mask)
+    # SetMemory -> FLOW
+    new_pos = new_pos.at[:, HEAD_FLOW].set(
+        jnp.where(setmem, 0, new_pos[:, HEAD_FLOW]))
+    new_space = new_space.at[:, HEAD_FLOW].set(
+        jnp.where(setmem, setmem_space, new_space[:, HEAD_FLOW]))
+    # Inst-Read / Inst-Write advance their heads
+    adv = (read_m | write_m)
+    new_pos = jnp.where(onehot_h & adv[:, None], new_pos + 1, new_pos)
+
+    # ---- memory-space writes (Inst-Write) ----
+    smt_aux = st.smt_aux
+    pmem = st.pmem
+    tape = st.tape
+    mem_len = st.mem_len
+    aux_len = st.smt_aux_len
+    pmem_len = st.pmem_len
+    for k in range(4):
+        wm = write_m & (w_space == k) & exec_mask
+        site = (cols[None, :] == w_pos[:, None]) & wm[:, None]
+        if k == 0:
+            tape = jnp.where(site, (w_val.astype(jnp.uint8)
+                                    | jnp.uint8(0x80))[:, None], tape)
+            mem_len = jnp.where(wm, jnp.maximum(mem_len, w_sz), mem_len)
+        elif k == 2:
+            pmem = jnp.where(site, w_val.astype(jnp.uint8)[:, None], pmem)
+            pmem_len = jnp.where(wm, jnp.maximum(pmem_len, w_sz), pmem_len)
+        else:
+            ti = 0 if k == 1 else 1
+            smt_aux = smt_aux.at[:, ti].set(
+                jnp.where(site, w_val.astype(jnp.uint8)[:, None],
+                          smt_aux[:, ti]))
+            aux_len = aux_len.at[:, ti].set(
+                jnp.where(wm, jnp.maximum(aux_len[:, ti], w_sz),
+                          aux_len[:, ti]))
+
+    # inject: reset the injecting thread's write buffer
+    for ti in range(2):
+        m = aux_reset_inj & (t == ti)
+        smt_aux = smt_aux.at[:, ti].set(
+            jnp.where(m[:, None], jnp.uint8(0), smt_aux[:, ti]))
+        aux_len = aux_len.at[:, ti].set(jnp.where(m, 1, aux_len[:, ti]))
+
+    # ---- divide bookkeeping (deferred to flush) ----
+    off_len = jnp.where(div_m, child_size, st.off_len)
+    # phenotype DivideReset (shared semantics with the heads engine)
+    gestation = st.time_used + 1 - st.gestation_start
+    merit_base = jnp.minimum(st.mem_len, child_size).astype(st.merit.dtype)
+    new_merit = jnp.where(div_m, merit_base * cur_bonus
+                          if params.inherit_merit else merit_base, st.merit)
+    fitness = jnp.where(div_m, new_merit /
+                        jnp.maximum(gestation, 1).astype(st.merit.dtype),
+                        st.fitness)
+    gestation_time = jnp.where(div_m, gestation, st.gestation_time)
+    generation = jnp.where(div_m, st.generation + 1, st.generation)
+    num_divides = jnp.where(div_m, st.num_divides + 1, st.num_divides)
+    last_task_count = jnp.where(div_m[:, None], cur_task_count,
+                                st.last_task_count)
+    cur_task_count = jnp.where(div_m[:, None], 0, cur_task_count)
+    cur_reaction_count = jnp.where(div_m[:, None], 0, cur_reaction_count)
+    cur_bonus2 = jnp.where(div_m, params.default_bonus, cur_bonus)
+    last_bonus = jnp.where(div_m, cur_bonus, st.last_bonus)
+
+    # ---- IP advance ----
+    mv_ip = mv_other & (head_op == HEAD_IP)
+    ip_next = (ip_p + consumed + skip.astype(jnp.int32) + 1) % ip_sz
+    new_pos = new_pos.at[:, HEAD_IP].set(
+        jnp.where(mv_ip, new_pos[:, HEAD_IP],         # Head-Move: no advance
+                  jnp.where(exec_mask, ip_next, new_pos[:, HEAD_IP])))
+    new_pos = jnp.where(div_m[:, None], 0, new_pos)
+    new_space = jnp.where(div_m[:, None], base_space[:, None], new_space)
+
+    # ---- scatter thread state back ----
+    t1 = (t == 1) & exec_mask
+    t0 = (t == 0) & exec_mask
+    smt_head_pos = st.smt_head_pos
+    smt_head_space = st.smt_head_space
+    smt_head_pos = smt_head_pos.at[:, 0].set(
+        jnp.where(t0[:, None], new_pos, smt_head_pos[:, 0]))
+    smt_head_pos = smt_head_pos.at[:, 1].set(
+        jnp.where(t1[:, None], new_pos, smt_head_pos[:, 1]))
+    smt_head_space = smt_head_space.at[:, 0].set(
+        jnp.where(t0[:, None], new_space, smt_head_space[:, 0]))
+    smt_head_space = smt_head_space.at[:, 1].set(
+        jnp.where(t1[:, None], new_space, smt_head_space[:, 1]))
+    smt_stacks = st.smt_stacks
+    smt_sp = st.smt_sp
+    smt_stacks = smt_stacks.at[:, 0].set(
+        jnp.where(t0[:, None, None], stacks[:, :3], smt_stacks[:, 0]))
+    smt_stacks = smt_stacks.at[:, 1].set(
+        jnp.where(t1[:, None, None], stacks[:, :3], smt_stacks[:, 1]))
+    smt_sp = smt_sp.at[:, 0].set(
+        jnp.where(t0[:, None], sps[:, :3], smt_sp[:, 0]))
+    smt_sp = smt_sp.at[:, 1].set(
+        jnp.where(t1[:, None], sps[:, :3], smt_sp[:, 1]))
+    gstack = jnp.where(exec_mask[:, None], stacks[:, 3], st.gstack)
+    gsp = jnp.where(exec_mask, sps[:, 3], st.gsp)
+
+    # divide resets the whole CPU (DIVIDE_METHOD 1 SPLIT, cc:492-496):
+    # both threads' heads/stacks, parasite wiped
+    smt_head_pos = jnp.where(div_m[:, None, None], 0, smt_head_pos)
+    base_spaces = jnp.asarray([[0, 0, 0, 0], [2, 2, 2, 2]], jnp.int32)
+    smt_head_space = jnp.where(div_m[:, None, None], base_spaces[None],
+                               smt_head_space)
+    smt_stacks = jnp.where(div_m[:, None, None, None], 0, smt_stacks)
+    smt_sp = jnp.where(div_m[:, None, None], 0, smt_sp)
+    gstack = jnp.where(div_m[:, None], 0, gstack)
+    gsp = jnp.where(div_m, 0, gsp)
+    parasite_active = jnp.where(div_m, False, st.parasite_active)
+    pmem_len = jnp.where(div_m, 0, pmem_len)
+
+    # ---- time + death ----
+    time_used = st.time_used + exec_mask.astype(jnp.int32)
+    died = exec_mask & (st.max_executed > 0) & (time_used >= st.max_executed)
+    alive = st.alive & ~died
+    insts_executed = st.insts_executed + exec_mask.astype(jnp.int32)
+    gestation_start = jnp.where(div_m, time_used, st.gestation_start)
+
+    return st.replace(
+        tape=tape, mem_len=mem_len,
+        smt_aux=smt_aux, smt_aux_len=aux_len, pmem=pmem, pmem_len=pmem_len,
+        parasite_active=parasite_active,
+        smt_stacks=smt_stacks, smt_sp=smt_sp, gstack=gstack, gsp=gsp,
+        smt_head_pos=smt_head_pos, smt_head_space=smt_head_space,
+        inject_pending=st.inject_pending | inj_m,
+        inj_mem=inj_mem, inj_len=inj_len,
+        divide_pending=st.divide_pending | div_m,
+        off_start=jnp.zeros_like(st.off_start), off_len=off_len,
+        off_copied_size=jnp.where(div_m, off_len, st.off_copied_size),
+        merit=new_merit, fitness=fitness, gestation_time=gestation_time,
+        generation=generation, num_divides=num_divides,
+        gestation_start=gestation_start,
+        last_task_count=last_task_count, cur_task_count=cur_task_count,
+        cur_reaction_count=cur_reaction_count, cur_bonus=cur_bonus2,
+        last_bonus=last_bonus,
+        input_ptr=input_ptr, input_buf=input_buf, input_buf_n=input_buf_n,
+        time_used=time_used, cpu_cycles=st.cpu_cycles +
+        exec_mask.astype(jnp.int32),
+        alive=alive, insts_executed=insts_executed,
+        resources=resources, res_grid=res_grid,
+    )
+
+
+def _push2(stacks, sps, mask, stack_id, val, exec_mask):
+    """Push val onto a FIXED stack id where mask&exec_mask (helper for
+    Search's multi-push)."""
+    m = mask & exec_mask
+    new_sp = (sps[:, stack_id] + 9) % 10
+    slot = (jnp.arange(10)[None, :] == new_sp[:, None]) & m[:, None]
+    stacks = stacks.at[:, stack_id].set(
+        jnp.where(slot, val[:, None], stacks[:, stack_id]))
+    sps = sps.at[:, stack_id].set(jnp.where(m, new_sp, sps[:, stack_id]))
+    return sps, stacks

@@ -40,6 +40,8 @@ def use_pallas_path(params) -> bool:
     registers no GSPMD partitioning rule, so a sharded multi-chip
     update (parallel/mesh.py) must stay on the XLA while_loop path, which
     GSPMD partitions cleanly."""
+    if params.hw_type != 0:
+        return False      # the cycle kernel implements heads hardware only
     if params.use_pallas == 2:
         return False
     if params.use_pallas == 1:
@@ -97,14 +99,20 @@ def update_step(params, st, key, neighbors, update_no):
             s, _ = carry
             return s < max_k
 
+        if params.hw_type in (1, 2):
+            from avida_tpu.ops.interpreter_smt import micro_step_smt
+            step_fn = micro_step_smt
+        else:
+            step_fn = micro_step
+
         def body(carry):
             s, st = carry
             # a freshly divided parent stalls until the end-of-update birth
             # flush extracts its offspring from the tape (deferred h-divide;
             # ops/interpreter.py header) -- it resumes next update
             exec_mask = st.alive & (s < granted) & ~st.divide_pending
-            st = micro_step(params, st, jax.random.fold_in(k_steps, s),
-                            exec_mask)
+            st = step_fn(params, st, jax.random.fold_in(k_steps, s),
+                         exec_mask)
             return s + 1, st
 
         _, st = jax.lax.while_loop(cond, body, (jnp.int32(0), st))

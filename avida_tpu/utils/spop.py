@@ -98,7 +98,8 @@ def restore_population(params, orgs, key, neighbors=None):
 
     n, L, R = params.num_cells, params.max_memory, params.num_reactions
     st = zeros_population(n, L, R, params.num_global_res,
-                          params.num_spatial_res, params.num_demes)
+                          params.num_spatial_res, params.num_demes,
+                          smt=(params.hw_type in (1, 2)))
     k_in, key = jax.random.split(key)
     st = st.replace(
         inputs=make_cell_inputs(k_in, n),

@@ -17,6 +17,7 @@ import numpy as np
 
 from avida_tpu.config import (AvidaConfig, load_avida_cfg, load_instset,
                               default_instset, heads_sex_instset,
+                              transsmt_instset,
                               load_organism, load_environment, load_events)
 from avida_tpu.config.environment import default_logic9_environment
 from avida_tpu.config.events import Event, parse_event_line
@@ -35,15 +36,45 @@ _DEFAULT_ANCESTOR_NAMES = (
        "mov-head", "nop-A", "nop-B"]
 )
 
+# Reference transsmt ancestor (support/config/default-transsmt.org): search
+# end label, SetMemory offspring space, copy loop, Divide at end-position.
+_TRANSSMT_ANCESTOR_NAMES = (
+    ["Search", "Nop-C", "Nop-D", "Push-Prev", "SetMemory", "Nop-A",
+     "Head-Move"]
+    + ["Nop-C"] * 83
+    + ["Search", "Inst-Read", "Inst-Write", "Head-Push", "Nop-C",
+       "If-Equal", "Divide", "Head-Move", "Nop-A", "Nop-B"]
+)
+
+# Reference transsmt parasite (support/config/default-transsmt-parasite.org):
+# nop body, copy loop into its own write space, Inject at the end.
+_TRANSSMT_PARASITE_NAMES = (
+    ["Nop-A"] + ["Nop-B"] * 75
+    + ["Inst-Read", "Val-Add", "Val-Dec", "SetMemory", "Nop-C", "IO",
+       "Nop-C", "Nop-B", "Head-Move", "Nop-C", "Search", "Inst-Write",
+       "Inst-Read", "If-Greater", "Head-Move", "Val-Sub", "Val-Dec", "IO",
+       "Val-Div", "Val-Dec", "Val-Dec", "Val-Dec", "Val-Div", "Inject"]
+)
+
 
 def default_ancestor(instset) -> np.ndarray:
     name_to_op = {n: i for i, n in enumerate(instset.inst_names)}
-    names = _DEFAULT_ANCESTOR_NAMES
-    if "h-divide" not in name_to_op and "divide-sex" in name_to_op:
+    if "Divide" in name_to_op or "Divide-Erase" in name_to_op:
+        names = _TRANSSMT_ANCESTOR_NAMES       # transsmt hardware
+    elif "h-divide" not in name_to_op and "divide-sex" in name_to_op:
         # sexual ancestor: same replicator with divide-sex
         # (ref support/config/default-heads-sex.org)
-        names = ["divide-sex" if n == "h-divide" else n for n in names]
+        names = ["divide-sex" if n == "h-divide" else n
+                 for n in _DEFAULT_ANCESTOR_NAMES]
+    else:
+        names = _DEFAULT_ANCESTOR_NAMES
     return np.asarray([name_to_op[n] for n in names], np.int8)
+
+
+def default_parasite(instset) -> np.ndarray:
+    name_to_op = {n: i for i, n in enumerate(instset.inst_names)}
+    return np.asarray([name_to_op[n] for n in _TRANSSMT_PARASITE_NAMES],
+                      np.int8)
 
 
 class World:
@@ -62,6 +93,8 @@ class World:
         # instruction set (cHardwareManager::LoadInstSets equivalent)
         if config_dir and cfg.INST_SET not in ("-", ""):
             self.instset = load_instset(os.path.join(config_dir, cfg.INST_SET))
+        elif "transsmt" in cfg.INST_SET or "smt" in cfg.INST_SET:
+            self.instset = transsmt_instset()
         elif "sex" in cfg.INST_SET:
             self.instset = heads_sex_instset()
         else:
@@ -166,6 +199,40 @@ class World:
     def _action_Inject(self, args):
         genome = self._resolve_org_path(args[0]) if args else None
         self.inject(genome)
+
+    def _action_InjectAll(self, args):
+        """InjectAll [filename]: an organism in every cell
+        (ref cActionInjectAll, actions/PopulationActions.cc)."""
+        genome = self._resolve_org_path(args[0]) if args else None
+        if self.state is None:
+            # bootstrap state only; the blanket reseed below covers cell 0,
+            # so suppress this inject's systematics record to avoid a
+            # double classification
+            sysm, self.systematics = self.systematics, None
+            self.inject(genome, cell=0)
+            self.systematics = sysm
+        g = genome if genome is not None else default_ancestor(self.instset)
+        n, L = self.params.num_cells, self.params.max_memory
+        import numpy as np_
+        gm = np_.zeros(L, np_.int8)
+        gm[: len(g)] = g
+        glen = len(g)
+        st = self.state
+        full = jnp.ones(n, bool)
+        self.key, k = jax.random.split(self.key)
+        from avida_tpu.core.state import make_cell_inputs
+        from avida_tpu.ops.demes import _clone_reset
+        genome_t = jnp.broadcast_to(jnp.asarray(gm)[None, :], (n, L))
+        updates = _clone_reset(
+            self.params, st, full, genome_t,
+            jnp.full(n, glen, jnp.int32), full,
+            jnp.full(n, float(glen), st.merit.dtype), k)
+        self.state = st.replace(**updates)
+        if self.systematics is not None:
+            # host-side loop is fine at test scale; large-world benches run
+            # with systematics off (the 100k InjectAll path)
+            for c in range(n):
+                self.systematics.classify_seed(c, g, update=self.update)
 
     def _action_Exit(self, args):
         self._exit = True
@@ -290,6 +357,36 @@ class World:
                     res_grid=self.state.res_grid.at[i].set(
                         jnp.full(n, level / n, jnp.float32)))
                 return
+
+    def _action_InjectParasite(self, args):
+        """InjectParasite [filename [label [cell_start [cell_end]]]]
+        (ref cActionInjectParasite, actions/PopulationActions.cc): place a
+        parasite genome into living organisms' parasite memory space.
+        Default genome is the stock transsmt parasite."""
+        import numpy as np_
+        if args and args[0] not in ("-", ""):
+            genome = self._resolve_org_path(args[0])
+        else:
+            genome = default_parasite(self.instset)
+        start = int(args[2]) if len(args) > 2 else 0
+        end = int(args[3]) if len(args) > 3 else start + 1
+        st = self.state
+        n, = st.alive.shape
+        L = self.params.max_memory
+        cells = jnp.arange(n)
+        sel = (cells >= start) & (cells < end) & st.alive \
+            & ~st.parasite_active
+        g = np_.zeros(L, np_.uint8)
+        g[: len(genome)] = genome.astype(np_.uint8)
+        self.state = st.replace(
+            pmem=jnp.where(sel[:, None], jnp.asarray(g)[None, :], st.pmem),
+            pmem_len=jnp.where(sel, len(genome), st.pmem_len),
+            parasite_active=st.parasite_active | sel,
+            smt_head_pos=st.smt_head_pos.at[:, 1].set(
+                jnp.where(sel[:, None], 0, st.smt_head_pos[:, 1])),
+            smt_head_space=st.smt_head_space.at[:, 1].set(
+                jnp.where(sel[:, None], 2, st.smt_head_space[:, 1])),
+        )
 
     def _action_CompeteDemes(self, args):
         """CompeteDemes [competition_type] (ref cPopulation::CompeteDemes;

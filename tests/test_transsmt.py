@@ -1,0 +1,86 @@
+"""TransSMT hardware + host-parasite coevolution.
+
+Covers BASELINE.json config 4 (transsmt + parasites).  Reference:
+cHardwareTransSMT (cpu/cHardwareTransSMT.cc) -- stack-based CPU with
+memory spaces; Inst_Inject (cc:1657) parasite transmission; virulence
+thread scheduling (cc:218-248); scenario modeled on the reference
+default_transsmt_100u and parasite tests.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from avida_tpu.config import AvidaConfig, transsmt_instset
+from avida_tpu.config.events import parse_event_line
+from avida_tpu.world import World, default_ancestor, default_parasite
+
+
+def _world(**kw):
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 10
+    cfg.WORLD_Y = 10
+    cfg.TPU_MAX_MEMORY = 160
+    cfg.RANDOM_SEED = 31
+    cfg.INST_SET = "transsmt"
+    cfg.AVE_TIME_SLICE = 100
+    cfg.TPU_MAX_STEPS_PER_UPDATE = 100
+    cfg.COPY_MUT_PROB = 0.0       # deterministic replication for the test
+    cfg.DIVIDE_INS_PROB = 0.0
+    cfg.DIVIDE_DEL_PROB = 0.0
+    cfg.set("TPU_SYSTEMATICS", 0)
+    for k, v in kw.items():
+        cfg.set(k, v)
+    return World(cfg=cfg)
+
+
+def test_transsmt_instset_loads():
+    s = transsmt_instset()
+    assert s.hw_type == 2
+    assert "Inject" in s.inst_names and "Divide" in s.inst_names
+    w = _world()
+    assert w.params.hw_type == 2
+    anc = default_ancestor(w.instset)
+    assert len(anc) == 100
+
+
+def test_transsmt_ancestor_self_replicates():
+    """The stock transsmt ancestor copies itself through its write buffer
+    and divides: population must grow (reference default_transsmt_100u)."""
+    w = _world()
+    w.inject()
+    w.run(max_updates=40)
+    n = w.num_organisms
+    assert n > 1, f"transsmt ancestor never divided (organisms={n})"
+    # offspring genomes are transsmt programs of plausible length
+    st = w.state
+    alive = np.asarray(st.alive)
+    lens = np.asarray(st.genome_len)[alive]
+    assert (lens >= 50).all() and (lens <= 160).all(), lens
+
+
+def test_host_parasite_world_both_persist():
+    """Inject the stock parasite into a host world: parasites must spread
+    (Inst_Inject through neighbors) while hosts keep reproducing --
+    BASELINE config 4's 'both populations persisting'."""
+    w = _world(PARASITE_VIRULENCE=0.8)
+    w.events = [parse_event_line("u begin Inject"),
+                parse_event_line("u 12 InjectAll"),
+                parse_event_line("u 20 InjectParasite - - 0 30")]
+    w.inject()
+    # fill a block of cells so parasites have hosts to spread into
+    for c in range(0, 30):
+        w.inject(cell=c)
+    w._action_InjectParasite(["-", "-", "0", "10"])
+    assert int(np.asarray(w.state.parasite_active).sum()) == 10
+    w.run(max_updates=40)
+    st = w.state
+    hosts = int(np.asarray(st.alive).sum())
+    parasites = int(np.asarray(st.parasite_active & st.alive).sum())
+    assert hosts > 10, f"host population collapsed: {hosts}"
+    assert parasites > 0, "parasites went extinct immediately"
+    # transmission happened: infections beyond the initially seeded cells
+    infected_cells = np.nonzero(np.asarray(st.parasite_active))[0]
+    assert (infected_cells >= 10).any() or parasites >= 10, infected_cells
