@@ -40,6 +40,44 @@ LOGIC_TASKS = {
     "true": (255,),
     "false": (0,),
 }
+# nand-/nor-resourceDependent (cTaskLib.cc:116-117) additionally gate on a
+# cell-resource threshold; mapping them to plain logic sets would silently
+# run wrong physics, so they stay unsupported (load raises) until the
+# resource precondition is implemented.
+# The full 3-input logic family: all 68 functions, logic-ID sets
+# transcribed from the registered checks (cTaskLib.cc:121-188 ->
+# Task_Logic3in_AA..CP bodies).  Format-contract constants.
+_LOGIC3 = {
+    "AA": (1,), "AB": (22,), "AC": (23,), "AD": (104,), "AE": (105,),
+    "AF": (126,), "AG": (127,), "AH": (128,), "AI": (129,), "AJ": (150,),
+    "AK": (151,), "AL": (232,), "AM": (233,), "AN": (254,),
+    "AO": (2, 4, 16), "AP": (6, 18, 20), "AQ": (7, 19, 21),
+    "AR": (8, 32, 64), "AS": (9, 33, 65), "AT": (14, 50, 84),
+    "AU": (24, 36, 66), "AV": (25, 37, 67), "AW": (30, 54, 86),
+    "AX": (31, 55, 87), "AY": (40, 72, 96), "AZ": (41, 73, 97),
+    "BA": (42, 76, 112), "BB": (43, 77, 113), "BC": (61, 91, 103),
+    "BD": (62, 94, 118), "BE": (106, 108, 120), "BF": (107, 109, 121),
+    "BG": (110, 122, 124), "BH": (111, 123, 125), "BI": (130, 132, 144),
+    "BJ": (131, 133, 145), "BK": (134, 146, 148), "BL": (135, 147, 149),
+    "BM": (137, 161, 193), "BN": (142, 178, 212), "BO": (143, 179, 213),
+    "BP": (152, 164, 194), "BQ": (158, 182, 214), "BR": (159, 183, 215),
+    "BS": (168, 200, 224), "BT": (169, 201, 225), "BU": (171, 205, 241),
+    "BV": (188, 218, 230), "BW": (189, 219, 231), "BX": (190, 222, 246),
+    "BY": (191, 223, 247), "BZ": (234, 236, 248), "CA": (235, 237, 249),
+    "CB": (239, 251, 253), "CC": (11, 13, 35, 49, 69, 81),
+    "CD": (26, 28, 38, 52, 70, 82), "CE": (27, 29, 39, 53, 71, 83),
+    "CF": (44, 56, 74, 88, 98, 100), "CG": (45, 57, 75, 89, 99, 101),
+    "CH": (46, 58, 78, 92, 114, 116), "CI": (47, 59, 79, 93, 115, 117),
+    "CJ": (138, 140, 162, 176, 196, 208),
+    "CK": (139, 141, 163, 177, 197, 209),
+    "CL": (154, 156, 166, 180, 198, 210),
+    "CM": (155, 157, 167, 181, 199, 211),
+    "CN": (172, 184, 202, 216, 226, 228),
+    "CO": (173, 185, 203, 217, 227, 229),
+    "CP": (174, 186, 206, 220, 242, 244),
+}
+for _suffix, _ids in _LOGIC3.items():
+    LOGIC_TASKS[f"logic_3{_suffix}"] = _ids
 for _name in list(LOGIC_TASKS):
     LOGIC_TASKS[_name + "_dup"] = LOGIC_TASKS[_name]
 
@@ -148,11 +186,20 @@ class Environment:
         req_mask = np.zeros((nr, nr), bool)
         noreq_mask = np.zeros((nr, nr), bool)
         name_to_idx = {r.name: i for i, r in enumerate(self.reactions)}
+        from avida_tpu.ops.tasks import MATH_TASKS
+        math_names = []
         for i, r in enumerate(self.reactions):
-            if r.task not in LOGIC_TASKS:
-                raise ValueError(
-                    f"task {r.task!r} is not in the vectorized logic task set yet")
-            mask[i, list(LOGIC_TASKS[r.task])] = True
+            if r.task in MATH_TASKS:
+                # math-family tasks evaluate against arithmetic candidates
+                # (ops/tasks.math_performed), not the logic-id mask
+                math_names.append(r.task)
+            else:
+                math_names.append("")
+                if r.task not in LOGIC_TASKS:
+                    raise ValueError(
+                        f"task {r.task!r} is not in the vectorized logic or "
+                        f"math task sets yet")
+                mask[i, list(LOGIC_TASKS[r.task])] = True
             if r.processes:
                 p = r.processes[0]
                 value[i] = p.value
@@ -188,6 +235,7 @@ class Environment:
             "req_reaction_mask": req_mask, "noreq_reaction_mask": noreq_mask,
             "proc_res_idx": p_res, "proc_res_spatial": p_spatial,
             "proc_max": p_max, "proc_frac": p_frac, "proc_depletable": p_depl,
+            "task_math_name": tuple(math_names),
         }
 
 
@@ -241,6 +289,16 @@ def load_environment(path: str) -> Environment:
                             q.noreactions.append(kv["noreaction"])
                         if "divide_only" in kv:
                             q.divide_only = bool(int(kv["divide_only"]))
+                            if q.divide_only:
+                                # fail loudly rather than silently running
+                                # wrong physics: the lockstep engine
+                                # evaluates tasks at IO, not at divide
+                                # (cEnvironment::TestRequisites divide_only)
+                                raise NotImplementedError(
+                                    "requisite divide_only=1 is not "
+                                    "supported by the lockstep engine yet; "
+                                    "remove it or use the reference for "
+                                    "this environment")
                         requisites.append(q)
                 if not processes:
                     processes.append(Process())

@@ -18,10 +18,12 @@ from dataclasses import dataclass
 TRIGGER_UPDATE = "update"
 TRIGGER_GENERATION = "generation"
 TRIGGER_IMMEDIATE = "immediate"
+TRIGGER_BIRTHS = "births"
 
 _TRIGGERS = {"u": TRIGGER_UPDATE, "update": TRIGGER_UPDATE,
              "g": TRIGGER_GENERATION, "generation": TRIGGER_GENERATION,
-             "i": TRIGGER_IMMEDIATE, "immediate": TRIGGER_IMMEDIATE}
+             "i": TRIGGER_IMMEDIATE, "immediate": TRIGGER_IMMEDIATE,
+             "b": TRIGGER_BIRTHS, "births": TRIGGER_BIRTHS}
 
 END = float("inf")
 

@@ -47,6 +47,12 @@ class WorldParams(struct.PyTreeNode):
     default_op: tuple = struct.field(pytree_node=False, default=())
     is_nop: tuple = struct.field(pytree_node=False, default=())
     nop_mod: tuple = struct.field(pytree_node=False, default=())
+    # per-instruction redundancy (mutation weight) as a cumulative
+    # distribution, and execution costs (cInstSet columns; cHardwareBase
+    # SingleProcess_PayPreCosts cc:1241).  Empty cost tuples = all zero.
+    mut_cdf: tuple = struct.field(pytree_node=False, default=())
+    inst_cost: tuple = struct.field(pytree_node=False, default=())
+    inst_ft_cost: tuple = struct.field(pytree_node=False, default=())
     # mutation rates
     copy_mut_prob: float = struct.field(pytree_node=False, default=0.0075)
     copy_ins_prob: float = struct.field(pytree_node=False, default=0.0)
@@ -55,6 +61,7 @@ class WorldParams(struct.PyTreeNode):
     divide_ins_prob: float = struct.field(pytree_node=False, default=0.05)
     divide_del_prob: float = struct.field(pytree_node=False, default=0.05)
     div_mut_prob: float = struct.field(pytree_node=False, default=0.0)   # per-site
+    divide_slip_prob: float = struct.field(pytree_node=False, default=0.0)
     point_mut_prob: float = struct.field(pytree_node=False, default=0.0)
     # divide restrictions
     offspring_size_range: float = struct.field(pytree_node=False, default=2.0)
@@ -96,6 +103,7 @@ class WorldParams(struct.PyTreeNode):
     min_task_count: tuple = struct.field(pytree_node=False, default=())
     req_reaction_mask: tuple = struct.field(pytree_node=False, default=())
     noreq_reaction_mask: tuple = struct.field(pytree_node=False, default=())
+    task_math_name: tuple = struct.field(pytree_node=False, default=())
     # reaction -> resource bindings (cReactionProcess)
     proc_res_idx: tuple = struct.field(pytree_node=False, default=())
     proc_res_spatial: tuple = struct.field(pytree_node=False, default=())
@@ -130,6 +138,11 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
     def tt(a):
         return tuple(map(tuple, a)) if a.ndim == 2 else tuple(a.tolist())
 
+    if instset.hw_type in (1, 2) and (instset.cost.any()
+                                      or instset.ft_cost.any()):
+        raise NotImplementedError(
+            "instruction costs are not implemented for TransSMT hardware "
+            "yet; zero the cost/ft_cost columns or use heads hardware")
     return WorldParams(
         hw_type=instset.hw_type,
         parasite_virulence=cfg.PARASITE_VIRULENCE,
@@ -142,6 +155,11 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         default_op=tuple(tables["default_op"].tolist()),
         is_nop=tuple(tables["is_nop"].tolist()),
         nop_mod=tuple(tables["nop_mod"].tolist()),
+        mut_cdf=tuple(np.cumsum(instset.mutation_weights()).tolist()),
+        inst_cost=(tuple(instset.cost.tolist())
+                   if instset.cost.any() else ()),
+        inst_ft_cost=(tuple(instset.ft_cost.tolist())
+                      if instset.ft_cost.any() else ()),
         copy_mut_prob=cfg.COPY_MUT_PROB,
         copy_ins_prob=cfg.COPY_INS_PROB,
         copy_del_prob=cfg.COPY_DEL_PROB,
@@ -149,6 +167,7 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         divide_ins_prob=cfg.DIVIDE_INS_PROB,
         divide_del_prob=cfg.DIVIDE_DEL_PROB,
         div_mut_prob=cfg.DIV_MUT_PROB,
+        divide_slip_prob=cfg.DIVIDE_SLIP_PROB,
         point_mut_prob=cfg.POINT_MUT_PROB,
         offspring_size_range=cfg.OFFSPRING_SIZE_RANGE,
         recombination_prob=cfg.RECOMBINATION_PROB,
@@ -184,6 +203,7 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         min_task_count=tuple(env_tables["min_task_count"].tolist()),
         req_reaction_mask=tt(env_tables["req_reaction_mask"]),
         noreq_reaction_mask=tt(env_tables["noreq_reaction_mask"]),
+        task_math_name=env_tables["task_math_name"],
         proc_res_idx=tuple(env_tables["proc_res_idx"].tolist()),
         proc_res_spatial=tuple(env_tables["proc_res_spatial"].tolist()),
         proc_max=tuple(env_tables["proc_max"].tolist()),
@@ -319,6 +339,14 @@ class PopulationState(struct.PyTreeNode):
     parent_id: jax.Array      # int32[N]    parent cell index at birth (-1 seed)
     birth_update: jax.Array   # int32[N]
 
+    # --- instruction cost engine (SingleProcess_PayPreCosts,
+    # cHardwareBase.cc:1241): remaining cycles owed before the current
+    # instruction executes, and which opcodes have paid their one-time
+    # first-use cost (64-bit opcode bitmask as 2x int32) ---
+    cost_wait: jax.Array       # int32[N]
+    ft_paid_lo: jax.Array      # int32[N]  opcodes 0-31
+    ft_paid_hi: jax.Array      # int32[N]  opcodes 32-63
+
     # --- per-update accounting ---
     insts_executed: jax.Array  # int32[N]  lifetime instructions executed
     budget_carry: jax.Array    # int32[N]  banked cycles (ops/update.py cap)
@@ -384,6 +412,7 @@ def zeros_population(n: int, L: int, R: int, n_global_res: int = 0,
         inj_mem=jnp.zeros((n, Ls), jnp.uint8), inj_len=i32(n),
         genotype_id=jnp.full(n, -1, jnp.int32), parent_id=jnp.full(n, -1, jnp.int32),
         birth_update=jnp.full(n, -1, jnp.int32),
+        cost_wait=i32(n), ft_paid_lo=i32(n), ft_paid_hi=i32(n),
         insts_executed=i32(n),
         budget_carry=i32(n),
         resources=f32(n_global_res),

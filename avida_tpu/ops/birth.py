@@ -332,6 +332,8 @@ def flush_births(params, st, key, neighbors, update_no):
         "divide_pending": False, "off_start": 0, "off_len": 0,
         "off_copied_size": 0, "genotype_id": -1,
         "birth_update": update_no, "insts_executed": 0, "budget_carry": 0,
+        # cost engine starts clean (no inherited debt or paid ft bits)
+        "cost_wait": 0, "ft_paid_lo": 0, "ft_paid_hi": 0,
         # TransSMT state (size-0 axes on heads hardware; writes are no-ops)
         "smt_aux": jnp.uint8(0), "smt_aux_len": 0,
         "pmem": jnp.uint8(0), "pmem_len": 0, "parasite_active": False,

@@ -129,6 +129,7 @@ _ZERO_FIELDS = [
     # TransSMT hardware state (size-0 axes on heads hardware)
     "smt_aux", "smt_aux_len", "pmem", "pmem_len", "smt_stacks", "smt_sp",
     "gstack", "gsp", "smt_head_pos", "inj_mem", "inj_len",
+    "cost_wait", "ft_paid_lo", "ft_paid_hi",
 ]
 _FALSE_FIELDS = ["mal_active", "breed_true", "divide_pending", "off_sex",
                  "parasite_active", "inject_pending"]
@@ -204,10 +205,11 @@ def compete_demes(params, st, key, competition_type):
 def _mutate_germline(params, germ_mem, germ_len, key):
     """Per-site germline copy mutations (GERMLINE_COPY_MUT,
     ReplaceDeme's germline mutation step)."""
+    from avida_tpu.ops.interpreter import random_inst
     D, L = germ_mem.shape
     u = jax.random.uniform(key, (D, L))
-    r = jax.random.randint(jax.random.fold_in(key, 1), (D, L), 0,
-                           params.num_insts, dtype=jnp.int32).astype(jnp.int8)
+    r = random_inst(params, jax.random.fold_in(key, 1),
+                    (D, L)).astype(jnp.int8)
     in_g = jnp.arange(L)[None, :] < germ_len[:, None]
     hit = (u < params.germline_copy_mut) & in_g
     return jnp.where(hit, r, germ_mem)

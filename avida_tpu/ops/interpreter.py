@@ -63,6 +63,22 @@ def tape_ops(tape):
     return (tape & OP_MASK).astype(jnp.int32)
 
 
+def random_inst(params, key, shape):
+    """Redundancy-weighted random instruction draw (cInstSet::GetRandomInst,
+    cpu/cInstSet.h:52): inverse-CDF over the per-opcode mutation weights.
+    Uniform sets short-circuit to randint."""
+    cdf = params.mut_cdf
+    n_i = params.num_insts
+    if not cdf or all(abs(cdf[k] - (k + 1) / n_i) < 1e-12
+                      for k in range(n_i)):
+        return jax.random.randint(key, shape, 0, n_i, dtype=jnp.int32)
+    u = jax.random.uniform(key, shape)
+    op = jnp.zeros(shape, jnp.int32)
+    for k in range(n_i - 1):
+        op = op + (u >= cdf[k]).astype(jnp.int32)
+    return op
+
+
 def _adjust(pos, mlen):
     """Head adjustment (ref cHeadCPU::fullAdjust, cHeadCPU.cc:28): negative
     positions clamp to 0, positions beyond memory wrap modulo memory size."""
@@ -165,7 +181,44 @@ def micro_step(params, st, key, exec_mask):
 
     cur_op = jnp.clip(s_ip & 63, 0, num_insts - 1)
     ip_exec_already = ((s_ip >> 6) & 1) != 0
-    sem = jnp.where(exec_mask, sem_t[cur_op], -1)
+
+    # ---- instruction cost engine (SingleProcess_PayPreCosts,
+    # cHardwareBase.cc:1241): an instruction with cost c consumes c cycles,
+    # executing on the last; ft_cost adds a one-time surcharge per opcode
+    # per organism.  Zero-cost sets (the default) compile this away. ----
+    has_costs = bool(params.inst_cost) or bool(params.inst_ft_cost)
+    if has_costs:
+        cost_t = jnp.asarray(params.inst_cost or (0,) * num_insts, jnp.int32)
+        ftc_t = jnp.asarray(params.inst_ft_cost or (0,) * num_insts,
+                            jnp.int32)
+        ft_bit = jnp.where(
+            cur_op < 32, (st.ft_paid_lo >> jnp.clip(cur_op, 0, 31)) & 1,
+            (st.ft_paid_hi >> jnp.clip(cur_op - 32, 0, 31)) & 1)
+        # total cycles for this instruction = max(cost, 1) + one-time ft
+        # surcharge: cost c alone = c cycles, ft alone = 1 + ft cycles
+        total_cost = jnp.maximum(cost_t[cur_op], 1) + \
+            jnp.where(ft_bit == 0, ftc_t[cur_op], 0)
+        eff_exec = exec_mask & (
+            (st.cost_wait == 1) | ((st.cost_wait == 0) & (total_cost <= 1)))
+        cost_wait = jnp.where(
+            exec_mask,
+            jnp.where(st.cost_wait > 0, st.cost_wait - 1,
+                      jnp.where(total_cost > 1, total_cost - 1, 0)),
+            st.cost_wait)
+        # ft surcharge is paid once the instruction actually executes
+        pay_ft = eff_exec & (ft_bit == 0)
+        ft_paid_lo = jnp.where(pay_ft & (cur_op < 32),
+                               st.ft_paid_lo | (1 << jnp.clip(cur_op, 0, 31)),
+                               st.ft_paid_lo)
+        ft_paid_hi = jnp.where(pay_ft & (cur_op >= 32),
+                               st.ft_paid_hi |
+                               (1 << jnp.clip(cur_op - 32, 0, 31)),
+                               st.ft_paid_hi)
+    else:
+        eff_exec = exec_mask
+        cost_wait = st.cost_wait
+        ft_paid_lo, ft_paid_hi = st.ft_paid_lo, st.ft_paid_hi
+    sem = jnp.where(eff_exec, sem_t[cur_op], -1)
 
     def is_op(s):
         return sem == s
@@ -201,7 +254,7 @@ def micro_step(params, st, key, exec_mask):
     # ---- executed flags (SetFlagExecuted in SingleProcess + helpers) ----
     lab0_exec = has_label & (label_len > 0)
     nop_exec = has_mod | lab0_exec  # modifier/first-label nop marked executed
-    exec_here = m_ip & exec_mask[:, None]
+    exec_here = m_ip & eff_exec[:, None]
     exec_next = (cols[None, :] == next_pos[:, None]) & nop_exec[:, None]
     tape = tape | jnp.where(exec_here | exec_next, EXEC_BIT, jnp.uint8(0))
 
@@ -218,7 +271,7 @@ def micro_step(params, st, key, exec_mask):
     # ---- PRNG draws for this step ----
     k_mut, k_in1 = jax.random.split(key, 2)
     u_copy_mut = jax.random.uniform(k_mut, (n,))
-    rand_inst = jax.random.randint(k_in1, (n,), 0, num_insts, dtype=jnp.int32)
+    rand_inst = random_inst(params, k_in1, (n,))
 
     # ---- stacks (cCPUStack.h:59-77: push decrements sp, pop reads+zeros) ----
     a1 = st.active_stack[:, None] == jnp.arange(2)[None, :]     # [N,2]
@@ -383,7 +436,9 @@ def micro_step(params, st, key, exec_mask):
         return tasks_ops.apply_reactions(
             params, env_tables, io_m, logic_id, st.cur_bonus,
             st.cur_task_count, st.cur_reaction_count,
-            st.resources, st.res_grid)[:5]
+            st.resources, st.res_grid,
+            input_buf=st.input_buf, input_buf_n=st.input_buf_n,
+            output=val)[:5]
 
     new_bonus, new_tc, new_rc, resources, res_grid = jax.lax.cond(
         io_m.any(), io_block,
@@ -468,7 +523,7 @@ def micro_step(params, st, key, exec_mask):
     ip_new = jnp.where(jmp_ip, jmp_tgt, ip_seq)
     ip_new = jnp.where(mov_ip, flow0, ip_new)
     ip_new = jnp.where(div_m, 0, ip_new)
-    ip_new = jnp.where(exec_mask, ip_new, st.heads[:, HEAD_IP])
+    ip_new = jnp.where(eff_exec, ip_new, st.heads[:, HEAD_IP])
     heads = heads.at[:, HEAD_IP].set(ip_new)
 
     # ---- divide: parent reset + pending offspring ----
@@ -482,6 +537,12 @@ def micro_step(params, st, key, exec_mask):
     active_stack = jnp.where(div_m, 0, active_stack)
     read_label_len = jnp.where(div_m, 0, read_label_len)
     mal_active = jnp.where(div_m, False, mal_active)
+    if has_costs:
+        # hardware reset clears pending cost debt; first-time costs reset
+        # per gestation (cHardwareTransSMT Divide_Main resets m_inst_ft_cost)
+        cost_wait = jnp.where(div_m, 0, cost_wait)
+        ft_paid_lo = jnp.where(div_m, 0, ft_paid_lo)
+        ft_paid_hi = jnp.where(div_m, 0, ft_paid_hi)
 
     # phenotype DivideReset (cPhenotype.cc:824): merit from size & bonus
     merit_base = _calc_size_merit(params, gsize, st.copied_size, exec_count)
@@ -533,6 +594,7 @@ def micro_step(params, st, key, exec_mask):
         off_copied_size=jnp.where(div_m, copied_count, st.off_copied_size),
         off_sex=jnp.where(div_m, div_sex_try, st.off_sex),
         insts_executed=insts_executed,
+        cost_wait=cost_wait, ft_paid_lo=ft_paid_lo, ft_paid_hi=ft_paid_hi,
         resources=resources, res_grid=res_grid,
     )
 
@@ -568,8 +630,7 @@ def extract_offspring(params, st, key):
 
     k_u, k_mpos, k_ipos, k_dpos, k_iinst = jax.random.split(key, 5)
     u_mut = jax.random.uniform(k_u, (n, 3))
-    r_inst2 = jax.random.randint(k_iinst, (n, 2), 0, params.num_insts,
-                                 dtype=jnp.int32)
+    r_inst2 = random_inst(params, k_iinst, (n, 2))
     # point substitution
     if params.divide_mut_prob > 0:
         mpos = jax.random.randint(k_mpos, (n,), 0, jnp.maximum(off_len, 1))
@@ -596,6 +657,77 @@ def extract_offspring(params, st, key):
                             jnp.int8(0), deleted)
         off = jnp.where(do_del[:, None], deleted, off)
         off_len = jnp.where(do_del, off_len - 1, off_len)
+
+    # COPY_INS_PROB / COPY_DEL_PROB (cHardwareBase::Divide_DoMutations
+    # copy-lifetime insert/delete): the reference applies these per h-copy;
+    # the lockstep engine applies the statistically equivalent
+    # Binomial(copied, p) count of single-site insertions/deletions to the
+    # offspring at divide time (documented deviation: the parent's write
+    # trajectory is unaffected), capped at 4 each per divide (the tail
+    # probability beyond 4 is negligible at any sane rate).
+    KMAX = 4
+    if params.copy_ins_prob > 0 or params.copy_del_prob > 0:
+        k_ci, k_cd = jax.random.split(jax.random.fold_in(key, 0xC0), 2)
+        cl = jnp.maximum(off_len, 1).astype(jnp.float32)
+        if params.copy_ins_prob > 0:
+            n_ins = jnp.clip(jax.random.binomial(
+                k_ci, cl, params.copy_ins_prob), 0, KMAX).astype(jnp.int32)
+            for k in range(KMAX):
+                kk = jax.random.fold_in(k_ci, k + 1)
+                ipos2 = jax.random.randint(kk, (n,), 0,
+                                           jnp.maximum(off_len, 1) + 1)
+                iv = random_inst(params, jax.random.fold_in(kk, 7), (n,))
+                do = div_m & (k < n_ins) & (off_len + 1 <= max_sz)
+                shifted = jnp.where(cols[None, :] > ipos2[:, None],
+                                    jnp.pad(off, ((0, 0), (1, 0)))[:, :L],
+                                    off)
+                ins = jnp.where(cols[None, :] == ipos2[:, None],
+                                iv[:, None].astype(jnp.int8), shifted)
+                off = jnp.where(do[:, None], ins, off)
+                off_len = jnp.where(do, off_len + 1, off_len)
+        if params.copy_del_prob > 0:
+            n_del = jnp.clip(jax.random.binomial(
+                k_cd, cl, params.copy_del_prob), 0, KMAX).astype(jnp.int32)
+            for k in range(KMAX):
+                kk = jax.random.fold_in(k_cd, k + 1)
+                dpos2 = jax.random.randint(kk, (n,), 0,
+                                           jnp.maximum(off_len, 1))
+                do = div_m & (k < n_del) & (off_len - 1 >= params.min_genome_len)
+                deleted = jnp.where(cols[None, :] >= dpos2[:, None],
+                                    jnp.pad(off, ((0, 0), (0, 1)))[:, 1:],
+                                    off)
+                deleted = jnp.where(cols[None, :] >= (off_len - 1)[:, None],
+                                    jnp.int8(0), deleted)
+                off = jnp.where(do[:, None], deleted, off)
+                off_len = jnp.where(do, off_len - 1, off_len)
+
+    # DIVIDE_SLIP_PROB (cHardwareBase::doSlipMutation cc:621): duplicate or
+    # delete a random region [p1, p2), direction random.
+    if params.divide_slip_prob > 0:
+        k_s = jax.random.fold_in(key, 0x51)
+        u_s, u_dir = jax.random.uniform(k_s, (n,)),             jax.random.uniform(jax.random.fold_in(k_s, 1), (n,))
+        pa = jax.random.randint(jax.random.fold_in(k_s, 2), (n,), 0,
+                                jnp.maximum(off_len, 1))
+        pb = jax.random.randint(jax.random.fold_in(k_s, 3), (n,), 0,
+                                jnp.maximum(off_len, 1))
+        p1 = jnp.minimum(pa, pb)
+        p2 = jnp.maximum(pa, pb)
+        size = p2 - p1
+        want = div_m & (u_s < params.divide_slip_prob) & (size > 0)
+        dup = want & (u_dir < 0.5) & (off_len + size <= max_sz)
+        dele = want & (u_dir >= 0.5) & (off_len - size >= params.min_genome_len)
+        from avida_tpu.ops.birth import _roll_right
+        # duplicate: out[q] = off[q] for q < p2, off[q - size] after
+        dup_plane = jnp.where(cols[None, :] < p2[:, None], off,
+                              _roll_right(off, size, L))
+        # delete: out[q] = off[q] for q < p1, off[q + size] after
+        del_plane = jnp.where(cols[None, :] < p1[:, None], off,
+                              _roll_right(off, -size, L))
+        off = jnp.where(dup[:, None], dup_plane,
+                        jnp.where(dele[:, None], del_plane, off))
+        off_len = jnp.where(dup, off_len + size,
+                            jnp.where(dele, off_len - size, off_len))
+        off = jnp.where(cols[None, :] < off_len[:, None], off, jnp.int8(0))
     return off, off_len
 
 

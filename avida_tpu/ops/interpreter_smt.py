@@ -173,9 +173,9 @@ def micro_step_smt(params, st, key, exec_mask):
     v2 = top(op2)
 
     # ---- PRNG ----
+    from avida_tpu.ops.interpreter import random_inst as _ri
     u_mut = jax.random.uniform(k_mut, (n,))
-    rand_inst = jax.random.randint(k_inst, (n,), 0, num_insts,
-                                   dtype=jnp.int32)
+    rand_inst = _ri(params, k_inst, (n,))
 
     # ---- compute push/pop plan ----
     # Each instruction does at most one pop from `pop_stack` and one push of
@@ -321,7 +321,9 @@ def micro_step_smt(params, st, key, exec_mask):
         return tasks_ops.apply_reactions(
             params, env_tables, io_host, logic_id, st.cur_bonus,
             st.cur_task_count, st.cur_reaction_count,
-            st.resources, st.res_grid)[:5]
+            st.resources, st.res_grid,
+            input_buf=st.input_buf, input_buf_n=st.input_buf_n,
+            output=value_out)[:5]
 
     new_bonus, new_tc, new_rc, resources, res_grid = jax.lax.cond(
         io_host.any(), io_block,

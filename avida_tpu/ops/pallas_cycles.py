@@ -105,6 +105,15 @@ def eligible(params) -> bool:
     from avida_tpu.models.heads import SEM_H_DIVIDE_SEX
     if any(int(s) == SEM_H_DIVIDE_SEX for s in params.sem):
         return False
+    if params.inst_cost or params.inst_ft_cost:
+        return False     # cost engine not implemented in-kernel
+    if any(getattr(params, "task_math_name", ())):
+        return False     # in-kernel reactions evaluate logic ids only
+    n_i = params.num_insts
+    if params.mut_cdf and any(
+            abs(params.mut_cdf[k] - (k + 1) / n_i) > 1e-12
+            for k in range(n_i)):
+        return False     # kernel PRNG draws are redundancy-uniform
     return all(r < 0 for r in params.proc_res_idx)
 
 

@@ -13,9 +13,130 @@ requisite windows and pow/add/mult bonus application are masked tensor ops.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 PROCTYPE_ADD, PROCTYPE_MULT, PROCTYPE_POW, PROCTYPE_LIN = 0, 1, 2, 3
+
+
+# ---- math task family (cTaskLib.cc:191-260, Task_Math{1,2,3}in_*) ----
+# Each task matches when the output equals the expression over ANY stored
+# input (arity 1) / ordered pair (arity 2) / ordered triple (arity 3).
+# C integer semantics: division/modulo truncate toward zero (lax.div/rem);
+# sqrt/log are (int)-cast doubles on |x| / x-positive respectively.
+
+def _isqrt(x):
+    return jnp.sqrt(jnp.abs(x).astype(jnp.float32)).astype(jnp.int32)
+
+
+def _ilog(x):
+    # (int) log((double) x): non-positive x never matches (C UB made safe)
+    safe = jnp.log(jnp.maximum(x, 1).astype(jnp.float32)).astype(jnp.int32)
+    return jnp.where(x > 0, safe, jnp.int32(-(2**30)))
+
+
+def _cdiv(a, b):
+    return jnp.where(b != 0, jax.lax.div(a, jnp.where(b == 0, 1, b)),
+                     jnp.int32(-(2**30)))
+
+
+def _crem(a, b):
+    return jnp.where(b != 0, jax.lax.rem(a, jnp.where(b == 0, 1, b)),
+                     jnp.int32(-(2**30)))
+
+
+MATH_TASKS = {
+    # arity 1 (cTaskLib.cc:191-207)
+    "math_1AA": (1, lambda x: 2 * x),
+    "math_1AB": (1, lambda x: _cdiv(2 * x, jnp.int32(3))),
+    "math_1AC": (1, lambda x: _cdiv(5 * x, jnp.int32(4))),
+    "math_1AD": (1, lambda x: x * x),
+    "math_1AE": (1, lambda x: x * x * x),
+    "math_1AF": (1, _isqrt),
+    "math_1AG": (1, _ilog),
+    "math_1AH": (1, lambda x: x * x + x * x * x),
+    "math_1AI": (1, lambda x: x * x + _isqrt(x)),
+    "math_1AJ": (1, lambda x: jnp.abs(x)),
+    "math_1AK": (1, lambda x: x - 5),
+    "math_1AL": (1, lambda x: -x),
+    "math_1AM": (1, lambda x: 5 * x),
+    "math_1AN": (1, lambda x: _cdiv(x, jnp.int32(4))),
+    "math_1AO": (1, lambda x: x - 6),
+    "math_1AP": (1, lambda x: x - 7),
+    "math_1AS": (1, lambda x: 3 * x),
+    # arity 2 (cTaskLib.cc:210-236)
+    "math_2AA": (2, lambda x, y: _isqrt(x + y)),
+    "math_2AB": (2, lambda x, y: (x + y) * (x + y)),
+    "math_2AC": (2, _crem),
+    "math_2AD": (2, lambda x, y: _cdiv(3 * x, jnp.int32(2))
+                 + _cdiv(5 * y, jnp.int32(4))),
+    "math_2AE": (2, lambda x, y: jnp.abs(x - 5) + jnp.abs(y - 6)),
+    "math_2AF": (2, lambda x, y: x * y - _cdiv(x, y)),
+    "math_2AG": (2, lambda x, y: (x - y) * (x - y)),
+    "math_2AH": (2, lambda x, y: x * x + y * y),
+    "math_2AI": (2, lambda x, y: x * x + y * y * y),
+    "math_2AJ": (2, lambda x, y: _cdiv(_isqrt(x) + y, x - 7)),
+    "math_2AK": (2, lambda x, y: _ilog(jnp.abs(_cdiv(x, y)))),
+    "math_2AL": (2, lambda x, y: _cdiv(_ilog(jnp.abs(x)), y)),
+    "math_2AM": (2, lambda x, y: _cdiv(x, _ilog(jnp.abs(y)))),
+    "math_2AN": (2, lambda x, y: x + y),
+    "math_2AO": (2, lambda x, y: x - y),
+    "math_2AP": (2, _cdiv),
+    "math_2AQ": (2, lambda x, y: x * y),
+    "math_2AR": (2, lambda x, y: _isqrt(x) + _isqrt(y)),
+    "math_2AS": (2, lambda x, y: x + 2 * y),
+    "math_2AT": (2, lambda x, y: x + 3 * y),
+    "math_2AU": (2, lambda x, y: 2 * x + 3 * y),
+    "math_2AV": (2, lambda x, y: x * y * y),
+    # 2AX duplicates 2AT and 2AW does not exist IN THE REFERENCE TOO
+    # (cTaskLib.cc:232 Task_Math2in_AX is literally X+3Y again)
+    "math_2AX": (2, lambda x, y: x + 3 * y),
+    "math_2AY": (2, lambda x, y: 2 * x + y),
+    "math_2AZ": (2, lambda x, y: 4 * x + 6 * y),
+    "math_2AAA": (2, lambda x, y: 3 * x - 2 * y),
+    # arity 3 (cTaskLib.cc:239-260)
+    "math_3AA": (3, lambda x, y, z: x * x + y * y + z * z),
+    "math_3AB": (3, lambda x, y, z: _isqrt(x) + _isqrt(y) + _isqrt(z)),
+    "math_3AC": (3, lambda x, y, z: x + 2 * y + 3 * z),
+    "math_3AD": (3, lambda x, y, z: x * y * y + z * z * z),
+    "math_3AE": (3, lambda x, y, z: _crem(x, y) * z),
+    "math_3AF": (3, lambda x, y, z: (x + y) * (x + y) + _isqrt(y + z)),
+    "math_3AG": (3, lambda x, y, z: _crem(x * y, y * z)),
+    "math_3AH": (3, lambda x, y, z: x + y + z),
+    "math_3AI": (3, lambda x, y, z: -x - y - z),
+    "math_3AJ": (3, lambda x, y, z: (x - y) * (x - y) + (y - z) * (y - z)
+                 + (z - x) * (z - x)),
+    "math_3AK": (3, lambda x, y, z: (x + y) * (x + y) + (y + z) * (y + z)
+                 + (z + x) * (z + x)),
+    "math_3AL": (3, lambda x, y, z: (x - y) * (x - y) + (x - z) * (x - z)),
+    "math_3AM": (3, lambda x, y, z: (x + y) * (x + y) + (x + z) * (x + z)),
+}
+
+
+def math_performed(task_name, input_buf, input_buf_n, output):
+    """bool[N]: does `output` match math task `task_name` over any stored
+    input combination (the reference's nested input loops, e.g.
+    Task_Math2in_AA)?"""
+    arity, fn = MATH_TASKS[task_name]
+    ins = [input_buf[:, k] for k in range(3)]
+    have = [input_buf_n > k for k in range(3)]
+    hit = jnp.zeros(output.shape, bool)
+    if arity == 1:
+        for i in range(3):
+            hit = hit | (have[i] & (output == fn(ins[i])))
+    elif arity == 2:
+        for i in range(3):
+            for j in range(3):
+                if i == j:
+                    continue
+                hit = hit | (have[i] & have[j] &
+                             (output == fn(ins[i], ins[j])))
+    else:
+        import itertools
+        for i, j, k in itertools.permutations(range(3)):
+            hit = hit | (have[i] & have[j] & have[k] &
+                         (output == fn(ins[i], ins[j], ins[k])))
+    return hit
 
 
 def compute_logic_id(input_buf, input_buf_n, output):
@@ -60,7 +181,8 @@ def compute_logic_id(input_buf, input_buf_n, output):
 
 
 def apply_reactions(params, env_tables, io_mask, logic_id, cur_bonus,
-                    cur_task_count, cur_reaction_count, resources, res_grid):
+                    cur_task_count, cur_reaction_count, resources, res_grid,
+                    input_buf=None, input_buf_n=None, output=None):
     """Trigger reactions for organisms performing IO this step.
 
     env_tables: dict of jnp arrays built from Environment.device_tables().
@@ -87,6 +209,16 @@ def apply_reactions(params, env_tables, io_mask, logic_id, cur_bonus,
     lid = jnp.clip(logic_id, 0, 255)
     valid = (logic_id >= 0) & io_mask             # [N]
     performed = mask[:, lid].T & valid[:, None]   # [N,R] task performed now
+    # math-family reactions match arithmetic candidates instead of logic ids
+    math_names = getattr(params, "task_math_name", ())
+    if any(math_names) and input_buf is not None:
+        cols = []
+        for r, nm in enumerate(math_names):
+            if nm:
+                cols.append((r, math_performed(nm, input_buf, input_buf_n,
+                                               output) & io_mask))
+        for r, col in cols:
+            performed = performed.at[:, r].set(col)
 
     # Requisite windows evaluated against pre-event counts (cc:1408-1470)
     in_window = ((cur_task_count >= min_tc[None, :]) &

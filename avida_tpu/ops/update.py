@@ -48,9 +48,9 @@ def use_pallas_path(params) -> bool:
         if not pallas_cycles.eligible(params):
             raise ValueError(
                 "TPU_USE_PALLAS=1 but this configuration disqualifies the "
-                "Pallas cycle kernel (ops/pallas_cycles.eligible): either a "
-                "reaction binds a resource, or the instruction set contains "
-                "divide-sex; use TPU_USE_PALLAS=0 or 2")
+                "Pallas cycle kernel (ops/pallas_cycles.eligible): a "
+                "resource-bound reaction, divide-sex, instruction costs, "
+                "or non-uniform redundancy; use TPU_USE_PALLAS=0 or 2")
         return True
     return (pallas_cycles.eligible(params)
             and jax.device_count() == 1
@@ -141,10 +141,11 @@ def update_step(params, st, key, neighbors, update_no):
 def _point_mutation_sweep(params, st, key):
     """Per-site point mutations once per update (Avida2Driver.cc:146-155 ->
     cHardwareBase::PointMutate cc:1087)."""
+    from avida_tpu.ops.interpreter import random_inst
     n, L = st.tape.shape
     u = jax.random.uniform(key, (n, L))
-    r = jax.random.randint(jax.random.fold_in(key, 1), (n, L), 0,
-                           params.num_insts, dtype=jnp.int32).astype(jnp.uint8)
+    r = random_inst(params, jax.random.fold_in(key, 1),
+                    (n, L)).astype(jnp.uint8)
     in_genome = jnp.arange(L)[None, :] < st.mem_len[:, None]
     hit = (u < params.point_mut_prob) & in_genome & st.alive[:, None]
     # replace opcode bits, keep flag bits

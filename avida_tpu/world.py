@@ -143,6 +143,7 @@ class World:
         self._last_ave_gen = jnp.float32(0.0)
         self._deaths_this = jnp.int32(0)      # device scalar
         self._prev_alive = None               # device scalar
+        self._total_births = jnp.int32(0)     # device scalar (BIRTHS trigger)
         self._events_done_for = None
         self._warned_actions = set()
         # per-generation-event next-fire bookkeeping (cEventList generation
@@ -294,6 +295,9 @@ class World:
                      breed_true, no_birth, n, 0, 0])
 
     def _action_PrintDominantData(self, args):
+        """dominant.dat with live per-genotype reductions (ref
+        PrintDominantData, actions/PrintActions.cc; column semantics from
+        the golden header in tests/heads_default_100u/expected/data)."""
         if self.systematics is None:
             return
         g = self.systematics.dominant()
@@ -301,19 +305,29 @@ class World:
             return
         f = self._file("dominant", output_mod.open_dominant_dat)
         st = self.state
-        cells = np.nonzero((self.systematics.cell_gid == g.gid)
-                           & np.asarray(st.alive))[0]
+        member = (self.systematics.cell_gid == g.gid) & np.asarray(st.alive)
+        cells = np.nonzero(member)[0]
         if cells.size:
             merit = float(np.asarray(st.merit)[cells].mean())
             gest = float(np.asarray(st.gestation_time)[cells].mean())
             fit = float(np.asarray(st.fitness)[cells].mean())
+            copied = float(np.asarray(st.copied_size)[cells].mean())
+            execd = float(np.asarray(st.executed_size)[cells].mean())
+            max_fit = float(np.asarray(st.fitness)[cells].max())
+            births = int((np.asarray(st.birth_update)[cells]
+                          == self.update - 1).sum())
+            breed_true = int(np.asarray(st.breed_true)[cells].sum())
         else:
-            merit = gest = fit = 0.0
+            merit = gest = fit = copied = execd = max_fit = 0.0
+            births = breed_true = 0
+        # reference names are "<size>-<base26>" (e.g. 100-aaaaa)
+        name = f"{g.length}-" + "".join(
+            chr(ord("a") + (g.gid // 26**k) % 26) for k in range(4, -1, -1))
         f.write_row([
             self.update, merit, gest, fit,
-            (merit / gest if gest else 0.0), g.length, g.length, g.length,
-            g.num_units, g.total_units, 0, g.depth, 0, fit, g.gid,
-            f"{g.depth:03d}-no_name"])
+            (1.0 / gest if gest else 0.0), g.length, copied, execd,
+            g.num_units, births, breed_true, g.depth, 0, max_fit, g.gid,
+            name])
 
     def _action_PrintTasksData(self, args):
         s = self._summary()
@@ -411,6 +425,48 @@ class World:
         self.key, k = jax.random.split(self.key)
         self.state = deme_ops.replicate_demes(self.params, self.state, k, trig)
 
+    def _action_KillProb(self, args):
+        """KillProb [prob]: each living organism dies with probability p
+        (ref cActionKillProb, actions/PopulationActions.cc)."""
+        p = float(args[0]) if args else 0.9
+        self.key, k = jax.random.split(self.key)
+        die = (jax.random.uniform(k, (self.params.num_cells,)) < p)             & self.state.alive
+        self.state = self.state.replace(alive=self.state.alive & ~die)
+
+    def _action_SerialTransfer(self, args):
+        """SerialTransfer [transfer_size]: keep a uniform random sample of
+        transfer_size organisms, kill the rest (ref cActionSerialTransfer)."""
+        size = int(args[0]) if args else 1
+        st = self.state
+        n = self.params.num_cells
+        self.key, k = jax.random.split(self.key)
+        score = jnp.where(st.alive, jax.random.uniform(k, (n,)), -1.0)
+        kth = jnp.sort(score)[-size]
+        keep = st.alive & (score >= kth)
+        self.state = st.replace(alive=keep)
+
+    def _action_LoadPopulation(self, args):
+        """LoadPopulation <file.spop> (ref cActionLoadPopulation,
+        actions/SaveLoadActions.cc:289 -> cPopulation::LoadPopulation
+        cc:6723): rebuild the population from a structured save."""
+        from avida_tpu.utils import spop
+        path = args[0]
+        if self.config_dir and not os.path.isabs(path)                 and not os.path.exists(path):
+            path = os.path.join(self.config_dir, args[0])
+        if not os.path.exists(path) and not os.path.isabs(args[0]):
+            cand = os.path.join(self.data_dir, args[0])
+            if os.path.exists(cand):
+                path = cand
+        self.key, k = jax.random.split(self.key)
+        orgs = spop.load_population(path, self.params, k)
+        self.state = spop.restore_population(self.params, orgs, k)
+        if self.systematics is not None:
+            from avida_tpu.systematics import GenotypeArbiter
+            self.systematics = GenotypeArbiter(self.params.num_cells)
+            for o in orgs:
+                self.systematics.classify_seed(o["cell"], o["genome"],
+                                               update=self.update)
+
     def _action_SavePopulation(self, args):
         from avida_tpu.utils import spop
         os.makedirs(self.data_dir, exist_ok=True)
@@ -447,9 +503,14 @@ class World:
             elif ev.trigger == "immediate":
                 if self.update == 0:
                     self._dispatch(ev)
-            elif ev.trigger == "generation":
+            elif ev.trigger in ("generation", "births"):
+                # BIRTHS triggers compare cumulative births; generation
+                # triggers the population-average generation
+                # (cEventList.h:63 trigger enum)
+                cur = (float(self._total_births) if ev.trigger == "births"
+                       else gen)
                 nxt = self._gen_next.setdefault(id(ev), ev.start)
-                while gen >= nxt and nxt <= ev.stop:
+                while cur >= nxt and nxt <= ev.stop:
                     self._dispatch(ev)
                     if ev.interval <= 0:
                         nxt = float("inf")      # one-shot
@@ -492,6 +553,7 @@ class World:
         self._last_ave_gen = ave_gens[-1]
         self._deaths_this = deaths[-1]
         self._prev_alive = n_alive[-1]
+        self._total_births = self._total_births + births.sum()
         return executed
 
     def _next_event_due(self) -> float:
@@ -543,7 +605,8 @@ class World:
         # per-update host work (systematics, generation triggers) forces
         # single stepping
         can_chunk = (self.systematics is None and
-                     not any(ev.trigger == "generation" for ev in self.events))
+                     not any(ev.trigger in ("generation", "births")
+                             for ev in self.events))
         while not self._exit:
             if max_updates is not None and self.update >= max_updates:
                 break

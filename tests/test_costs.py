@@ -1,0 +1,160 @@
+"""Instruction cost engine, redundancy-weighted mutations, mutation
+completeness (copy-ins/del, slip).
+
+Reference: cHardwareBase::SingleProcess_PayPreCosts (cc:1241), redundancy-
+weighted cInstSet::GetRandomInst (cpu/cInstSet.h:52), Divide_DoMutations
+copy-lifetime insert/delete + doSlipMutation (cHardwareBase.cc:296,621).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from avida_tpu.config import AvidaConfig
+from avida_tpu.config.instset import default_instset
+from avida_tpu.config.environment import default_logic9_environment
+from avida_tpu.core.state import make_world_params, zeros_population
+from avida_tpu.ops.interpreter import micro_step, random_inst, extract_offspring
+from avida_tpu.world import World, default_ancestor
+
+
+def _params(instset=None, **cfg_kw):
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 4
+    cfg.WORLD_Y = 4
+    cfg.TPU_MAX_MEMORY = 64
+    for k, v in cfg_kw.items():
+        cfg.set(k, v)
+    return make_world_params(cfg, instset or default_instset(),
+                             default_logic9_environment())
+
+
+def _one_org(params, program):
+    n, L, R = params.num_cells, params.max_memory, params.num_reactions
+    st = zeros_population(n, L, R)
+    tape = np.zeros((n, L), np.uint8)
+    tape[0, : len(program)] = program
+    return st.replace(
+        tape=jnp.asarray(tape),
+        mem_len=st.mem_len.at[0].set(len(program)),
+        genome_len=st.genome_len.at[0].set(len(program)),
+        alive=st.alive.at[0].set(True))
+
+
+def test_redundancy_biases_mutation_draws():
+    """A 10x-redundant opcode must be drawn ~10x as often (GetRandomInst)."""
+    s = default_instset()
+    s.redundancy[:] = 1.0
+    s.redundancy[5] = 10.0        # if-label 10x
+    params = _params(instset=s)
+    draws = np.asarray(random_inst(params, jax.random.key(0), (20000,)))
+    counts = np.bincount(draws, minlength=params.num_insts)
+    frac5 = counts[5] / draws.size
+    expect = 10.0 / (params.num_insts - 1 + 10.0)
+    assert abs(frac5 - expect) < 0.02, (frac5, expect)
+    # uniform opcodes stay uniform relative to each other
+    others = counts[np.arange(params.num_insts) != 5]
+    assert others.std() / others.mean() < 0.2
+
+
+def test_instruction_cost_slows_the_right_instruction():
+    """cost=3 on `inc` makes each inc take 3 cycles; nop-heavy code is
+    unaffected (SingleProcess_PayPreCosts)."""
+    s = default_instset()
+    inc_op = s.opcode("inc")
+    s.cost[inc_op] = 3
+    params = _params(instset=s)
+    prog_inc = [inc_op] * 8                      # pure inc program
+    nopA = s.opcode("nop-A")
+    st = _one_org(params, prog_inc)
+    exec_mask = st.alive
+    for c in range(6):
+        st = micro_step(params, st, jax.random.key(c), exec_mask)
+    # 6 cycles at cost 3 => exactly 2 incs executed: BX == 2
+    assert int(st.regs[0, 1]) == 2, np.asarray(st.regs[0])
+    assert int(st.time_used[0]) == 6             # cycles still consumed
+
+    # same program with zero-cost set: 6 incs in 6 cycles
+    params0 = _params()
+    st0 = _one_org(params0, prog_inc)
+    for c in range(6):
+        st0 = micro_step(params0, st0, jax.random.key(c), st0.alive)
+    assert int(st0.regs[0, 1]) == 6
+
+
+def test_first_time_cost_charged_once():
+    """ft_cost=4 on inc: the first inc costs 1+4, later incs cost 1."""
+    s = default_instset()
+    inc_op = s.opcode("inc")
+    s.ft_cost[inc_op] = 4
+    params = _params(instset=s)
+    st = _one_org(params, [inc_op] * 12)
+    for c in range(9):
+        st = micro_step(params, st, jax.random.key(c), st.alive)
+    # first inc: 5 cycles; remaining 4 cycles: 4 incs => BX == 5
+    assert int(st.regs[0, 1]) == 5, np.asarray(st.regs[0])
+
+
+def _offspring_lengths(params, n_samples=512, seed=0):
+    """Sample offspring lengths from extract_offspring on synthetic
+    pending divides of length 40."""
+    n, L, R = params.num_cells, params.max_memory, params.num_reactions
+    lens = []
+    st = zeros_population(n, L, R)
+    tape = np.zeros((n, L), np.uint8)
+    tape[:, :40] = 3
+    st = st.replace(
+        tape=jnp.asarray(tape),
+        genome_len=jnp.full(n, 40, jnp.int32),
+        mem_len=jnp.full(n, 40, jnp.int32),
+        alive=jnp.ones(n, bool),
+        divide_pending=jnp.ones(n, bool),
+        off_len=jnp.full(n, 40, jnp.int32),
+    )
+    for s in range(n_samples // n):
+        _, off_len = extract_offspring(params, st, jax.random.key(seed + s))
+        lens.extend(np.asarray(off_len).tolist())
+    return np.asarray(lens)
+
+
+def test_copy_ins_del_shift_length_distribution():
+    base = _params(DIVIDE_INS_PROB=0.0, DIVIDE_DEL_PROB=0.0)
+    l0 = _offspring_lengths(base)
+    assert (l0 == 40).all()
+
+    ins = _params(DIVIDE_INS_PROB=0.0, DIVIDE_DEL_PROB=0.0,
+                  COPY_INS_PROB=0.02)
+    li = _offspring_lengths(ins)
+    # E[insertions] = 40 * 0.02 = 0.8 per offspring
+    assert li.mean() > 40.3, li.mean()
+    assert (li >= 40).all()
+
+    dele = _params(DIVIDE_INS_PROB=0.0, DIVIDE_DEL_PROB=0.0,
+                   COPY_DEL_PROB=0.02)
+    ld = _offspring_lengths(dele)
+    assert ld.mean() < 39.7, ld.mean()
+    assert (ld <= 40).all()
+
+
+def test_slip_mutation_duplicates_and_deletes_regions():
+    slip = _params(DIVIDE_INS_PROB=0.0, DIVIDE_DEL_PROB=0.0,
+                   DIVIDE_SLIP_PROB=1.0)
+    ls = _offspring_lengths(slip, n_samples=256)
+    # every divide slips: lengths spread both ways around 40
+    assert (ls > 40).any() and (ls < 40).any(), ls[:20]
+    assert ls.min() >= slip.min_genome_len
+    assert ls.max() <= 64
+
+
+def test_instruction_costs_route_off_the_pallas_kernel():
+    from avida_tpu.ops.pallas_cycles import eligible
+    s = default_instset()
+    s.cost[s.opcode("inc")] = 3
+    assert not eligible(_params(instset=s))
+    s2 = default_instset()
+    s2.redundancy[0] = 5.0
+    assert not eligible(_params(instset=s2))
+    assert eligible(_params())

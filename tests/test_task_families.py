@@ -1,0 +1,91 @@
+"""Task families beyond logic-9: full 3-input logic set + math family.
+
+Reference: cTaskLib.cc:87-260 -- 215 registrations; the logic families
+(all 68 3-input functions) evaluate via logic-ID membership, the math
+families via arithmetic-candidate matching (Task_Math{1,2,3}in_*).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from avida_tpu.config.environment import (LOGIC_TASKS, Environment, Reaction,
+                                          Process, load_environment)
+from avida_tpu.ops import tasks as tasks_ops
+
+
+def test_full_logic_family_loads():
+    # all 68 3-input functions present
+    three_in = [k for k in LOGIC_TASKS if k.startswith("logic_3")
+                and not k.endswith("_dup")]
+    assert len(three_in) == 68
+    # spot checks against the reference constants (cTaskLib.cc)
+    assert LOGIC_TASKS["logic_3AH"] == (128,)     # A&B&C
+    assert LOGIC_TASKS["logic_3AN"] == (254,)     # A|B|C
+    assert LOGIC_TASKS["logic_3CP"] == (174, 186, 206, 220, 242, 244)
+
+
+def test_reference_style_environment_loads(tmp_path):
+    cfg = tmp_path / "environment.cfg"
+    cfg.write_text(
+        "REACTION NOT not process:value=1.0:type=pow\n"
+        "REACTION L3AH logic_3AH process:value=4.0:type=pow\n"
+        "REACTION M1AA math_1AA process:value=2.0:type=pow\n"
+        "REACTION M2AN math_2AN process:value=3.0:type=pow\n")
+    env = load_environment(str(cfg))
+    tables = env.device_tables()
+    assert tables["task_math_name"] == ("", "", "math_1AA", "math_2AN")
+    assert tables["task_logic_mask"][1, 128]      # logic_3AH id
+
+def test_math_performed_matches_candidates():
+    ib = jnp.asarray([[7, 3, 0], [10, 4, 2], [5, 5, 5]], jnp.int32)
+    ibn = jnp.asarray([2, 3, 3], jnp.int32)
+    # math_1AA (2X): outputs 14 (=2*7), 9 (no), 10 (=2*5)
+    out = jnp.asarray([14, 9, 10], jnp.int32)
+    hit = np.asarray(tasks_ops.math_performed("math_1AA", ib, ibn, out))
+    assert hit.tolist() == [True, False, True]
+    # math_2AN (X+Y): 10=7+3 yes; 14=10+4 yes; 10=5+5 yes
+    out2 = jnp.asarray([10, 14, 10], jnp.int32)
+    hit2 = np.asarray(tasks_ops.math_performed("math_2AN", ib, ibn, out2))
+    assert hit2.tolist() == [True, True, True]
+    # math_3AH (X+Y+Z): needs 3 inputs -> row 0 (only 2 stored) can't match
+    out3 = jnp.asarray([10, 16, 15], jnp.int32)
+    hit3 = np.asarray(tasks_ops.math_performed("math_3AH", ib, ibn, out3))
+    assert hit3.tolist() == [False, True, True]
+    # math_2AC (X%Y): 7%3=1
+    out4 = jnp.asarray([1, 2, 0], jnp.int32)
+    hit4 = np.asarray(tasks_ops.math_performed("math_2AC", ib, ibn, out4))
+    assert bool(hit4[0]) and bool(hit4[2])
+
+
+def test_math_reaction_rewards_bonus():
+    """An organism outputting 2*input gets the math_1AA pow bonus through
+    the full reaction pipeline."""
+    env = Environment(reactions=[
+        Reaction("M1AA", "math_1AA", [Process(value=2.0, type=2)], []),
+    ])
+    from avida_tpu.config import AvidaConfig
+    from avida_tpu.core.state import make_world_params
+    from avida_tpu.config.instset import default_instset
+    cfg = AvidaConfig()
+    cfg.WORLD_X = cfg.WORLD_Y = 2
+    params = make_world_params(cfg, default_instset(), env)
+    tables = tasks_ops.env_tables_to_device(params)
+    n = 4
+    ib = jnp.asarray([[6, 0, 0]] * n, jnp.int32)
+    ibn = jnp.full(n, 1, jnp.int32)
+    out = jnp.asarray([12, 11, 12, 12], jnp.int32)
+    io = jnp.asarray([True, True, False, True])
+    logic_id = tasks_ops.compute_logic_id(ib, ibn, out)
+    bonus, tc, rc, _, _, any_r = tasks_ops.apply_reactions(
+        params, tables, io, logic_id, jnp.ones(n, jnp.float32),
+        jnp.zeros((n, 1), jnp.int32), jnp.zeros((n, 1), jnp.int32),
+        jnp.zeros(0), jnp.zeros((0, n)),
+        input_buf=ib, input_buf_n=ibn, output=out)
+    got = np.asarray(bonus)
+    assert got[0] == 4.0      # 2^2 pow bonus
+    assert got[1] == 1.0      # wrong output
+    assert got[2] == 1.0      # no IO
+    assert got[3] == 4.0
