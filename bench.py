@@ -67,23 +67,11 @@ def build(world_x, world_y, max_memory, seed):
     return w.params, st, neighbors, key
 
 
-def main():
+def measure(world, warmup, timed, chunk=5, seed=100):
+    """org-inst/s at a given world side length (world x world organisms)."""
     from avida_tpu.ops.update import update_step
 
-    # 320x320 = 102,400 organisms (BASELINE.json config: 100k target scale).
-    # Smaller on CPU so the bench terminates quickly off-TPU.
-    on_tpu = jax.devices()[0].platform == "tpu"
-    world = 320 if on_tpu else 60
-    warmup, timed = (3, 10) if on_tpu else (1, 3)
-
-    params, st, neighbors, key = build(world, world, 256, seed=100)
-
-    # Multi-update scan: the whole timed segment is device-resident (the
-    # World driver equally avoids per-update host syncs via queued device
-    # scalars); one dispatch per `chunk` updates, executed counts summed on
-    # device.  Host sync only at the end -- anything else measures tunnel
-    # round-trips, not the engine.
-    chunk = 5
+    params, st, neighbors, key = build(world, world, 256, seed=seed)
 
     @jax.jit
     def run_chunk(st, key, u0):
@@ -107,8 +95,37 @@ def main():
     jax.block_until_ready(st)
     dt = time.perf_counter() - t0
     executed_total = int(sum(int(x) for x in counts))
+    return executed_total / dt
 
-    ips = executed_total / dt
+
+def main():
+    from avida_tpu.ops.update import update_step
+
+    # 320x320 = 102,400 organisms (BASELINE.json config: 100k target scale).
+    # Smaller on CPU so the bench terminates quickly off-TPU.
+    on_tpu = jax.devices()[0].platform == "tpu"
+    world = 320 if on_tpu else 60
+    warmup, timed = (3, 10) if on_tpu else (1, 3)
+
+    if "--sweep" in sys.argv:
+        # BASELINE.json config 2: population sweep 3.6k -> 100k organisms.
+        # One JSON line per size (the driver's headline line is the plain
+        # `python bench.py` run).
+        for w in ([60, 100, 180, 320] if on_tpu else [20, 40, 60]):
+            ips = measure(w, warmup, timed)
+            print(json.dumps({
+                "metric": "org_instructions_per_sec",
+                "organisms": w * w,
+                "value": round(ips, 1),
+                "unit": "inst/s",
+                "vs_baseline": round(ips / BASELINE_INST_PER_SEC, 4),
+            }))
+        return
+
+    # Multi-update scan inside measure(): the whole timed segment is
+    # device-resident; host sync only at the end -- anything else measures
+    # dispatch round-trips, not the engine.
+    ips = measure(world, warmup, timed)
     print(json.dumps({
         "metric": "org_instructions_per_sec",
         "value": round(ips, 1),
