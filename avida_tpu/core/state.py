@@ -90,6 +90,8 @@ class WorldParams(struct.PyTreeNode):
     demes_migration_rate: float = struct.field(pytree_node=False, default=0.0)
     # birth
     birth_method: int = struct.field(pytree_node=False, default=0)
+    population_cap: int = struct.field(pytree_node=False, default=0)
+    pop_cap_eldest: int = struct.field(pytree_node=False, default=0)
     prefer_empty: bool = struct.field(pytree_node=False, default=True)
     allow_parent: bool = struct.field(pytree_node=False, default=True)
     divide_method: int = struct.field(pytree_node=False, default=1)
@@ -143,6 +145,10 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         raise NotImplementedError(
             "instruction costs are not implemented for TransSMT hardware "
             "yet; zero the cost/ft_cost columns or use heads hardware")
+    if cfg.POPULATION_CAP and cfg.POP_CAP_ELDEST:
+        raise ValueError(
+            "POPULATION_CAP and POP_CAP_ELDEST are mutually exclusive "
+            "carrying-capacity policies (cPopulation.cc:5192-5238)")
     return WorldParams(
         hw_type=instset.hw_type,
         parasite_virulence=cfg.PARASITE_VIRULENCE,
@@ -191,6 +197,8 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         death_method=cfg.DEATH_METHOD,
         age_limit=cfg.AGE_LIMIT,
         birth_method=cfg.BIRTH_METHOD,
+        population_cap=cfg.POPULATION_CAP,
+        pop_cap_eldest=cfg.POP_CAP_ELDEST,
         prefer_empty=bool(cfg.PREFER_EMPTY),
         allow_parent=bool(cfg.ALLOW_PARENT),
         divide_method=cfg.DIVIDE_METHOD,

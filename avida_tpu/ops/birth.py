@@ -238,7 +238,13 @@ def flush_births(params, st, key, neighbors, update_no):
             params, st, k_sex, off_mem, off_len, pending)
         leftover = (st.divide_pending & st.alive) & ~pending
 
-    # ---- target selection (PositionOffspring, cc:5185; BIRTH_METHOD 0) ----
+    # ---- target selection (PositionOffspring, cc:5185: the 12
+    # ePOSITION_OFFSPRING methods, Definitions.h:67-82) ----
+    bm = params.birth_method
+    if bm in (9, 10, 11):
+        raise NotImplementedError(
+            f"BIRTH_METHOD {bm} (energy-used / dispersal placement) needs "
+            f"the energy model; use methods 0-8")
     cand = neighbors                                  # [N, 8]
     if params.num_demes > 1:
         # deme-local placement: candidates in a different deme collapse to
@@ -248,16 +254,64 @@ def flush_births(params, st, key, neighbors, update_no):
         cpd = params.num_cells // params.num_demes
         same_deme = (cand // cpd) == (rows // cpd)[:, None]
         cand = jnp.where(same_deme, cand, rows[:, None])
-    if params.allow_parent:
+    if params.allow_parent and bm in (0, 1, 2, 3):
         cand = jnp.concatenate([cand, rows[:, None]], axis=1)   # [N, 9]
     ncand = cand.shape[1]
     occupied = st.alive[cand]                         # [N, C]
     u = jax.random.uniform(k_place, (n, ncand))
-    score = u
-    if params.prefer_empty:
-        score = score + jnp.where(~occupied, 10.0, 0.0)
+    # dominant over any occupant age (int32 < 2.2e9) or merit
+    empty_bonus = jnp.where(~occupied, 1e12, 0.0)
+    if bm == 0:            # RANDOM neighbor (PREFER_EMPTY optional)
+        score = u + (jnp.where(~occupied, 10.0, 0.0)
+                     if params.prefer_empty else 0.0)
+    elif bm == 1:          # AGE: replace the oldest neighbor; empty first
+        # stale stats of DEAD former occupants must not leak into scores
+        occ_age = jnp.where(occupied, st.time_used[cand], 0)
+        score = occ_age.astype(jnp.float32) + u + empty_bonus
+    elif bm == 2:          # MERIT: replace the lowest-merit neighbor
+        occ_merit = jnp.where(occupied, st.merit[cand], 0)
+        score = -occ_merit.astype(jnp.float32) + u + empty_bonus
+    elif bm == 3:          # EMPTY: only empty neighbor cells qualify
+        score = u + empty_bonus
+    else:
+        score = u
     choice = jnp.argmax(score, axis=1)
     target = cand[rows, choice]                       # [N]
+    if bm == 3:
+        # no empty candidate -> the parent keeps waiting (the reference
+        # simply fails the birth)
+        pending = pending & ~occupied.all(axis=1)
+    elif bm == 4:          # FULL_SOUP_RANDOM: anywhere in the world/deme
+        if params.num_demes > 1:
+            cpd = params.num_cells // params.num_demes
+            r = jax.random.randint(jax.random.fold_in(k_place, 4), (n,), 0,
+                                   cpd, dtype=jnp.int32)
+            target = (rows // cpd) * cpd + r
+        else:
+            target = jax.random.randint(jax.random.fold_in(k_place, 4),
+                                        (n,), 0, n, dtype=jnp.int32)
+    elif bm == 5:          # FULL_SOUP_ELDEST (reaper queue analogue):
+        # everyone targets the globally oldest slot (empty cells count as
+        # infinitely old); lowest parent index wins the claim
+        age = jnp.where(st.alive, st.time_used, 2**30)
+        target = jnp.full(n, jnp.argmax(age), jnp.int32)
+    elif bm == 6:          # DEME_RANDOM
+        cpd = params.num_cells // max(params.num_demes, 1)
+        r = jax.random.randint(jax.random.fold_in(k_place, 6), (n,), 0,
+                               cpd, dtype=jnp.int32)
+        target = (rows // cpd) * cpd + r
+    elif bm == 7:          # PARENT_FACING: the faced connection; the
+        # lockstep engine models no rotation, so facing = connection 0
+        # (documented deviation)
+        target = neighbors[:, 0]
+    elif bm == 8:          # NEXT_CELL
+        target = (rows + 1) % n
+    if params.num_demes > 1 and bm in (5, 7, 8):
+        # global/absolute targets must still respect deme boundaries:
+        # a cross-deme target collapses to the parent cell (only
+        # DEMES_MIGRATION_RATE crosses demes)
+        cpd = params.num_cells // params.num_demes
+        target = jnp.where(target // cpd == rows // cpd, target, rows)
     if params.num_demes > 1 and params.demes_migration_rate > 0:
         # DEMES_MIGRATION_RATE: offspring born into a random cell of a
         # random other deme (cPopulation deme migration / cMigrationMatrix
@@ -452,6 +506,26 @@ def flush_births(params, st, key, neighbors, update_no):
     cleared = jnp.where(won | leftover | ~st.alive, False, st.divide_pending)
     st = st.replace(divide_pending=cleared,
                     off_sex=st.off_sex & cleared)
+    if params.population_cap > 0 or params.pop_cap_eldest > 0:
+        # carrying capacity (cPopulation::PositionOffspring pop-cap kills,
+        # cc:5192-5238): when the population exceeds the cap, kill the
+        # excess -- random victims for POPULATION_CAP, the oldest for
+        # POP_CAP_ELDEST -- sparing this update's newborns
+        cap = params.population_cap or params.pop_cap_eldest
+        eligible = st.alive & ~births       # newborns are spared
+        excess = jnp.minimum(jnp.maximum(st.alive.sum() - cap, 0),
+                             eligible.sum())
+        k_cap = jax.random.fold_in(key, 0xCAB)
+        if params.pop_cap_eldest > 0:
+            score = jnp.where(eligible,
+                              st.time_used.astype(jnp.float32)
+                              + jax.random.uniform(k_cap, (n,)), -1.0)
+        else:
+            score = jnp.where(eligible,
+                              jax.random.uniform(k_cap, (n,)), -1.0)
+        order = jnp.argsort(-score)
+        rank = jnp.zeros(n, jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
+        st = st.replace(alive=st.alive & ~(rank < excess))
     if params.hw_type in (1, 2):
         # a winning SMT parent's offspring buffer resets to the 1-inst
         # blank (Divide_Main tail, cHardwareTransSMT.cc:485)

@@ -1,0 +1,154 @@
+"""BIRTH_METHOD 0-8 placement + POPULATION_CAP carrying capacity.
+
+Reference: cPopulation::PositionOffspring (cPopulation.cc:5185, the 12
+ePOSITION_OFFSPRING methods from core/Definitions.h:67-82) and the
+pop-cap kill paths (cc:5192-5238).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from avida_tpu.config import AvidaConfig
+from avida_tpu.config.environment import default_logic9_environment
+from avida_tpu.config.instset import default_instset
+from avida_tpu.core.state import make_world_params, zeros_population
+from avida_tpu.ops import birth as birth_ops
+
+
+def _params(**kw):
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 6
+    cfg.WORLD_Y = 6
+    cfg.TPU_MAX_MEMORY = 64
+    for k, v in kw.items():
+        cfg.set(k, v)
+    return make_world_params(cfg, default_instset(),
+                             default_logic9_environment())
+
+
+def _pending_world(params, parents=(14,), fill=()):
+    n, L, R = params.num_cells, params.max_memory, params.num_reactions
+    st = zeros_population(n, L, R)
+    tape = np.zeros((n, L), np.uint8)
+    alive = np.zeros(n, bool)
+    pend = np.zeros(n, bool)
+    age = np.zeros(n, np.int32)
+    merit = np.zeros(n, np.float32)
+    for c in parents:
+        tape[c, :20] = 2
+        alive[c] = pend[c] = True
+        merit[c] = 10.0
+    for i, c in enumerate(fill):
+        alive[c] = True
+        age[c] = 10 + i * 10          # increasing ages
+        merit[c] = 1.0 + i            # increasing merits
+    return st.replace(
+        tape=jnp.asarray(tape), genome=jnp.asarray(tape.astype(np.int8)),
+        alive=jnp.asarray(alive), merit=jnp.asarray(merit),
+        time_used=jnp.asarray(age),
+        divide_pending=jnp.asarray(pend),
+        off_len=jnp.where(jnp.asarray(pend), 20, 0),
+        mem_len=jnp.where(jnp.asarray(alive), 20, 0),
+        genome_len=jnp.where(jnp.asarray(alive), 20, 0),
+    )
+
+
+def _flush(params, st, seed=0):
+    neighbors = jnp.asarray(birth_ops.neighbor_table(
+        params.world_x, params.world_y, params.geometry))
+    return birth_ops.flush_births(params, st, jax.random.key(seed),
+                                  neighbors, jnp.int32(0))
+
+
+def _newborn_cells(st0, st1):
+    return np.nonzero(np.asarray(st1.alive) & ~np.asarray(st0.alive))[0]
+
+
+def test_birth_method_1_replaces_oldest_neighbor():
+    params = _params(BIRTH_METHOD=1, ALLOW_PARENT=0)
+    # parent at 14; neighbors 13 and 15 occupied, 15 older; rest empty ->
+    # empties win first
+    st = _pending_world(params, parents=(14,), fill=(13, 15))
+    st1 = _flush(params, st)
+    born = _newborn_cells(st, st1)
+    assert len(born) == 1 and born[0] not in (13, 15)   # empty preferred
+    # now fill the entire neighborhood: oldest (highest fill index) dies
+    neigh = birth_ops.neighbor_table(params.world_x, params.world_y, 2)[14]
+    st2 = _pending_world(params, parents=(14,), fill=tuple(neigh))
+    st3 = _flush(params, st2)
+    # the newborn landed on the OLDEST neighbor
+    ages = {c: 10 + i * 10 for i, c in enumerate(neigh)}
+    oldest = max(neigh, key=lambda c: ages[c])
+    assert bool(np.asarray(st3.birth_update)[oldest] == 0)
+
+
+def test_birth_method_2_replaces_lowest_merit_neighbor():
+    params = _params(BIRTH_METHOD=2, ALLOW_PARENT=0)
+    neigh = birth_ops.neighbor_table(params.world_x, params.world_y, 2)[14]
+    st = _pending_world(params, parents=(14,), fill=tuple(neigh))
+    st1 = _flush(params, st)
+    lowest = min(neigh, key=lambda c: 1.0 + list(neigh).index(c))
+    assert bool(np.asarray(st1.birth_update)[lowest] == 0)
+
+
+def test_birth_method_3_requires_empty_cell():
+    params = _params(BIRTH_METHOD=3, ALLOW_PARENT=0)
+    neigh = birth_ops.neighbor_table(params.world_x, params.world_y, 2)[14]
+    st = _pending_world(params, parents=(14,), fill=tuple(neigh))
+    st1 = _flush(params, st)
+    # neighborhood full: no birth, parent still pending
+    assert len(_newborn_cells(st, st1)) == 0
+    assert bool(st1.divide_pending[14])
+
+
+def test_birth_method_4_full_soup_random():
+    params = _params(BIRTH_METHOD=4)
+    st = _pending_world(params, parents=(14,))
+    # across seeds, births land beyond the 8-neighborhood
+    neigh = set(birth_ops.neighbor_table(params.world_x, params.world_y,
+                                         2)[14].tolist()) | {14}
+    landed = set()
+    for s in range(8):
+        st1 = _flush(params, st, seed=s)
+        landed.update(_newborn_cells(st, st1).tolist())
+    assert landed - neigh, landed
+
+
+def test_birth_method_5_replaces_global_eldest():
+    params = _params(BIRTH_METHOD=5)
+    # full world (empty cells count as trivially oldest, so fill them all):
+    # the oldest organism dies for the newborn
+    fill = tuple(c for c in range(36) if c != 14)
+    st = _pending_world(params, parents=(14,), fill=fill)
+    st1 = _flush(params, st)
+    oldest = fill[-1]                 # highest age in _pending_world
+    assert bool(np.asarray(st1.birth_update)[oldest] == 0)
+
+
+def test_birth_method_8_next_cell():
+    params = _params(BIRTH_METHOD=8)
+    st = _pending_world(params, parents=(14,))
+    st1 = _flush(params, st)
+    assert _newborn_cells(st, st1).tolist() == [15]
+
+
+def test_population_cap_kills_excess():
+    params = _params(POPULATION_CAP=5)
+    st = _pending_world(params, parents=(14,),
+                        fill=tuple(range(8)))    # 9 alive, cap 5
+    st1 = _flush(params, st)
+    assert int(np.asarray(st1.alive).sum()) == 5
+
+
+def test_pop_cap_eldest_kills_oldest():
+    params = _params(POP_CAP_ELDEST=6)
+    st = _pending_world(params, parents=(14,), fill=tuple(range(8)))
+    st1 = _flush(params, st)
+    alive = np.asarray(st1.alive)
+    assert alive.sum() == 6
+    # the oldest fills (highest ages: cells 6,7 at ages 70,80) died first
+    assert not alive[7] and not alive[6]
