@@ -147,6 +147,10 @@ class GenotypeArbiter:
         return sum(1 for g in self.genotypes.values()
                    if g.num_units > 0 and g.threshold)
 
+    def live_genotypes(self):
+        """Iterator over genotypes with living members (stats entropy)."""
+        return (g for g in self.genotypes.values() if g.num_units > 0)
+
     def dominant(self) -> Genotype | None:
         """Most-abundant live genotype (ref dominant genotype reporting)."""
         best = None

@@ -91,6 +91,21 @@ def open_dominant_dat(data_dir: str) -> DatFile:
          "Name of the Dominant Genotype"])
 
 
+def open_fitness_dat(data_dir: str) -> DatFile:
+    return DatFile(
+        os.path.join(data_dir, "fitness.dat"), "Avida Fitness Data",
+        ["Update", "Avg Generation", "Average Fitness", "Maximum Fitness",
+         "Number of organisms"])
+
+
+def open_stats_dat(data_dir: str) -> DatFile:
+    return DatFile(
+        os.path.join(data_dir, "stats.dat"), "Generic Statistics Data",
+        ["Update", "Average creature age", "Genotype entropy",
+         "Average gestation time", "Number of genotypes",
+         "Dominant genotype abundance"])
+
+
 def open_resource_dat(data_dir: str, resource_names: list) -> DatFile:
     return DatFile(
         os.path.join(data_dir, "resource.dat"), "Avida resource data",

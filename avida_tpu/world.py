@@ -329,6 +329,38 @@ class World:
             g.num_units, births, breed_true, g.depth, 0, max_fit, g.gid,
             name])
 
+    def _action_PrintFitnessData(self, args):
+        """fitness.dat (ref cActionPrintFitnessData,
+        actions/PrintActions.cc:1380: update, generation, ave/max fitness,
+        organism count; histogram variants not implemented)."""
+        s = self._summary()
+        f = self._file("fitness", output_mod.open_fitness_dat)
+        f.write_row([self.update, float(s["ave_generation"]),
+                     float(s["ave_fitness"]), float(s["max_fitness"]),
+                     int(s["num_organisms"])])
+
+    def _action_PrintStatsData(self, args):
+        """stats.dat (ref cActionPrintStatsData -> cStats entropy/age
+        aggregation): population age, genotype Shannon entropy, gestation,
+        genotype counts."""
+        s = self._summary()
+        f = self._file("stats", output_mod.open_stats_dat)
+        sysm = self.systematics
+        entropy = 0.0
+        num_gt = 0
+        dom_abund = 0
+        if sysm is not None and sysm.num_genotypes:
+            import math
+            counts = [g.num_units for g in sysm.live_genotypes()]
+            total = sum(counts) or 1
+            entropy = -sum((c / total) * math.log(c / total)
+                           for c in counts if c > 0)
+            num_gt = sysm.num_genotypes
+            dom = sysm.dominant()
+            dom_abund = dom.num_units if dom else 0
+        f.write_row([self.update, float(s["ave_age"]), entropy,
+                     float(s["ave_gestation"]), num_gt, dom_abund])
+
     def _action_PrintTasksData(self, args):
         s = self._summary()
         f = self._file("tasks", output_mod.open_tasks_dat,
