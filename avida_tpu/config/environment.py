@@ -129,10 +129,22 @@ class Resource:
     inflowx2: int = -1
     inflowy1: int = -1
     inflowy2: int = -1
+    # gradient (moving-peak) resources (cGradientCount.cc)
+    height: int = 0               # peak height; 0 = not a gradient resource
+    spread: int = 0               # cone radius
+    plateau: float = -1.0         # flat-top value (-1 = pure cone)
+    updatestep: int = 1           # updates between peak moves
+    peakx: int = -1               # -1 = random initial position
+    peaky: int = -1
+    move_a_scaler: float = 1.0    # >1 enables movement
 
     @property
     def is_spatial(self) -> bool:
         return self.geometry != "global"
+
+    @property
+    def is_gradient(self) -> bool:
+        return self.height > 0
 
 
 @dataclass
@@ -324,7 +336,31 @@ def load_environment(path: str) -> Environment:
                         inflowy1=int(kv.get("inflowy1", -1)),
                         inflowy2=int(kv.get("inflowy2", -1)),
                     ))
-            # GRADIENT_RESOURCE / CELL / GRID -- planned (spatial resources)
+            elif kind == "GRADIENT_RESOURCE":
+                # moving-peak resources (cEnvironment::LoadGradientResource
+                # cc:831 -> cGradientCount).  Core parameters only; halos,
+                # hills, barriers and plateau depletion are future work.
+                for spec in tokens[1:]:
+                    rname, kvs = _parse_colon_kv(spec)
+                    kv = {}
+                    for item in kvs:
+                        if "=" in item:
+                            k, v = item.split("=", 1)
+                            kv[k] = v
+                    env.resources.append(Resource(
+                        name=rname, geometry="grid",
+                        # no stencil dynamics: the cone is recomputed each
+                        # update, so diffusing these rows is wasted work
+                        xdiffuse=0.0, ydiffuse=0.0,
+                        height=int(float(kv.get("height", 8))),
+                        spread=int(float(kv.get("spread", 10))),
+                        plateau=float(kv.get("plateau", -1.0)),
+                        updatestep=int(float(kv.get("updatestep", 1))),
+                        peakx=int(float(kv.get("peakx", -1))),
+                        peaky=int(float(kv.get("peaky", -1))),
+                        move_a_scaler=float(kv.get("move_a_scaler", 1.0)),
+                    ))
+            # CELL / GRID -- planned
     return env
 
 

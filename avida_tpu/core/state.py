@@ -126,6 +126,15 @@ class WorldParams(struct.PyTreeNode):
     sres_ydiffuse: tuple = struct.field(pytree_node=False, default=())
     sres_inflow_box: tuple = struct.field(pytree_node=False, default=())
     sres_torus: tuple = struct.field(pytree_node=False, default=())
+    # gradient (moving-peak) spatial resources (cGradientCount):
+    # per-spatial-resource-row parameters; height 0 = ordinary diffusion
+    sres_grad_height: tuple = struct.field(pytree_node=False, default=())
+    sres_grad_spread: tuple = struct.field(pytree_node=False, default=())
+    sres_grad_plateau: tuple = struct.field(pytree_node=False, default=())
+    sres_grad_updatestep: tuple = struct.field(pytree_node=False, default=())
+    sres_grad_move: tuple = struct.field(pytree_node=False, default=())
+    sres_grad_peakx: tuple = struct.field(pytree_node=False, default=())
+    sres_grad_peaky: tuple = struct.field(pytree_node=False, default=())
 
     @property
     def num_cells(self) -> int:
@@ -231,6 +240,20 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
                               for r in environment.spatial_resources()),
         sres_torus=tuple(r.geometry == "torus"
                          for r in environment.spatial_resources()),
+        sres_grad_height=tuple(r.height
+                               for r in environment.spatial_resources()),
+        sres_grad_spread=tuple(r.spread
+                               for r in environment.spatial_resources()),
+        sres_grad_plateau=tuple(r.plateau
+                                for r in environment.spatial_resources()),
+        sres_grad_updatestep=tuple(
+            r.updatestep for r in environment.spatial_resources()),
+        sres_grad_move=tuple(r.move_a_scaler > 1
+                             for r in environment.spatial_resources()),
+        sres_grad_peakx=tuple(r.peakx
+                              for r in environment.spatial_resources()),
+        sres_grad_peaky=tuple(r.peaky
+                              for r in environment.spatial_resources()),
     )
 
 
@@ -362,6 +385,8 @@ class PopulationState(struct.PyTreeNode):
     # --- resources (world-level state carried with the population) ---
     resources: jax.Array       # f32[Rg]    global pools (cResourceCount)
     res_grid: jax.Array        # f32[Rs, N] spatial per-cell (cSpatialResCount)
+    grad_peak: jax.Array       # int32[Rs, 2] moving-peak (x, y); -1 = unset
+                               # (cGradientCount peak position)
 
     @property
     def mem(self) -> jax.Array:
@@ -425,6 +450,7 @@ def zeros_population(n: int, L: int, R: int, n_global_res: int = 0,
         budget_carry=i32(n),
         resources=f32(n_global_res),
         res_grid=f32((n_spatial_res, n)),
+        grad_peak=jnp.full((n_spatial_res, 2), -1, jnp.int32),
     )
 
 

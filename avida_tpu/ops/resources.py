@@ -38,6 +38,65 @@ def step_global(params, resources):
     return jnp.maximum(resources + inflow - outflow * resources, 0.0)
 
 
+def step_gradient(params, st, key, update_no):
+    """Moving-peak gradient resources (cGradientCount::UpdateCount ->
+    updatePeakRes/fillinResourceValues, main/cGradientCount.cc).
+
+    Each gradient row's grid is the cone height/(dist+1) within `spread`
+    of the peak (plateau cells -- where height/(dist+1) >= 1 -- take the
+    plateau value when set), recomputed every update; the peak takes a
+    random-direction step every `updatestep` updates when movement is on.
+    Simplifications (documented): no halos/hills/barriers, and the cone
+    refreshes each update rather than modeling plateau depletion.
+    """
+    if not any(params.sres_grad_height):
+        return st
+    X, Y = params.world_x, params.world_y
+    n = params.num_cells
+    cx = jnp.arange(n) % X
+    cy = jnp.arange(n) // X
+    res_grid = st.res_grid
+    grad_peak = st.grad_peak
+    for r, h in enumerate(params.sres_grad_height):
+        if not h:
+            continue
+        spread = params.sres_grad_spread[r]
+        plateau = params.sres_grad_plateau[r]
+        kr = jax.random.fold_in(key, r)
+        px, py = grad_peak[r, 0], grad_peak[r, 1]
+        # initial placement: the configured peakx/peaky, else random
+        # within the world, spread-inset (generatePeak cc:?)
+        k_init, k_move = jax.random.split(kr)
+        unset = px < 0
+        cfg_px, cfg_py = params.sres_grad_peakx[r], params.sres_grad_peaky[r]
+        init_px = (jnp.int32(cfg_px) if cfg_px >= 0 else jax.random.randint(
+            k_init, (), min(spread, X // 2), max(X - spread, X // 2 + 1),
+            dtype=jnp.int32))
+        init_py = (jnp.int32(cfg_py) if cfg_py >= 0 else jax.random.randint(
+            jax.random.fold_in(k_init, 1), (),
+            min(spread, Y // 2), max(Y - spread, Y // 2 + 1),
+            dtype=jnp.int32))
+        px = jnp.where(unset, init_px, px)
+        py = jnp.where(unset, init_py, py)
+        if params.sres_grad_move[r]:
+            ustep = max(params.sres_grad_updatestep[r], 1)
+            step_due = (update_no % ustep) == 0
+            dx = jax.random.randint(k_move, (), -1, 2, dtype=jnp.int32)
+            dy = jax.random.randint(jax.random.fold_in(k_move, 1), (),
+                                    -1, 2, dtype=jnp.int32)
+            px = jnp.clip(px + jnp.where(step_due, dx, 0), 0, X - 1)
+            py = jnp.clip(py + jnp.where(step_due, dy, 0), 0, Y - 1)
+        dist = jnp.sqrt(((cx - px) ** 2 + (cy - py) ** 2)
+                        .astype(jnp.float32))
+        cone = h / (dist + 1.0)
+        if plateau >= 0:
+            cone = jnp.where(cone >= 1.0, plateau, cone)
+        cone = jnp.where(dist <= spread, cone, 0.0)
+        res_grid = res_grid.at[r].set(cone)
+        grad_peak = grad_peak.at[r, 0].set(px).at[r, 1].set(py)
+    return st.replace(res_grid=res_grid, grad_peak=grad_peak)
+
+
 def step_spatial(params, res_grid):
     """One update of a spatial resource: inflow box, outflow, diffusion.
 

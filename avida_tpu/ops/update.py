@@ -65,6 +65,8 @@ def update_step(params, st, key, neighbors, update_no):
     # resource dynamics integrate once per update (ops/resources.py)
     st = st.replace(resources=res_ops.step_global(params, st.resources),
                     res_grid=res_ops.step_spatial(params, st.res_grid))
+    st = res_ops.step_gradient(params, st, jax.random.fold_in(key, 0x6AD),
+                               update_no)
 
     budgets = sched_ops.compute_budgets(params, st, k_budget)
     # Budget carry-over (TPU lockstep semantic, SURVEY §7 step 3): the

@@ -45,6 +45,7 @@ def population_sharding(mesh: Mesh) -> NamedSharding:
 # PopulationState fields whose cell axis is NOT dim 0 (see core/state.py):
 # the spatial resource grid is [R_s, N], global pools have no cell axis.
 _FIELD_SPECS = {"res_grid": P(None, CELL_AXIS), "resources": P(),
+                "grad_peak": P(),
                 # birth-chamber store: world-level, replicated
                 "bc_mem": P(), "bc_len": P(), "bc_merit": P(),
                 "bc_valid": P(),

@@ -185,7 +185,7 @@ class World:
             # overwrite only per-organism arrays (cell axis = dim 0);
             # world-level state (resources, birth-chamber store) is
             # untouched by an Inject
-            world_fields = {"resources", "res_grid",
+            world_fields = {"resources", "res_grid", "grad_peak",
                             "bc_mem", "bc_len", "bc_merit", "bc_valid"}
             updates = {
                 name: getattr(self.state, name).at[c].set(

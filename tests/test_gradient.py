@@ -1,0 +1,53 @@
+"""Gradient (moving-peak) resources.
+
+Reference: cGradientCount (main/cGradientCount.cc) via
+cEnvironment::LoadGradientResource (cc:831): a cone of resource
+height/(dist+1) within `spread` of a peak that takes a random step every
+`updatestep` updates; plateau caps the cone top.  Simplifications
+documented in ops/resources.step_gradient (no halos/hills/barriers or
+plateau depletion).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from avida_tpu.world import World
+
+
+def _world(tmp_path):
+    env_cfg = tmp_path / "environment.cfg"
+    env_cfg.write_text(
+        "GRADIENT_RESOURCE food:height=8:spread=6:plateau=2:updatestep=2"
+        ":move_a_scaler=2\n"
+        "REACTION NOT not process:value=1.0:type=pow:resource=food\n")
+    (tmp_path / "avida.cfg").write_text(
+        "WORLD_X 20\nWORLD_Y 20\nRANDOM_SEED 5\n"
+        "ENVIRONMENT_FILE environment.cfg\n"
+        "AVE_TIME_SLICE 100\nTPU_MAX_STEPS_PER_UPDATE 100\n")
+    return World(config_dir=str(tmp_path), data_dir=str(tmp_path))
+
+
+def test_gradient_resource_cone_and_movement(tmp_path):
+    w = _world(tmp_path)
+    r = w.environment.spatial_resources()[0]
+    assert r.is_gradient and r.height == 8 and r.spread == 6
+
+    w.inject()
+    w.run(max_updates=10)
+    rg = np.asarray(w.state.res_grid[0]).reshape(20, 20)
+    peak = np.asarray(w.state.grad_peak[0]).copy()
+    assert (peak >= 0).all()
+    # the cone exists, is bounded by the plateau cap, and covers the spread
+    assert rg.max() > 0
+    assert abs(rg.max() - 2.0) < 1e-5
+    assert 20 < (rg > 0).sum() < 160          # pi*6^2 ~ 113 cells
+    # the resource value at the peak cell is the plateau
+    assert abs(rg[peak[1], peak[0]] - 2.0) < 1e-5
+
+    # the peak wanders over time (move_a_scaler > 1)
+    w.run(max_updates=40)
+    peak2 = np.asarray(w.state.grad_peak[0])
+    assert (peak != peak2).any(), (peak, peak2)
