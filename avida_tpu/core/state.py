@@ -154,6 +154,11 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         raise NotImplementedError(
             "instruction costs are not implemented for TransSMT hardware "
             "yet; zero the cost/ft_cost columns or use heads hardware")
+    for r in environment.spatial_resources():
+        if r.is_gradient and (r.peakx >= cfg.WORLD_X or r.peaky >= cfg.WORLD_Y):
+            raise ValueError(
+                f"GRADIENT_RESOURCE {r.name!r} peak ({r.peakx},{r.peaky}) "
+                f"lies outside the {cfg.WORLD_X}x{cfg.WORLD_Y} world")
     if cfg.POPULATION_CAP and cfg.POP_CAP_ELDEST:
         raise ValueError(
             "POPULATION_CAP and POP_CAP_ELDEST are mutually exclusive "
@@ -317,6 +322,8 @@ class PopulationState(struct.PyTreeNode):
     generation: jax.Array     # int32[N]
     max_executed: jax.Array   # int32[N]    death threshold (DEATH_METHOD)
     num_divides: jax.Array    # int32[N]
+    sterile: jax.Array        # bool[N]     divide permanently fails
+                              # (STERILIZE_*, Divide_TestFitnessMeasures)
     breed_true: jax.Array     # bool[N]     born identical to parent genome
                               # (ref cPhenotype copy_true / is_breed_true)
 
@@ -427,6 +434,7 @@ def zeros_population(n: int, L: int, R: int, n_global_res: int = 0,
         fitness=f32(n), last_bonus=f32(n), last_merit_base=f32(n),
         executed_size=i32(n), copied_size=i32(n), child_copied_size=i32(n),
         generation=i32(n), max_executed=i32(n), num_divides=i32(n),
+        sterile=jnp.zeros(n, bool),
         breed_true=jnp.zeros(n, bool),
         divide_pending=jnp.zeros(n, bool),
         off_start=i32(n), off_len=i32(n),

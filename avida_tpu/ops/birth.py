@@ -377,7 +377,7 @@ def flush_births(params, st, key, neighbors, update_no):
     const_updates = {
         "regs": 0, "heads": 0, "stacks": 0, "sp": 0, "active_stack": 0,
         "read_label": jnp.int8(0), "read_label_len": 0,
-        "mal_active": False, "alive": True,
+        "mal_active": False, "alive": True, "sterile": False,
         "input_ptr": 0, "input_buf": 0, "input_buf_n": 0, "output_buf": 0,
         "cur_bonus": jnp.asarray(params.default_bonus, st.cur_bonus.dtype),
         "cur_task_count": 0, "cur_reaction_count": 0,

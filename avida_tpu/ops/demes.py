@@ -132,7 +132,7 @@ _ZERO_FIELDS = [
     "cost_wait", "ft_paid_lo", "ft_paid_hi",
 ]
 _FALSE_FIELDS = ["mal_active", "breed_true", "divide_pending", "off_sex",
-                 "parasite_active", "inject_pending"]
+                 "parasite_active", "inject_pending", "sterile"]
 
 
 def _clone_reset(params, st, sel_cells, genome, genome_len, alive, merit,

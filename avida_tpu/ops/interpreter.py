@@ -417,7 +417,8 @@ def micro_step(params, st, key, exec_mask):
                               * params.min_exe_lines).astype(jnp.int32)) &
               (copied_count >= (child_size.astype(jnp.float32)
                                 * params.min_copied_lines).astype(jnp.int32)) &
-              ~st.divide_pending)   # lockstep: one pending birth per organism
+              ~st.divide_pending &  # lockstep: one pending birth per organism
+              ~st.sterile)          # STERILIZE_*: divide permanently fails
     div_m = div_try & viable
 
     # offspring extraction is DEFERRED: record the split; ops/birth.py

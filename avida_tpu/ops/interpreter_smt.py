@@ -361,7 +361,7 @@ def micro_step_smt(params, st, key, exec_mask):
                          .astype(jnp.int32))
     div_m = (div_try & (wh_space == 1)
              & (child_size >= min_sz) & (child_size <= max_sz)
-             & ~st.divide_pending)
+             & ~st.divide_pending & ~st.sterile)
 
     # ---- Inject (either thread; cc:1657) ----
     inj_try = is_op(SEM_INJECT)

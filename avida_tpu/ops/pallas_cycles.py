@@ -90,7 +90,7 @@ FV_LAST_BONUS = 3
 FV_LAST_MERIT_BASE = 4
 NF = 8
 
-FLAG_MAL, FLAG_ALIVE, FLAG_DIVPEND = 1, 2, 4
+FLAG_MAL, FLAG_ALIVE, FLAG_DIVPEND, FLAG_STERILE = 1, 2, 4, 8
 
 DEFAULT_BLOCK = 256
 CHUNK = 64           # sublane rows per register-resident traversal chunk
@@ -541,7 +541,9 @@ def _make_kernel(params, L, B, num_steps):
                                      ).astype(jnp.int32))
             exec_count = exec_count0 + jnp.where(
                 div_try & ~ip_exec_already & (ip < parent_size), 1, 0)
-            viable = ((child_size >= min_sz) & (child_size <= max_sz) &
+            sterile_f = (flags & FLAG_STERILE) != 0
+            viable = (~sterile_f &
+                      (child_size >= min_sz) & (child_size <= max_sz) &
                       (parent_size >= min_sz) & (parent_size <= max_sz) &
                       (exec_count >= (parent_size.astype(jnp.float32)
                                       * params.min_exe_lines).astype(jnp.int32)) &
@@ -810,7 +812,8 @@ def _make_kernel(params, L, B, num_steps):
             ivec_ref[IV_INSTS_EXEC, :] = insts_exec[0]
             flags_new = (jnp.where(new_mal, FLAG_MAL, 0)
                          | jnp.where(alive, FLAG_ALIVE, 0)
-                         | jnp.where(divide_pending, FLAG_DIVPEND, 0))
+                         | jnp.where(divide_pending, FLAG_DIVPEND, 0)
+                         | jnp.where(sterile_f, FLAG_STERILE, 0))
             ivec_ref[IV_FLAGS, :] = flags_new[0]
             ivec_ref[pl.ds(IV_REGS, 3), :] = regs_new
             ivec_ref[pl.ds(IV_HEADS, 4), :] = heads_new
@@ -904,7 +907,8 @@ def pack_state(params, st, granted):
     setrow(IV_OFF_COPIED, st.off_copied_size)
     setrow(IV_INSTS_EXEC, st.insts_executed)
     setrow(IV_FLAGS, (st.mal_active * FLAG_MAL + st.alive * FLAG_ALIVE
-                      + st.divide_pending * FLAG_DIVPEND))
+                      + st.divide_pending * FLAG_DIVPEND
+                      + st.sterile * FLAG_STERILE))
     setrow(IV_GENOME_LEN, st.genome_len)
     setrow(IV_MAX_EXEC, st.max_executed)
     setrow(IV_GRANTED, granted)
@@ -1010,6 +1014,7 @@ def unpack_state(params, st, packed):
         read_label_len=row(IV_READ_LABEL_LEN),
         mal_active=(flags & FLAG_MAL) != 0,
         alive=(flags & FLAG_ALIVE) != 0,
+        sterile=(flags & FLAG_STERILE) != 0,
         input_ptr=row(IV_INPUT_PTR),
         input_buf=jnp.stack([row(IV_INPUT_BUF + k) for k in range(3)], axis=1),
         input_buf_n=row(IV_INPUT_BUF_N),

@@ -1,0 +1,49 @@
+"""Cached per-genotype Test-CPU metrics.
+
+TPU-native equivalent of Systematics::GenomeTestMetrics
+(avida-core/source/systematics/GenomeTestMetrics.cc): sandbox fitness for
+a genotype is computed once and memoized by genome content, so reversion
+tests (cHardwareBase::Divide_TestFitnessMeasures cc:866) and analyze-mode
+recalculation don't re-run gestations for genotypes already scored.
+Uncached genotypes are evaluated in ONE batched Test-CPU run
+(analyze/testcpu.evaluate_genomes).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class GenomeTestMetrics:
+    """Host-side genome-bytes -> (viable, fitness, gestation) cache."""
+
+    def __init__(self, params):
+        self.params = params
+        self._cache: dict[bytes, tuple[bool, float, int]] = {}
+
+    def __len__(self):
+        return len(self._cache)
+
+    def get_fitness(self, genomes: np.ndarray, lens: np.ndarray,
+                    seed: int = 0) -> np.ndarray:
+        """f64[G] sandbox fitness for each genome row (0 = inviable)."""
+        from avida_tpu.analyze.testcpu import evaluate_genomes
+
+        keys = [genomes[i, : int(lens[i])].tobytes()
+                for i in range(genomes.shape[0])]
+        miss = [i for i, k in enumerate(keys) if k not in self._cache]
+        if miss:
+            # pad the batch to a power of two so the jitted gestation run
+            # compiles O(log N) shapes, not one per distinct miss count
+            G = 1 << max(len(miss) - 1, 0).bit_length()
+            sub = np.zeros((G, self.params.max_memory), np.int8)
+            sub_lens = np.zeros(G, np.int32)
+            for j, i in enumerate(miss):
+                sub[j, : int(lens[i])] = genomes[i, : int(lens[i])]
+                sub_lens[j] = lens[i]
+            res = evaluate_genomes(self.params, sub, sub_lens, seed=seed)
+            for j, i in enumerate(miss):
+                fit = float(res.fitness[j]) if bool(res.viable[j]) else 0.0
+                self._cache[keys[i]] = (bool(res.viable[j]), fit,
+                                        int(res.gestation_time[j]))
+        return np.asarray([self._cache[k][1] for k in keys], np.float64)

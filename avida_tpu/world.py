@@ -155,6 +155,24 @@ class World:
         self.systematics = (GenotypeArbiter(self.params.num_cells)
                             if cfg.get("TPU_SYSTEMATICS", 1) else None)
 
+        # offspring reversion/sterilization via the batched Test CPU
+        # (cHardwareBase::Divide_TestFitnessMeasures cc:866); fitness
+        # lookups memoize per genotype (systematics/test_metrics.py)
+        self._revert = {
+            "fatal": (cfg.REVERT_FATAL, cfg.STERILIZE_FATAL),
+            "neg": (cfg.REVERT_DETRIMENTAL, cfg.STERILIZE_DETRIMENTAL),
+            "neut": (cfg.REVERT_NEUTRAL, cfg.STERILIZE_NEUTRAL),
+            "pos": (cfg.REVERT_BENEFICIAL, cfg.STERILIZE_BENEFICIAL),
+        }
+        self._revert_on = any(p > 0 for pair in self._revert.values()
+                              for p in pair)
+        self._neut_min = 1.0 - cfg.get("NEUTRAL_MIN", 0.0)
+        self._neut_max = 1.0 + cfg.get("NEUTRAL_MAX", 0.0)
+        if self._revert_on:
+            from avida_tpu.systematics.test_metrics import GenomeTestMetrics
+            self.test_metrics = GenomeTestMetrics(self.params)
+            self._revert_rng = np.random.default_rng(seed ^ 0x5EED)
+
     # ---- event actions (subset of the 418-action library) ----
 
     def _resolve_org_path(self, name: str) -> np.ndarray:
@@ -555,11 +573,89 @@ class World:
     def run_update(self):
         """Run ONE update (does not advance self.update; callers do).
         Device-side bookkeeping lives in ops/update.update_scan -- this is
-        the chunk-of-1 case plus the per-update systematics feed."""
+        the chunk-of-1 case plus the per-update reversion test and
+        systematics feed."""
         executed = self._scan_updates(1)
+        if self._revert_on:
+            self._apply_reversion()
         if self.systematics is not None:
             self._feed_systematics()
         return executed
+
+    def _apply_reversion(self):
+        """Offspring fitness test: revert (to the parent genome) or
+        sterilize newborns whose sandbox fitness classifies fatal /
+        detrimental / neutral / beneficial vs their parent's
+        (Divide_TestFitnessMeasures, cHardwareBase.cc:866; thresholds
+        neut_min/max from NEUTRAL_MIN/MAX).  Sterilization follows the
+        reference: the offspring lives but can never divide (sterile
+        flag).  Runs at birth rather than at divide (the lockstep flush
+        is the divide boundary).  Documented edges: a newborn whose
+        parent cell was overwritten this update cannot be reverted --
+        inviable (fatal) ones are refused (killed), others admitted
+        as-is; device-side per-update birth counters (BIRTHS triggers,
+        deaths) are computed before this host step and may overcount by
+        the refused offspring."""
+        st = self.state
+        alive = np.asarray(st.alive)
+        born = (np.asarray(st.birth_update) == self.update) & alive
+        cells = np.nonzero(born)[0]
+        if not cells.size:
+            return
+        # device-gather ONLY the newborn + parent rows (update-granularity
+        # transfer discipline, SURVEY SS5)
+        idx = jnp.asarray(cells)
+        parents = np.asarray(st.parent_id[idx])
+        pidx = jnp.asarray(np.clip(parents, 0, None))
+        child_g = np.asarray(st.genome[idx])
+        child_l = np.asarray(st.genome_len[idx])
+        par_g = np.asarray(st.genome[pidx])
+        par_l = np.asarray(st.genome_len[pidx])
+        parent_ok = ((parents >= 0) & alive[np.clip(parents, 0, None)]
+                     & (np.asarray(st.birth_update[pidx]) != self.update))
+        child_fit = self.test_metrics.get_fitness(child_g, child_l)
+        parent_fit = self.test_metrics.get_fitness(par_g, par_l)
+        neut_min = parent_fit * self._neut_min
+        neut_max = parent_fit * self._neut_max
+        cat = np.where(child_fit == 0.0, 0,
+                       np.where(child_fit < neut_min, 1,
+                                np.where(child_fit <= neut_max, 2, 3)))
+        probs = [self._revert["fatal"], self._revert["neg"],
+                 self._revert["neut"], self._revert["pos"]]
+        u = self._revert_rng.random((2, cells.size))
+        want_revert = np.asarray([u[0, i] < probs[cat[i]][0]
+                                  for i in range(cells.size)])
+        revert = want_revert & parent_ok
+        sterilize = np.asarray([u[1, i] < probs[cat[i]][1]
+                                for i in range(cells.size)])
+        # fatal reversions with no parent genome left are refused outright
+        kill_fallback = want_revert & ~parent_ok & (cat == 0)
+        if not (revert.any() or sterilize.any() or kill_fallback.any()):
+            return
+        new_st = st
+        if revert.any():
+            from avida_tpu.ops.interpreter import pack_tape
+            rev_cells = jnp.asarray(cells[revert])
+            rev_parents = jnp.asarray(parents[revert])
+            pg = new_st.genome[rev_parents]
+            pl = new_st.genome_len[rev_parents]
+            new_st = new_st.replace(
+                genome=new_st.genome.at[rev_cells].set(pg),
+                tape=new_st.tape.at[rev_cells].set(pack_tape(pg)),
+                genome_len=new_st.genome_len.at[rev_cells].set(pl),
+                mem_len=new_st.mem_len.at[rev_cells].set(pl),
+                breed_true=new_st.breed_true.at[rev_cells].set(True),
+            )
+        if sterilize.any():
+            # reference semantics: the offspring lives (occupying its
+            # cell, competing for space) but can never divide
+            mark = jnp.asarray(cells[sterilize])
+            new_st = new_st.replace(
+                sterile=new_st.sterile.at[mark].set(True))
+        if kill_fallback.any():
+            kill = jnp.asarray(cells[kill_fallback])
+            new_st = new_st.replace(alive=new_st.alive.at[kill].set(False))
+        self.state = new_st
 
     def run_updates(self, k: int):
         """Run k consecutive updates as one device program (ops/update.py
@@ -636,7 +732,7 @@ class World:
         # event-free stretches run as one device program; anything needing
         # per-update host work (systematics, generation triggers) forces
         # single stepping
-        can_chunk = (self.systematics is None and
+        can_chunk = (self.systematics is None and not self._revert_on and
                      not any(ev.trigger in ("generation", "births")
                              for ev in self.events))
         while not self._exit:
