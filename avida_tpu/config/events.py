@@ -5,8 +5,13 @@ AddEventFileFormat, avida-core/source/main/cEventList.h:63,106):
 
     [trigger] [start[:interval[:stop]]] [action] [args...]
 
-Triggers: `u`/`update`, `g`/`generation`, `i`/`immediate`.  Start may be
-`begin`; stop may be `end`.  Actions are dispatched by the host driver
+Triggers: `u`/`update`, `g`/`generation`, `i`/`immediate`, `b`/`births`
+(cumulative birth count).  The reference's BIRTHS_INTERRUPT trigger
+(cEventList.h:63) interrupts an update mid-flight when the count crosses;
+the lockstep engine's update is atomic, so `births` fires at the next
+update boundary instead -- a documented deviation of at most one update's
+latency.  Start may be `begin`; stop may be `end`.  Actions are
+dispatched by the host driver
 (avida_tpu/world.py) against the action registry in avida_tpu/utils/actions.py
 (ref: 418-action library, avida-core/source/actions/).
 """
