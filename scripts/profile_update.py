@@ -3,6 +3,17 @@
 Times each stage of ops/update.update_step separately at bench scale:
 scheduler draw, pack, kernel launch, unpack, birth flush, and the fused
 whole update.  Run on TPU: `python scripts/profile_update.py [world]`.
+
+MEASUREMENT CAVEATS (learned the hard way; see BASELINE.md):
+ - repeated dispatches with IDENTICAL inputs can be elided/cached by the
+   runtime and report absurdly low times -- vary an input per call when
+   timing an op in isolation;
+ - per-call block_until_ready over a remote-device tunnel measures
+   network round-trips (100-300 ms, noisy), not device time -- this
+   script pipelines N dispatches and syncs once, which is the only
+   reliable method here;
+ - treat end-to-end `python bench.py` deltas as ground truth (run-to-run
+   noise ~ +/-2M inst/s at 102k organisms).
 """
 
 from __future__ import annotations
