@@ -333,6 +333,12 @@ class PopulationState(struct.PyTreeNode):
     divide_pending: jax.Array  # bool[N]
     off_start: jax.Array      # int32[N]   offspring start position on tape
     off_len: jax.Array        # int32[N]
+    off_tape: jax.Array       # uint8[N, L] extracted offspring opcodes,
+                              # aligned at 0 and zero-padded beyond off_len
+                              # (written at h-divide by the Pallas kernel, or
+                              # at update end by the XLA path; consumed by
+                              # the birth flush -- persists so a parent whose
+                              # placement lost a conflict can retry)
     off_copied_size: jax.Array  # int32[N]
     off_sex: jax.Array        # bool[N]    offspring awaits a mate (divide-sex;
                               # ref cPhenotype divide_sex + cBirthChamber)
@@ -438,6 +444,7 @@ def zeros_population(n: int, L: int, R: int, n_global_res: int = 0,
         breed_true=jnp.zeros(n, bool),
         divide_pending=jnp.zeros(n, bool),
         off_start=i32(n), off_len=i32(n),
+        off_tape=jnp.zeros((n, L), jnp.uint8),
         off_copied_size=i32(n), off_sex=jnp.zeros(n, bool),
         bc_mem=jnp.zeros(L, jnp.int8), bc_len=jnp.zeros((), jnp.int32),
         bc_merit=jnp.zeros((), jnp.float32), bc_valid=jnp.zeros((), bool),

@@ -600,7 +600,7 @@ def micro_step(params, st, key, exec_mask):
     )
 
 
-def extract_offspring(params, st, key):
+def extract_offspring(params, st, key, use_off_tape=False):
     """Materialize pending offspring genomes: off[n, q] = opcodes[n,
     off_start[n] + q] for q < off_len[n], with divide mutations applied
     (Divide_DoMutations, cHardwareBase.cc:296: point sub, single insertion,
@@ -608,6 +608,13 @@ def extract_offspring(params, st, key):
 
     Runs once per update in the birth engine -- the deferred half of
     h-divide.  Returns (off int8[N, L], off_len int32[N]).
+
+    `use_off_tape=True` (the birth flush on heads hardware) skips the
+    [N, L] barrel shift and reads the pre-extracted st.off_tape plane,
+    which ops/update.update_step guarantees is current at flush time
+    (written at the divide cycle by the Pallas kernel, or by one masked
+    end-of-update roll on the XLA path).  Direct callers (Test CPU,
+    unit tests) that drive micro_step themselves leave it False.
 
     TransSMT hardware divides off the host write buffer instead of a tape
     suffix (Divide_Main, cHardwareTransSMT.cc:438); the divide-mutation
@@ -618,6 +625,8 @@ def extract_offspring(params, st, key):
     off_len = st.off_len
     if params.hw_type in (1, 2):
         off = st.smt_aux[:, 0].astype(jnp.int8)
+    elif use_off_tape:
+        off = st.off_tape.astype(jnp.int8)
     else:
         ops = tape_ops(st.tape).astype(jnp.int8)
         off = barrel_shift_left(ops, st.off_start, L)

@@ -11,12 +11,50 @@ block of B organisms with every byte of their state resident in VMEM:
   per-cycle work          = VMEM-resident VPU ops only
 
 Layout: organisms live on the LANE dimension (128-wide) --
-  tape_t : uint8[L, N]   memory planes, position on sublanes
+  tape_t : uint8[L, N]   opcode planes, position on sublanes (6-bit opcodes
+                         ONLY; the executed/copied site flags live in packed
+                         int32 bitplanes inside ivec, 1 bit per site)
+  off_t  : uint8[L, N]   extracted-offspring planes (see below)
   ivec   : int32[NI, N]  every int32 per-organism scalar, one row each
   fvec   : f32[NF, N]    float phenotype scalars
 so per-organism scalars are [1, B] lane vectors (2 vregs at B=256) and the
 tape reductions reduce over sublanes, producing lane vectors directly --
 no orientation changes anywhere in the cycle body.
+
+Design notes (v2 -- the round-4 performance rewrite):
+
+* ONE merged tape traversal per cycle.  The only tape mutations are the
+  h-copy byte at the write head and the h-alloc zone zeroing; both are
+  DEFERRED one cycle (pending-write / pending-zero ivec rows) and applied
+  at the start of the next cycle's read traversal, collapsing the separate
+  read and write passes of v1 into a single load-apply-store-extract pass.
+  Deferral is semantically exact: within a cycle nothing reads the byte an
+  h-copy just wrote, and reads in later cycles see it applied.
+
+* Site flags as bitplanes.  cCPUMemory's per-site executed/copied flags are
+  int32 bitmasks ([L/32, B] rows in ivec) instead of tape bits 6/7.  Flag
+  set/clear is a handful of [LW, B] ops, and the divide-viability counts
+  (Divide_CheckViable, cHardwareBase.cc:140) are masked popcounts over the
+  bitplanes -- v1's gated whole-tape zone pass is gone.
+
+* Eager-5 label window.  The per-cycle traversal packs only the first 5
+  label positions (one int32 accumulator); the full MAX_LABEL_SIZE=10
+  window runs as a gated second pass only when some lane is actually
+  executing a label instruction whose first 5 window slots are all nops --
+  rare in practice (real labels are 1-3 nops).
+
+* In-kernel offspring extraction.  At h-divide, the offspring sequence
+  [read-head, write-head) is extracted into the off_t plane by a gated
+  per-lane barrel roll (log2(L) conditional sublane rotations), so the
+  birth flush never pays the [N, L] lane-axis shift that dominated it.
+  off_t is persistent state (PopulationState.off_tape): a parent whose
+  placement lost a conflict retries from it next update.
+
+* Per-block budget stop.  Each block's internal while_loop runs only to
+  the max granted budget of ITS organisms.  (Sorting organisms by budget
+  before blocking would cut the per-block max from ~1.55x to ~1.03x of
+  the mean, but permuting the packed state costs ~10 ms/update of
+  gather/transpose on this part and was reverted -- see run_cycles.)
 
 Semantics are the heads hardware exactly as ops/interpreter.micro_step
 implements it (same reference citations apply, cHardwareCPU.cc:908-1079);
@@ -50,7 +88,8 @@ from avida_tpu.models.heads import (
     HEAD_IP, HEAD_READ, HEAD_WRITE, HEAD_FLOW, MAX_LABEL_SIZE,
 )
 
-# ---- ivec row layout ----
+# ---- ivec row layout (fixed rows; the bitplane/dyn tail is L/R-dependent,
+# see _layout) ----
 IV_MEM_LEN = 0
 IV_ACTIVE_STACK = 1
 IV_READ_LABEL_LEN = 2
@@ -81,7 +120,12 @@ IV_INPUT_BUF = 32        # 3 rows
 IV_INPUTS = 35           # 3 rows, ro
 IV_READ_LABEL = 38       # 10 rows
 IV_STACKS = 48           # 20 rows (stack-major: stack*10 + depth)
-IV_DYN = 68              # task/reaction rows start here
+IV_PW_POS = 68           # deferred h-copy write: position (-1 = none)
+IV_PW_VAL = 69           # deferred h-copy write: opcode
+IV_PZ_START = 70         # deferred zero range [start, end) (alloc zone)
+IV_PZ_END = 71
+IV_EXEC_BM = 72          # LW rows: executed-site bitplane (LW = L/32)
+# COPIED_BM at IV_EXEC_BM + LW; task/reaction rows at IV_EXEC_BM + 2*LW
 
 FV_MERIT = 0
 FV_CUR_BONUS = 1
@@ -94,6 +138,14 @@ FLAG_MAL, FLAG_ALIVE, FLAG_DIVPEND, FLAG_STERILE = 1, 2, 4, 8
 
 DEFAULT_BLOCK = 256
 CHUNK = 64           # sublane rows per register-resident traversal chunk
+EAGER_LABEL = 5      # label slots packed in the per-cycle traversal
+
+# Debug/profiling knob: comma-separated feature names whose kernel code is
+# compiled OUT (semantics break!) to measure their cost by ablation, e.g.
+# TPU_KERNEL_ABLATE=search,extract python scripts/profile_update.py
+import os as _os
+_ABLATE = frozenset(
+    f for f in _os.environ.get("TPU_KERNEL_ABLATE", "").split(",") if f)
 
 
 def eligible(params) -> bool:
@@ -117,10 +169,15 @@ def eligible(params) -> bool:
     return all(r < 0 for r in params.proc_res_idx)
 
 
-def _ni(params) -> int:
+def _layout(params, L):
+    """(NI, LW, iv_copied_bm, iv_dyn) for a CHUNK-padded tape height L."""
+    LW = L // 32
+    iv_copied = IV_EXEC_BM + LW
+    iv_dyn = IV_EXEC_BM + 2 * LW
     R = params.num_reactions
-    ni = IV_DYN + 3 * R          # cur_task, cur_reaction, last_task
-    return (ni + 7) & ~7         # sublane-pad
+    ni = iv_dyn + 3 * R          # cur_task, cur_reaction, last_task
+    ni = (ni + 7) & ~7           # sublane-pad
+    return ni, LW, iv_copied, iv_dyn
 
 
 def _sel_table(op, table):
@@ -153,6 +210,34 @@ def _bitmask_lookup(op, bits):
     return jnp.where(op < 32, lo_v, jnp.uint32(0)) == 1
 
 
+def _multibit_lookup(op, table, nbits):
+    """table[op] (values < 2**nbits) for a [1,B] opcode vector via per-bit
+    packed masks and variable shifts: nbits x ~4 ops instead of a
+    len(table) x 2 select chain."""
+    opc = jnp.clip(op, 0, 31).astype(jnp.uint32)
+    oph = jnp.clip(op - 32, 0, 31).astype(jnp.uint32)
+    two_words = len(table) > 32
+    out = jnp.zeros_like(op)
+    for b in range(nbits):
+        lo = 0
+        hi = 0
+        for k, v in enumerate(table):
+            if (int(v) >> b) & 1:
+                if k < 32:
+                    lo |= 1 << k
+                else:
+                    hi |= 1 << (k - 32)
+        if not (lo or hi):
+            continue
+        bit = (jnp.uint32(lo) >> opc) & 1
+        if two_words:
+            # hi == 0 must still force the bit to 0 for op >= 32 (the lo
+            # lookup above clipped op to 31 and would leak inst 31's bit)
+            bit = jnp.where(op < 32, bit, (jnp.uint32(hi) >> oph) & 1)
+        out = out | (bit << b).astype(jnp.int32)
+    return out
+
+
 def _popcount32(x):
     # unsigned SWAR popcount (int32 inputs may carry bit 31; arithmetic
     # shifts would smear it, so everything runs in uint32)
@@ -161,6 +246,35 @@ def _popcount32(x):
     x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
     x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
     return ((x * jnp.uint32(0x01010101)) >> 24).astype(jnp.int32)
+
+
+def _word_range_mask(lw_rows, lo, hi):
+    """int32[LW, B] bitmask selecting bit positions [lo, hi) of the
+    L-bit-long per-lane bitplane (lo/hi are [1, B] site indices)."""
+    base = lw_rows * 32
+    lo_w = jnp.clip(lo - base, 0, 32)
+    hi_w = jnp.clip(hi - base, 0, 32)
+    full = jnp.int32(-1)
+    m_lo = jnp.where(lo_w >= 32, 0,
+                     full << jnp.minimum(lo_w, 31).astype(jnp.uint32))
+    m_hi = jnp.where(hi_w >= 32, 0,
+                     full << jnp.minimum(hi_w, 31).astype(jnp.uint32))
+    return m_lo & ~m_hi
+
+
+def _set_bit(bm, lw_rows, pos, cond):
+    """Set bit `pos` ([1,B]) in the [LW,B] bitplane where cond ([1,B])."""
+    bit = (jnp.int32(1) << (pos & 31).astype(jnp.uint32))
+    hit = (lw_rows == (pos >> 5)) & cond
+    return bm | jnp.where(hit, bit, 0)
+
+
+def _read_bit(bm, lw_rows, pos):
+    """Bit `pos` ([1,B]) of the [LW,B] bitplane -> bool[1,B]."""
+    word = jnp.sum(jnp.where(lw_rows == (pos >> 5), bm, 0),
+                   axis=0, keepdims=True)
+    return ((word.astype(jnp.uint32) >> (pos & 31).astype(jnp.uint32))
+            & 1) != 0
 
 
 def _logic_id(i0, i1, i2, n_in, output):
@@ -214,7 +328,7 @@ def _make_kernel(params, L, B, num_steps):
     max_memory so padding never changes physics."""
     L0 = params.max_memory
     R = params.num_reactions
-    NI = _ni(params)
+    NI, LW, IV_COPIED_BM, IV_DYN = _layout(params, L)
     num_insts = params.num_insts
     sem_tab = params.sem
     mod_tab = params.mod_kind
@@ -225,6 +339,9 @@ def _make_kernel(params, L, B, num_steps):
     # turning every nop lookup into a single compare
     nops_prefix = (all(bool(nop_tab[k]) == (k < 3) for k in range(num_insts))
                    and tuple(int(x) for x in nmod_tab[:3]) == (0, 1, 2))
+    # packed-metadata lookup: meta = sem | mod_kind<<5 | default_op<<7
+    meta_tab = tuple((int(sem_tab[k]) | (int(mod_tab[k]) << 5)
+                      | (int(def_tab[k]) << 7)) for k in range(num_insts))
     fdt = jnp.float32
 
     def adjust(pos, mlen):
@@ -235,11 +352,12 @@ def _make_kernel(params, L, B, num_steps):
         # cheap adjust for pos guaranteed in [0, 2*mlen)
         return jnp.where(pos >= mlen, pos - mlen, pos)
 
-    def kernel(seed_ref, tape_in, ivec_in, fvec_in,
-               tape_ref, ivec_ref, fvec_ref):
+    def kernel(seed_ref, tape_in, off_in, ivec_in, fvec_in,
+               tape_ref, off_ref, ivec_ref, fvec_ref):
         # work entirely on the (aliased) output blocks: copy once, mutate
         # in VMEM across all cycles, write-back handled by the pipeline
         tape_ref[...] = tape_in[...]
+        off_ref[...] = off_in[...]
         ivec_ref[...] = ivec_in[...]
         fvec_ref[...] = fvec_in[...]
         if params.copy_mut_prob > 0:
@@ -251,6 +369,11 @@ def _make_kernel(params, L, B, num_steps):
         reg_rows = jax.lax.broadcasted_iota(jnp.int32, (3, B), 0)
         head_rows = jax.lax.broadcasted_iota(jnp.int32, (4, B), 0)
         stk_rows = jax.lax.broadcasted_iota(jnp.int32, (20, B), 0)
+        lw_rows = jax.lax.broadcasted_iota(jnp.int32, (LW, B), 0)
+
+        def apply_pending(tc, rows_c, pw_pos, pw_val, pz_s, pz_e):
+            tc = jnp.where(rows_c == pw_pos, pw_val, tc)
+            return jnp.where((rows_c >= pz_s) & (rows_c < pz_e), 0, tc)
 
         def cycle_body(s, _):
             mlen = jnp.maximum(ivec_ref[IV_MEM_LEN, :][None, :], 1)
@@ -271,48 +394,45 @@ def _make_kernel(params, L, B, num_steps):
             child_end = jnp.where(wp == 0, mlen, wp)
             child_size = child_end - parent_size
 
-            # ---- packed read traversal, CHUNKED over the position axis ----
-            # Whole-[L,B] intermediates spill every op to VMEM (the vector
-            # register file only holds a few [CH,B] tiles); accumulating over
-            # CH-row chunks keeps each chunk's op chain register-resident and
-            # makes the traversal compute-bound instead of VMEM-bound.
+            pw_pos = ivec_ref[IV_PW_POS, :][None, :]
+            pw_val = ivec_ref[IV_PW_VAL, :][None, :]
+            pz_s = ivec_ref[IV_PZ_START, :][None, :]
+            pz_e = ivec_ref[IV_PZ_END, :][None, :]
+
+            # ---- THE merged traversal: apply last cycle's deferred tape
+            # writes, store, and extract every per-cycle read, CHUNKED over
+            # the position axis so each chunk's op chain stays
+            # register-resident ----
             r1 = jnp.zeros((1, B), jnp.int32)
-            lab_lo = jnp.zeros((1, B), jnp.int32)
-            lab_hi = jnp.zeros((1, B), jnp.int32)
+            lab5 = jnp.zeros((1, B), jnp.int32)
             for c in range(L // CHUNK):
                 tc = tape_ref[pl.ds(c * CHUNK, CHUNK), :].astype(jnp.int32)
                 rows_c = (jax.lax.broadcasted_iota(jnp.int32, (CHUNK, B), 0)
                           + c * CHUNK)
+                tc = apply_pending(tc, rows_c, pw_pos, pw_val, pz_s, pz_e)
+                tape_ref[pl.ds(c * CHUNK, CHUNK), :] = tc.astype(jnp.uint8)
                 d = rows_c - ip
                 w1 = ((d == 0).astype(jnp.int32)
-                      + ((d == 1).astype(jnp.int32) << 8)
                       + ((rows_c == rp).astype(jnp.int32) << 16))
                 r1 = r1 + jnp.sum(tc * w1, axis=0, keepdims=True)
-                # label window: positions (ip+1+k) mod mlen, k in [0,10)
+                # eager label window: positions (ip+1+k) mod mlen,
+                # k in [0, EAGER_LABEL); slot 0 doubles as the operand
+                # byte (ip+1 incl. the wrap to position 0)
                 rel = d - 1 + jnp.where(d < 1, mlen, 0)
-                sh = jnp.minimum(jnp.where(rel < 5, rel, rel - 5) * 6, 30)
-                inw = rows_c < mlen
-                sv = (tc & 63) << sh
-                lab_lo = lab_lo + jnp.sum(
-                    jnp.where(inw & (rel < 5), sv, 0), axis=0, keepdims=True)
-                lab_hi = lab_hi + jnp.sum(
-                    jnp.where(inw & (rel >= 5) & (rel < MAX_LABEL_SIZE), sv, 0),
-                    axis=0, keepdims=True)
+                sh = jnp.minimum(rel, EAGER_LABEL).astype(jnp.uint32) * 6
+                inw = (rows_c < mlen) & (rel < EAGER_LABEL)
+                lab5 = lab5 + jnp.sum(
+                    jnp.where(inw, tc << sh, 0), axis=0, keepdims=True)
 
             s_ip = r1 & 255
-            s_ip1 = (r1 >> 8) & 255
+            s_ip1 = lab5 & 63
             s_rp = (r1 >> 16) & 63
 
             cur_op = jnp.clip(s_ip & 63, 0, num_insts - 1)
-            ip_exec_already = ((s_ip >> 6) & 1) != 0
-            # one packed-metadata select chain replaces three table chains:
-            # meta = sem | mod_kind<<5 | default_op<<7
-            meta = jnp.zeros_like(cur_op)
-            for kk in range(num_insts):
-                mk = (int(sem_tab[kk]) | (int(mod_tab[kk]) << 5)
-                      | (int(def_tab[kk]) << 7))
-                if mk:
-                    meta = jnp.where(cur_op == kk, jnp.int32(mk), meta)
+            ebm = ivec_ref[pl.ds(IV_EXEC_BM, LW), :]          # [LW, B]
+            cbm = ivec_ref[pl.ds(IV_COPIED_BM, LW), :]        # [LW, B]
+            ip_exec_already = _read_bit(ebm, lw_rows, ip)
+            meta = _multibit_lookup(cur_op, meta_tab, 9)
             sem = jnp.where(exec_mask, meta & 31, -1)
             mod_kind = jnp.where(exec_mask, (meta >> 5) & 3, MOD_NONE)
             default_operand = (meta >> 7) & 3
@@ -320,33 +440,9 @@ def _make_kernel(params, L, B, num_steps):
             def is_op(x):
                 return sem == x
 
-            # ---- divide-viability zone counts: a second chunked pass, run
-            # only on cycles where some lane actually executes h-divide ----
-            div_try = is_op(SEM_H_DIVIDE)
-
-            def zone_pass(_):
-                r2 = jnp.zeros((1, B), jnp.int32)
-                for c in range(L // CHUNK):
-                    tc = tape_ref[pl.ds(c * CHUNK, CHUNK), :].astype(jnp.int32)
-                    rows_c = (jax.lax.broadcasted_iota(
-                        jnp.int32, (CHUNK, B), 0) + c * CHUNK)
-                    in_p = rows_c < parent_size
-                    cz = (rows_c >= parent_size) & (rows_c < child_end)
-                    r2 = r2 + jnp.sum(
-                        jnp.where(in_p, (tc >> 6) & 1, 0)
-                        + (jnp.where(cz, tc >> 7, 0) << 16),
-                        axis=0, keepdims=True)
-                return r2
-
-            r2 = jax.lax.cond(jnp.any(div_try), zone_pass,
-                              lambda _: jnp.zeros((1, B), jnp.int32), None)
-            exec_count0 = r2 & 0xFFFF
-            copied_count = r2 >> 16
-
-            # ---- operand resolution ----
-            op0 = tape_ref[0, :][None, :].astype(jnp.int32) & 63
-            next_op = jnp.where(ip == mlen - 1, op0, s_ip1 & 63)
-            next_op = jnp.clip(next_op, 0, num_insts - 1)
+            # ---- operand resolution (s_ip1 = label slot 0 = the byte at
+            # (ip+1) mod mlen, wrap included) ----
+            next_op = jnp.clip(s_ip1, 0, num_insts - 1)
             if nops_prefix:
                 next_is_nop = next_op < 3
                 nmod_next = next_op
@@ -359,24 +455,56 @@ def _make_kernel(params, L, B, num_steps):
             consumed = has_mod.astype(jnp.int32)
             next_pos = adjust1(ip + 1, mlen)
 
-            # ---- label decode ----
+            # ---- label decode: eager 5 slots; the full 10-slot window is
+            # a gated second pass that only fires when some lane executes a
+            # label op whose first 5 window slots are ALL nops ----
             has_label = mod_kind == MOD_LABEL
-            lab_ops_l = [jnp.clip((lab_lo >> (6 * k)) & 63, 0, num_insts - 1)
-                         for k in range(5)]
-            lab_ops_l += [jnp.clip((lab_hi >> (6 * k)) & 63, 0, num_insts - 1)
-                          for k in range(5)]
+            lab_ops = [jnp.clip((lab5 >> (6 * k)) & 63, 0, num_insts - 1)
+                       for k in range(EAGER_LABEL)]
+
+            def slot_nop(v):
+                if nops_prefix:
+                    return v < 3, v
+                return _bitmask_lookup(v, nop_tab), _sel_table(v, nmod_tab)
+
             run = jnp.ones_like(cur_op)
             label_len = jnp.zeros_like(cur_op)
             lab_vals = []
-            for k in range(MAX_LABEL_SIZE):
-                if nops_prefix:
-                    isn = lab_ops_l[k] < 3
-                    nv = lab_ops_l[k]   # identity for real nops; values at
-                    # non-nop positions are only ever used under k<label_len
-                else:
-                    isn = _bitmask_lookup(lab_ops_l[k], nop_tab)
-                    nv = _sel_table(lab_ops_l[k], nmod_tab)
+            for k in range(EAGER_LABEL):
+                isn, nv = slot_nop(lab_ops[k])
                 in_range = (k + 1) <= (mlen - 1)
+                run = run * (isn & in_range).astype(jnp.int32)
+                label_len = label_len + run
+                lab_vals.append(nv)
+
+            need_ext = has_label & (label_len >= EAGER_LABEL)
+
+            def ext_pass(_):
+                hi = jnp.zeros((1, B), jnp.int32)
+                for c in range(L // CHUNK):
+                    tc = tape_ref[pl.ds(c * CHUNK, CHUNK), :].astype(jnp.int32)
+                    rows_c = (jax.lax.broadcasted_iota(
+                        jnp.int32, (CHUNK, B), 0) + c * CHUNK)
+                    d = rows_c - ip
+                    rel = d - 1 + jnp.where(d < 1, mlen, 0)
+                    rel2 = rel - EAGER_LABEL
+                    sh = jnp.clip(rel2, 0,
+                                  MAX_LABEL_SIZE - EAGER_LABEL
+                                  ).astype(jnp.uint32) * 6
+                    inw = ((rows_c < mlen) & (rel2 >= 0)
+                           & (rel2 < MAX_LABEL_SIZE - EAGER_LABEL))
+                    hi = hi + jnp.sum(jnp.where(inw, tc << sh, 0),
+                                      axis=0, keepdims=True)
+                return hi
+
+            lab_hi = jax.lax.cond(
+                jnp.any(need_ext) if "labelext" not in _ABLATE else False,
+                ext_pass,
+                lambda _: jnp.zeros((1, B), jnp.int32), None)
+            for k in range(MAX_LABEL_SIZE - EAGER_LABEL):
+                v = jnp.clip((lab_hi >> (6 * k)) & 63, 0, num_insts - 1)
+                isn, nv = slot_nop(v)
+                in_range = (EAGER_LABEL + k + 1) <= (mlen - 1)
                 run = run * (isn & in_range).astype(jnp.int32)
                 label_len = label_len + run
                 lab_vals.append(nv)
@@ -435,22 +563,65 @@ def _make_kernel(params, L, B, num_steps):
             active_stack = jnp.where(is_op(SEM_SWAP_STK), 1 - a_stk, a_stk)
 
             # ---- h-search (gated on any lane searching) ----
+            # Fast matcher for labels of length <= EAGER_LABEL (covers all
+            # real genomes; nop complement values are 0..2, so a 5-slot
+            # label packs into 10 bits base-4 with 3 as the "non-nop"
+            # sentinel).  Chunked over the position axis so every
+            # intermediate stays register-resident -- the v1 whole-plane
+            # matcher was ~35% of total kernel time at bench scale.
             srch = is_op(SEM_H_SEARCH)
 
-            def search_block(_):
-                clipped = jnp.clip(tape_ref[...].astype(jnp.int32) & 63,
+            def search_fast(_):
+                # packed complement label, 2 bits per slot
+                c2 = jnp.zeros((1, B), jnp.int32)
+                for k in range(EAGER_LABEL):
+                    c2 = c2 | (jnp.clip(lbl_c[k], 0, 3) << (2 * k))
+                m2 = (jnp.int32(1) << (2 * jnp.minimum(
+                    label_len, EAGER_LABEL)).astype(jnp.uint32)) - 1
+                c2 = c2 & m2
+                ok_lane = (label_len > 0) & (label_len <= EAGER_LABEL)
+                best = jnp.full((1, B), L, jnp.int32)
+                W = EAGER_LABEL - 1
+                for c in range(L // CHUNK):
+                    hi = min(CHUNK + W, L - c * CHUNK)
+                    tc = tape_ref[pl.ds(c * CHUNK, hi), :].astype(jnp.int32)
+                    if hi < CHUNK + W:
+                        tc = jnp.concatenate(
+                            [tc, jnp.full((CHUNK + W - hi, B), 3, jnp.int32)],
+                            axis=0)
+                    if nops_prefix:
+                        nv2 = jnp.where(tc < 3, tc, 3)
+                    else:
+                        nv2 = jnp.full_like(tc, 3)
+                        for k in range(num_insts):
+                            if nop_tab[k]:
+                                nv2 = jnp.where(
+                                    tc == k, jnp.int32(int(nmod_tab[k])), nv2)
+                    w2 = jnp.zeros((CHUNK, B), jnp.int32)
+                    for k in range(EAGER_LABEL):
+                        w2 = w2 | (nv2[k:k + CHUNK, :] << (2 * k))
+                    rows_c = (jax.lax.broadcasted_iota(
+                        jnp.int32, (CHUNK, B), 0) + c * CHUNK)
+                    hit = ((w2 & m2) == c2) & ok_lane \
+                        & ((rows_c + label_len) <= mlen)
+                    best = jnp.minimum(
+                        best, jnp.min(jnp.where(hit, rows_c, L), axis=0,
+                                      keepdims=True))
+                return best
+
+            def search_slow(_):
+                # general matcher (labels longer than EAGER_LABEL): the
+                # whole-plane version; fires only for 6+-nop labels
+                clipped = jnp.clip(tape_ref[...].astype(jnp.int32),
                                    0, num_insts - 1)
-                isnop_p = jnp.zeros_like(clipped, dtype=jnp.bool_)
                 nopval_p = jnp.full_like(clipped, -1)
                 for k in range(num_insts):
                     if nop_tab[k]:
                         hit = clipped == k
-                        isnop_p = isnop_p | hit
                         nopval_p = jnp.where(hit, jnp.int32(int(nmod_tab[k])),
                                              nopval_p)
                 match = jnp.ones((L, B), jnp.bool_)
                 for k in range(MAX_LABEL_SIZE):
-                    # nopval at position row+k (static shift down)
                     if k == 0:
                         shifted = nopval_p
                     else:
@@ -463,9 +634,15 @@ def _make_kernel(params, L, B, num_steps):
                 q = jnp.min(jnp.where(match, rows, L), axis=0, keepdims=True)
                 return q
 
-            q_found = jax.lax.cond(
-                jnp.any(srch), search_block,
-                lambda _: jnp.full((1, B), L, jnp.int32), None)
+            if "search" in _ABLATE:
+                q_found = jnp.full((1, B), L, jnp.int32)
+            else:
+                q_found = jax.lax.cond(
+                    jnp.any(srch & (label_len <= EAGER_LABEL)), search_fast,
+                    lambda _: jnp.full((1, B), L, jnp.int32), None)
+                q_found = jax.lax.cond(
+                    jnp.any(srch & (label_len > EAGER_LABEL)), search_slow,
+                    lambda _: q_found, None)
             found = q_found < L
             ip_after_label = adjust1(ip + label_len, mlen)
             search_head = jnp.where(found, q_found + label_len - 1,
@@ -539,6 +716,22 @@ def _make_kernel(params, L, B, num_steps):
                                   ).astype(jnp.int32))
             max_sz = jnp.minimum(L0, (fsize * params.offspring_size_range
                                      ).astype(jnp.int32))
+
+            # divide-viability zone counts: masked popcounts over the site
+            # bitplanes, run only on cycles where some lane tries h-divide
+            def div_counts(_):
+                below_p = _word_range_mask(lw_rows, jnp.zeros_like(ip),
+                                           parent_size)
+                child_z = _word_range_mask(lw_rows, parent_size, child_end)
+                e = jnp.sum(_popcount32(ebm & below_p), axis=0, keepdims=True)
+                cc = jnp.sum(_popcount32(cbm & child_z), axis=0, keepdims=True)
+                return e, cc
+
+            exec_count0, copied_count = jax.lax.cond(
+                jnp.any(div_try) if "divcounts" not in _ABLATE else False,
+                div_counts,
+                lambda _: (jnp.zeros((1, B), jnp.int32),
+                           jnp.zeros((1, B), jnp.int32)), None)
             exec_count = exec_count0 + jnp.where(
                 div_try & ~ip_exec_already & (ip < parent_size), 1, 0)
             sterile_f = (flags & FLAG_STERILE) != 0
@@ -554,6 +747,27 @@ def _make_kernel(params, L, B, num_steps):
             off_start = jnp.where(div_m, rp, ivec_ref[IV_OFF_START, :][None, :])
             off_len = jnp.where(div_m, child_size,
                                 ivec_ref[IV_OFF_LEN, :][None, :])
+
+            # ---- offspring extraction into the off plane (gated): a
+            # per-lane barrel roll of the opcode tape by the read-head
+            # offset, masked to the child region ----
+            def extract(_):
+                acc = tape_ref[...]
+                r = rp
+                k = 1
+                while k < L:
+                    rolled = jnp.concatenate([acc[k:, :], acc[:k, :]], axis=0)
+                    bit = (r & k) != 0
+                    acc = jnp.where(bit, rolled, acc)
+                    k <<= 1
+                keep = div_m & (rows < off_len)
+                return jnp.where(keep, acc,
+                                 jnp.where(div_m, jnp.uint8(0), off_ref[...]))
+
+            if "extract" not in _ABLATE:
+                off_new = jax.lax.cond(jnp.any(div_m), extract,
+                                       lambda _: off_ref[...], None)
+                off_ref[...] = off_new
 
             # ---- IO + tasks (per-organism, infinite resources) ----
             io_m = is_op(SEM_IO)
@@ -623,7 +837,9 @@ def _make_kernel(params, L, B, num_steps):
 
             # IO is absent from whole blocks for long stretches (the stock
             # ancestor performs none); gate the ~400-op task pipeline on it
-            outs = jax.lax.cond(jnp.any(io_m), tasks_block, no_tasks, None)
+            outs = jax.lax.cond(
+                jnp.any(io_m) if "tasks" not in _ABLATE else False,
+                tasks_block, no_tasks, None)
             new_bonus = outs[0]
             performed_l = list(outs[1:1 + R])
             rewarded_l = list(outs[1 + R:1 + 2 * R])
@@ -715,6 +931,28 @@ def _make_kernel(params, L, B, num_steps):
             read_label_len = jnp.where(div_m, 0, read_label_len)
             new_mal = new_mal & ~div_m
 
+            # ---- site-flag bitplane updates (replaces v1's tape bits 6/7)
+            # exec flag at ip; at the first operand nop when one is consumed
+            lab0_exec = has_label & (label_len > 0)
+            nop_exec = has_mod | lab0_exec
+            ebm = _set_bit(ebm, lw_rows, ip, exec_mask)
+            ebm = _set_bit(ebm, lw_rows, next_pos, nop_exec)
+            cbm = _set_bit(cbm, lw_rows, wp, copy_m)
+            # h-alloc clears site flags across the fresh zone
+            zone = _word_range_mask(lw_rows, old_len, new_len_alloc)
+            clear_z = jnp.where(alloc_m, zone, 0)
+            ebm = ebm & ~clear_z
+            cbm = cbm & ~clear_z
+            # divide clears every site flag (v1: tape &= 63)
+            ebm = jnp.where(div_m, 0, ebm)
+            cbm = jnp.where(div_m, 0, cbm)
+
+            # ---- deferred tape writes for the NEXT cycle's traversal ----
+            new_pw_pos = jnp.where(copy_m, wp, -1)
+            new_pw_val = jnp.where(do_mut, rand_inst, read_inst)
+            new_pz_s = jnp.where(alloc_m, old_len, 0)
+            new_pz_e = jnp.where(alloc_m, new_len_alloc, 0)
+
             # ---- phenotype DivideReset ----
             copied_sz = ivec_ref[IV_COPIED_SIZE, :][None, :]
             m = params.base_merit_method
@@ -772,25 +1010,6 @@ def _make_kernel(params, L, B, num_steps):
                 exec_mask.astype(jnp.int32)
             divide_pending = divide_pending | div_m
 
-            # ---- the single tape write pass (chunked, register-resident) ----
-            lab0_exec = has_label & (label_len > 0)
-            nop_exec = has_mod | lab0_exec
-            exec_at_ip = exec_mask
-            wr_copy = copy_m
-            base_w = written | 128
-            for c in range(L // CHUNK):
-                tc = tape_ref[pl.ds(c * CHUNK, CHUNK), :].astype(jnp.int32)
-                rows_c = (jax.lax.broadcasted_iota(jnp.int32, (CHUNK, B), 0)
-                          + c * CHUNK)
-                exec_set = (((rows_c == ip) & exec_at_ip)
-                            | ((rows_c == next_pos) & nop_exec))
-                t = tc | jnp.where(exec_set, 64, 0)
-                t = jnp.where(alloc_m & (rows_c >= old_len)
-                              & (rows_c < new_len_alloc), 0, t)
-                t = jnp.where((rows_c == wp) & wr_copy, base_w | (t & 64), t)
-                t = jnp.where(div_m, t & 63, t)
-                tape_ref[pl.ds(c * CHUNK, CHUNK), :] = t.astype(jnp.uint8)
-
             # ---- write back scalars ----
             ivec_ref[IV_MEM_LEN, :] = mem_len[0]
             ivec_ref[IV_ACTIVE_STACK, :] = active_stack[0]
@@ -824,6 +1043,12 @@ def _make_kernel(params, L, B, num_steps):
             ivec_ref[IV_INPUT_BUF + 2, :] = ibuf2[0]
             ivec_ref[pl.ds(IV_READ_LABEL, MAX_LABEL_SIZE), :] = read_label
             ivec_ref[pl.ds(IV_STACKS, 20), :] = stacks
+            ivec_ref[IV_PW_POS, :] = new_pw_pos[0]
+            ivec_ref[IV_PW_VAL, :] = new_pw_val[0]
+            ivec_ref[IV_PZ_START, :] = new_pz_s[0]
+            ivec_ref[IV_PZ_END, :] = new_pz_e[0]
+            ivec_ref[pl.ds(IV_EXEC_BM, LW), :] = ebm
+            ivec_ref[pl.ds(IV_COPIED_BM, LW), :] = cbm
             # task/reaction counters change only on IO or divide cycles
             @pl.when(jnp.any(io_m) | jnp.any(div_m))
             def _update_task_counts():
@@ -859,6 +1084,22 @@ def _make_kernel(params, L, B, num_steps):
 
         jax.lax.while_loop(cond, body, (jnp.int32(0), 0))
 
+        # apply the last cycle's deferred tape writes so the output tape is
+        # fully materialized
+        pw_pos = ivec_ref[IV_PW_POS, :][None, :]
+        pw_val = ivec_ref[IV_PW_VAL, :][None, :]
+        pz_s = ivec_ref[IV_PZ_START, :][None, :]
+        pz_e = ivec_ref[IV_PZ_END, :][None, :]
+        for c in range(L // CHUNK):
+            tc = tape_ref[pl.ds(c * CHUNK, CHUNK), :].astype(jnp.int32)
+            rows_c = (jax.lax.broadcasted_iota(jnp.int32, (CHUNK, B), 0)
+                      + c * CHUNK)
+            tc = apply_pending(tc, rows_c, pw_pos, pw_val, pz_s, pz_e)
+            tape_ref[pl.ds(c * CHUNK, CHUNK), :] = tc.astype(jnp.uint8)
+        ivec_ref[IV_PW_POS, :] = jnp.full((B,), -1, jnp.int32)
+        ivec_ref[IV_PZ_START, :] = jnp.zeros((B,), jnp.int32)
+        ivec_ref[IV_PZ_END, :] = jnp.zeros((B,), jnp.int32)
+
     return kernel, NI
 
 
@@ -871,18 +1112,54 @@ def _dims(params, n, L0):
     return B, n_pad, L
 
 
+def _flag_to_words(tape, bit, L):
+    """Site flag `bit` (6 or 7) of uint8[N, L] -> int32[N, L//32] packed
+    words (bit j of word w = flag of site 32w+j).
+
+    SWAR, not a 32-wide reduce: bitcast 4 bytes to one u32, gather the 4
+    flag bits into a nibble with a multiply (positions 24..27 of
+    v * 0x01020408 collect bytes 0..3 in order, carry-free), then combine
+    8 nibbles per word."""
+    n = tape.shape[0]
+    x = jax.lax.bitcast_convert_type(tape.reshape(n, L // 4, 4),
+                                     jnp.uint32).reshape(n, L // 4)
+    b4 = (x >> bit) & jnp.uint32(0x01010101)
+    nib = ((b4 * jnp.uint32(0x01020408)) >> 24) & 0xF       # [n, L/4]
+    nib = nib.astype(jnp.int32).reshape(n, L // 32, 8)
+    return (nib << (jnp.arange(8, dtype=jnp.int32) * 4)[None, None, :]).sum(
+        axis=2)
+
+
+def _words_to_flag(words, bit, L):
+    """int32[N, L//32] packed words -> uint8[N, L] with the flag at `bit`
+    (inverse of _flag_to_words; SWAR spread 0x00204081)."""
+    n = words.shape[0]
+    nib = ((words[:, :, None] >> (jnp.arange(8, dtype=jnp.int32) * 4)
+            [None, None, :]) & 0xF).astype(jnp.uint32).reshape(n, L // 4)
+    b4 = (nib * jnp.uint32(0x00204081)) & jnp.uint32(0x01010101)
+    by = jax.lax.bitcast_convert_type(b4 << bit, jnp.uint8)  # [n, L/4, 4]
+    return by.reshape(n, L)
+
+
 def pack_state(params, st, granted):
-    """PopulationState -> (tape_t, ivec, fvec) kernel layout (traced)."""
+    """PopulationState -> (tape_t, off_t, ivec, fvec) kernel layout
+    (traced)."""
     n, L0 = st.tape.shape
     R = params.num_reactions
-    NI = _ni(params)
     B, n_pad, L = _dims(params, n, L0)
+    NI, LW, IV_COPIED_BM, IV_DYN = _layout(params, L)
 
     def padn(x):
         return jnp.pad(x, ((0, n_pad - n),) + ((0, 0),) * (x.ndim - 1))
 
-    # ---- pack ----
-    tape_t = jnp.pad(padn(st.tape), ((0, 0), (0, L - L0))).T   # [L, n_pad]
+    # ---- tape: opcode plane + site-flag bitplanes ----
+    tape_p = jnp.pad(st.tape, ((0, 0), (0, L - L0)))
+    opc_t = padn(tape_p & jnp.uint8(63)).T                     # [L, n_pad]
+    exec_w = _flag_to_words(tape_p, 6, L)                      # [n, LW]
+    cop_w = _flag_to_words(tape_p, 7, L)
+    off_p = jnp.pad(st.off_tape, ((0, 0), (0, L - L0)))
+    off_t = padn(off_p).T                                      # [L, n_pad]
+
     iv = [None] * NI
 
     def setrow(i, x):
@@ -928,6 +1205,13 @@ def pack_state(params, st, granted):
     for s_ in range(2):
         for d in range(10):
             setrow(IV_STACKS + s_ * 10 + d, st.stacks[:, s_, d])
+    iv[IV_PW_POS] = jnp.full(n_pad, -1, jnp.int32)
+    iv[IV_PW_VAL] = jnp.zeros(n_pad, jnp.int32)
+    iv[IV_PZ_START] = jnp.zeros(n_pad, jnp.int32)
+    iv[IV_PZ_END] = jnp.zeros(n_pad, jnp.int32)
+    for w in range(LW):
+        iv[IV_EXEC_BM + w] = padn(exec_w[:, w])
+        iv[IV_COPIED_BM + w] = padn(cop_w[:, w])
     for r in range(R):
         setrow(IV_DYN + r, st.cur_task_count[:, r])
         setrow(IV_DYN + R + r, st.cur_reaction_count[:, r])
@@ -938,20 +1222,24 @@ def pack_state(params, st, granted):
     ivec = jnp.stack(iv, axis=0)                               # [NI, n_pad]
 
     fv = [jnp.zeros(n_pad, jnp.float32)] * NF
-    fv[FV_MERIT] = padn(st.merit.astype(jnp.float32))
-    fv[FV_CUR_BONUS] = padn(st.cur_bonus.astype(jnp.float32))
-    fv[FV_FITNESS] = padn(st.fitness.astype(jnp.float32))
-    fv[FV_LAST_BONUS] = padn(st.last_bonus.astype(jnp.float32))
-    fv[FV_LAST_MERIT_BASE] = padn(st.last_merit_base.astype(jnp.float32))
+
+    def fpad(x):
+        return padn(x.astype(jnp.float32))
+
+    fv[FV_MERIT] = fpad(st.merit)
+    fv[FV_CUR_BONUS] = fpad(st.cur_bonus)
+    fv[FV_FITNESS] = fpad(st.fitness)
+    fv[FV_LAST_BONUS] = fpad(st.last_bonus)
+    fv[FV_LAST_MERIT_BASE] = fpad(st.last_merit_base)
     fvec = jnp.stack(fv, axis=0)
-    return tape_t, ivec, fvec
+    return opc_t, off_t, ivec, fvec
 
 
 def run_packed(params, packed, key, num_steps):
-    """One kernel launch over the packed state triple (traced)."""
-    tape_t, ivec, fvec = packed
+    """One kernel launch over the packed state quad (traced)."""
+    tape_t, off_t, ivec, fvec = packed
     L, n_pad = tape_t.shape
-    NI = _ni(params)
+    NI, LW, _, _ = _layout(params, L)
     B = min(DEFAULT_BLOCK, n_pad)
 
     seed = jax.random.randint(key, (1,), 0, 2**31 - 1, dtype=jnp.int32)
@@ -965,42 +1253,54 @@ def run_packed(params, packed, key, num_steps):
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((L, B), lambda i: (0, i)),
+            pl.BlockSpec((L, B), lambda i: (0, i)),
             pl.BlockSpec((NI, B), lambda i: (0, i)),
             pl.BlockSpec((NF, B), lambda i: (0, i)),
         ],
         out_specs=[
+            pl.BlockSpec((L, B), lambda i: (0, i)),
             pl.BlockSpec((L, B), lambda i: (0, i)),
             pl.BlockSpec((NI, B), lambda i: (0, i)),
             pl.BlockSpec((NF, B), lambda i: (0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((L, n_pad), jnp.uint8),
+            jax.ShapeDtypeStruct((L, n_pad), jnp.uint8),
             jax.ShapeDtypeStruct((NI, n_pad), jnp.int32),
             jax.ShapeDtypeStruct((NF, n_pad), jnp.float32),
         ],
-        input_output_aliases={1: 0, 2: 1, 3: 2},
+        input_output_aliases={1: 0, 2: 1, 3: 2, 4: 3},
         interpret=interpret,
-    )(seed, tape_t, ivec, fvec)
+    )(seed, tape_t, off_t, ivec, fvec)
     return tuple(out)
 
 
 def unpack_state(params, st, packed):
     """Kernel layout -> PopulationState, preserving untouched fields of
     `st` (genome, breed_true, resources...) (traced)."""
-    tape_o, ivec_o, fvec_o = packed
+    tape_o, off_o, ivec_o, fvec_o = packed
     n, L0 = st.tape.shape
     R = params.num_reactions
+    L = tape_o.shape[0]
+    NI, LW, IV_COPIED_BM, IV_DYN = _layout(params, L)
 
-    # ---- unpack ----
     def row(i):
         return ivec_o[i, :n]
 
     def frow(i):
         return fvec_o[i, :n]
 
+    # rebuild the flag-bit tape from the opcode plane + bitplanes
+    opc = tape_o.T[:n]                                         # [n, L]
+    exec_w = jnp.stack([row(IV_EXEC_BM + w) for w in range(LW)], axis=1)
+    cop_w = jnp.stack([row(IV_COPIED_BM + w) for w in range(LW)], axis=1)
+    tape = (opc | _words_to_flag(exec_w, 6, L)
+            | _words_to_flag(cop_w, 7, L))[:, :L0]
+
     flags = row(IV_FLAGS)
     return st.replace(
-        tape=tape_o.T[:n, :L0],
+        tape=tape,
+        off_tape=off_o.T[:n, :L0],
         mem_len=row(IV_MEM_LEN),
         regs=jnp.stack([row(IV_REGS + k) for k in range(3)], axis=1),
         heads=jnp.stack([row(IV_HEADS + k) for k in range(4)], axis=1),
@@ -1043,7 +1343,14 @@ def unpack_state(params, st, packed):
 def run_cycles(params, st, key, granted, num_steps):
     """Run up to `num_steps` lockstep cycles with per-organism budgets
     `granted` (int32[N]) through the VMEM-resident kernel.  Returns the new
-    PopulationState.  Caller must check `eligible(params)` first."""
+    PopulationState.  Caller must check `eligible(params)` first.
+
+    (A budget-sorted block permutation was tried here and reverted: each
+    block runs to ITS OWN max budget, so sorting organisms by budget cuts
+    masked idle lanes ~35% -- but permuting the packed state costs ~10 ms
+    of gather/transpose per update on this part, swamping the win.  The
+    throughput knob for heavy-tailed budgets is TPU_MAX_STEPS_PER_UPDATE.)"""
     packed = pack_state(params, st, granted)
     packed = run_packed(params, packed, key, num_steps)
     return unpack_state(params, st, packed)
+

@@ -69,24 +69,28 @@ def update_step(params, st, key, neighbors, update_no):
                                update_no)
 
     budgets = sched_ops.compute_budgets(params, st, k_budget)
-    # Budget carry-over (TPU lockstep semantic, SURVEY §7 step 3): the
-    # micro-step count per update is capped so SIMD lanes stay busy -- the
-    # reference's merit-proportional allocation is heavy-tailed (an organism
-    # at k x mean merit gets k x AVE_TIME_SLICE cycles *within one update*,
-    # which would leave every other lane idle for the tail).  Cycles an
-    # organism earned but could not execute this update (cap, or the
-    # post-divide stall below) are banked per-organism and re-granted next
-    # update, so merit proportionality is preserved as bounded-burst stride
-    # scheduling: within-update bursts are capped at 2 x AVE_TIME_SLICE
-    # (config TPU_MAX_STEPS_PER_UPDATE overrides), and the bank holds up to
-    # 100 x AVE_TIME_SLICE before cycles are dropped.  Documented deviation:
-    # a lineage sustaining > 2x the population-mean merit spreads more
-    # slowly than in the reference (selection direction and first-discovery
-    # statistics are unaffected; fixation sweeps are time-smeared).
+    # Budget carry-over (TPU lockstep semantic, SURVEY §7 step 3).  By
+    # DEFAULT (TPU_MAX_STEPS_PER_UPDATE = 0) every organism executes its
+    # full merit-proportional budget within the update -- the reference's
+    # scheduling semantics exactly (burst-capped runs measurably slow
+    # selective sweeps: median updates-to-EQU moved from ~3.5k to >12k
+    # under a 2x cap; BASELINE.md).  Setting TPU_MAX_STEPS_PER_UPDATE > 0
+    # is a throughput opt-in: within-update bursts are capped so SIMD
+    # lanes stay busy on heavy-tailed merit distributions, and cycles an
+    # organism earned but could not execute (cap, or the post-divide stall
+    # below) are banked per-organism (up to 100 x AVE_TIME_SLICE) and
+    # re-granted next update -- bounded-burst stride scheduling that
+    # preserves long-run merit proportionality but time-smears fixation
+    # sweeps (documented deviation).
     budgets = budgets + st.budget_carry
-    cap = params.max_steps_per_update or 2 * params.ave_time_slice
-    max_k = jnp.minimum(budgets.max(), cap)
-    granted = jnp.minimum(budgets, max_k)
+    cap = int(params.max_steps_per_update)
+    if cap > 0:
+        max_k = jnp.minimum(budgets.max(), cap)
+        granted = jnp.minimum(budgets, max_k)
+    else:                  # uncapped: reference-faithful bursts
+        cap = 2**31 - 1
+        max_k = budgets.max()
+        granted = budgets
 
     executed0 = st.insts_executed
 
@@ -117,7 +121,23 @@ def update_step(params, st, key, neighbors, update_no):
                          exec_mask)
             return s + 1, st
 
+        pending_before = st.divide_pending
         _, st = jax.lax.while_loop(cond, body, (jnp.int32(0), st))
+        if params.hw_type == 0:
+            # materialize this update's new offspring into off_tape (the
+            # Pallas kernel does this at the divide cycle; here one masked
+            # barrel roll per update keeps the two paths bit-identical) --
+            # a stalled parent's tape is frozen, so end-of-update extraction
+            # sees exactly the divide-time bytes
+            from avida_tpu.ops.interpreter import barrel_shift_left, tape_ops
+            new_div = st.divide_pending & ~pending_before
+            n_, L_ = st.tape.shape
+            ext = barrel_shift_left(
+                tape_ops(st.tape).astype(jnp.uint8), st.off_start, L_)
+            ext = jnp.where(jnp.arange(L_)[None, :] < st.off_len[:, None],
+                            ext, jnp.uint8(0))
+            st = st.replace(off_tape=jnp.where(new_div[:, None], ext,
+                                               st.off_tape))
     # bank whatever each organism earned but did not execute (cap or stall)
     executed_this = st.insts_executed - executed0
     carry = jnp.clip(budgets - executed_this, 0, 100 * params.ave_time_slice)
@@ -129,7 +149,8 @@ def update_step(params, st, key, neighbors, update_no):
     # lifetime count (undercounting, possibly negative)
     executed = executed_this.sum()
 
-    st = birth_ops.flush_births(params, st, k_birth, neighbors, update_no)
+    st = birth_ops.flush_births(params, st, k_birth, neighbors, update_no,
+                                use_off_tape=True)
 
     if params.num_demes > 1:
         st = st.replace(deme_age=st.deme_age + 1)   # cDeme::IncAge per update

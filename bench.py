@@ -35,6 +35,12 @@ def build(world_x, world_y, max_memory, seed):
     cfg.WORLD_Y = world_y
     cfg.TPU_MAX_MEMORY = max_memory
     cfg.RANDOM_SEED = seed
+    # Throughput opt-in (documented, ops/update.py): cap per-update bursts
+    # so lockstep lanes stay busy; earned-but-unexecuted cycles are banked
+    # and re-granted, preserving long-run merit proportionality.  The
+    # DEFAULT config is uncapped = reference-faithful scheduling; the
+    # bench opts into the cap (BENCH_CAP env overrides; 0 = uncapped).
+    cfg.TPU_MAX_STEPS_PER_UPDATE = int(os.environ.get("BENCH_CAP", "45"))
     w = World(cfg=cfg)
     anc = default_ancestor(w.instset)
 
