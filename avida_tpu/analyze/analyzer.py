@@ -130,6 +130,8 @@ class Analyzer:
                 seen[key].total_cpus += 1
             else:
                 g = AnalyzeGenotype(o["genome"], self._take_id())
+                g.src_id = o.get("id", -1)
+                g.parent_src = o.get("parent", -1)
                 seen[key] = g
                 self.batch.append(g)
 
@@ -285,6 +287,118 @@ class Analyzer:
         return g.fitness if g.viable else 0.0
 
     # ---- misc ------------------------------------------------------------
+
+    def _cmd_ALIGN(self, args):
+        """Progressive alignment of the batch against its first genotype
+        (ref cAnalyze::CommandAlign, cAnalyze.cc: gaps written as "_").
+        Stores g.alignment (letter sequence with gaps); DETAIL can emit
+        the `alignment` field afterwards."""
+        if not self.batch:
+            return
+        ref_seq = self.batch[0].sequence
+
+        def lcs_align(a, b):
+            # O(len(a)*len(b)) LCS table; emits aligned letter strings
+            la, lb = len(a), len(b)
+            D = np.zeros((la + 1, lb + 1), np.int32)
+            for i in range(la - 1, -1, -1):
+                for j in range(lb - 1, -1, -1):
+                    best = max(D[i + 1][j + 1] + (1 if a[i] == b[j] else 0),
+                               D[i + 1][j], D[i][j + 1])
+                    D[i][j] = best
+            # traceback
+            out_a, out_b = [], []
+            i = j = 0
+            while i < la and j < lb:
+                if a[i] == b[j] and D[i][j] == D[i + 1][j + 1] + 1:
+                    out_a.append(a[i]); out_b.append(b[j]); i += 1; j += 1
+                elif D[i][j] == D[i + 1][j]:
+                    out_a.append(a[i]); out_b.append(-1); i += 1
+                else:
+                    out_a.append(-1); out_b.append(b[j]); j += 1
+            while i < la:
+                out_a.append(a[i]); out_b.append(-1); i += 1
+            while j < lb:
+                out_a.append(-1); out_b.append(b[j]); j += 1
+            return out_a, out_b
+
+        def to_str(seq):
+            return "".join("_" if x < 0 else spop_mod._seq_to_string(
+                np.asarray([x], np.int8)) for x in seq)
+
+        for g in self.batch:
+            ra, rb = lcs_align(list(ref_seq), list(g.sequence))
+            g.alignment = to_str(rb)
+        self.batch[0].alignment = to_str(list(ref_seq))
+
+    def _cmd_MAP_MUTATIONS(self, args):
+        """Per-site x per-instruction mutant fitness map for each batch
+        genotype (ref cAnalyze::CommandMapMutations): one file per
+        genotype, row = site, column = replacement instruction, value =
+        fitness relative to the base genotype."""
+        outdir = os.path.join(self.data_dir, args[0] if args else "mutmap")
+        os.makedirs(outdir, exist_ok=True)
+        ni = self.params.num_insts
+        for g in self.batch:
+            base = max(self._recalc_one(g), 1e-30)
+            L = g.length
+            muts = []
+            for site in range(L):
+                for op in range(ni):
+                    m = g.sequence.copy()
+                    m[site] = op
+                    muts.append(AnalyzeGenotype(m))
+            buf, lens = self._padded(muts)
+            r = evaluate_genomes(self.params, buf, lens)
+            fit = np.where(r.viable, r.fitness, 0.0).reshape(L, ni) / base
+            with open(os.path.join(outdir, f"mut-map-{g.id}.dat"), "w") as f:
+                f.write("# Mutation map: rows = sites, cols = instructions; "
+                        "entries = mutant fitness / base fitness\n")
+                for site in range(L):
+                    f.write(" ".join(f"{fit[site, o]:.4f}"
+                                     for o in range(ni)) + "\n")
+
+    def _cmd_FIND_LINEAGE(self, args):
+        """Reduce the batch to the ancestral lineage of the chosen
+        genotype (ref cAnalyze::CommandFindLineage): walk parent links
+        (from the loaded .spop systematics columns) from the best
+        genotype back to the root."""
+        if not self.batch:
+            return
+        field = args[0] if args else "num_cpus"
+        best = max(self.batch,
+                   key=lambda g: getattr(g, field, 0) or 0)
+        by_src = {getattr(g, "src_id", -1): g for g in self.batch}
+        lineage = []
+        cur = best
+        seen = set()
+        while cur is not None and id(cur) not in seen:
+            seen.add(id(cur))
+            lineage.append(cur)
+            cur = by_src.get(getattr(cur, "parent_src", -1))
+        self.batch[:] = lineage[::-1]        # root first
+
+    def _cmd_RECOMBINE(self, args):
+        """Cross consecutive batch pairs with one-region swap (ref
+        cAnalyze::CommandRecombine; region-swap semantics shared with
+        cBirthChamber::RegionSwap): appends the recombinants to the
+        batch."""
+        reps = int(args[0]) if args else 1
+        rng = np.random.default_rng(getattr(self, "_recomb_seed", 0) + 1)
+        out = []
+        for _ in range(reps):
+            for i in range(0, len(self.batch) - 1, 2):
+                a = self.batch[i].sequence
+                b = self.batch[i + 1].sequence
+                la, lb = len(a), len(b)
+                f0, f1 = sorted(rng.random(2))
+                s0, e0 = int(f0 * la), int(f1 * la)
+                s1, e1 = int(f0 * lb), int(f1 * lb)
+                child = np.concatenate([a[:s0], b[s1:e1], a[e0:]])
+                if len(child) >= self.params.min_genome_len and \
+                        len(child) <= self.params.max_memory:
+                    out.append(AnalyzeGenotype(child, self._take_id()))
+        self.batch.extend(out)
 
     def _cmd_VERBOSE(self, args):
         self.verbose = not args or args[0] not in ("0", "off")

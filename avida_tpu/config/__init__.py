@@ -1,6 +1,6 @@
 from avida_tpu.config.schema import AvidaConfig, load_avida_cfg
 from avida_tpu.config.instset import (InstSet, load_instset, default_instset,
-                                      heads_sex_instset, transsmt_instset)
+                                      heads_sex_instset, transsmt_instset, experimental_instset, pred_look_instset)
 from avida_tpu.config.organism import load_organism
 from avida_tpu.config.environment import Environment, load_environment
 from avida_tpu.config.events import Event, load_events

@@ -95,6 +95,9 @@ class Process:
     min_number: float = 0.0
     max_fraction: float = 1.0
     depletable: bool = True
+    product: str | None = None      # by-product resource (DoProcesses
+                                    # cc:1824-1830)
+    conversion: float = 1.0         # produced = consumed * conversion
 
 
 @dataclass
@@ -123,6 +126,7 @@ class Resource:
     outflow: float = 0.0
     initial: float = 0.0
     geometry: str = "global"      # global | grid | torus (spatial)
+    deme: bool = False            # per-deme pool (cResource::SetDemeResource)
     xdiffuse: float = 1.0         # spatial only (cSpatialResCount diffusion)
     ydiffuse: float = 1.0
     inflowx1: int = -1            # spatial inflow box (-1 = everywhere)
@@ -165,10 +169,14 @@ class Environment:
         return [r.name for r in self.reactions]
 
     def global_resources(self):
-        return [r for r in self.resources if not r.is_spatial]
+        return [r for r in self.resources
+                if not r.is_spatial and not r.deme]
 
     def spatial_resources(self):
         return [r for r in self.resources if r.is_spatial]
+
+    def deme_resources(self):
+        return [r for r in self.resources if r.deme and not r.is_spatial]
 
     def device_tables(self):
         """Build numpy tables for the jitted task-evaluation kernel.
@@ -186,11 +194,16 @@ class Environment:
         # first-process resource binding (cReactionProcess; -1 = infinite)
         gres = {r.name: i for i, r in enumerate(self.global_resources())}
         sres = {r.name: i for i, r in enumerate(self.spatial_resources())}
+        dres = {r.name: i for i, r in enumerate(self.deme_resources())}
         p_res = np.full(nr, -1, np.int32)
         p_spatial = np.zeros(nr, bool)
+        p_deme = np.zeros(nr, bool)
         p_max = np.ones(nr, np.float64)
         p_frac = np.ones(nr, np.float64)
         p_depl = np.ones(nr, bool)
+        p_prod = np.full(nr, -1, np.int32)
+        p_prod_spatial = np.zeros(nr, bool)
+        p_conv = np.ones(nr, np.float64)
         max_tc = np.full(nr, 2**30, np.int64)
         min_tc = np.zeros(nr, np.int64)
         max_rc = np.full(nr, 2**30, np.int64)
@@ -224,6 +237,9 @@ class Environment:
                 elif p.resource is not None and p.resource in sres:
                     p_res[i] = sres[p.resource]
                     p_spatial[i] = True
+                elif p.resource is not None and p.resource in dres:
+                    p_res[i] = dres[p.resource]
+                    p_deme[i] = True
                 elif p.resource is not None:
                     # ref cEnvironment::LoadReactionProcess errors on unknown
                     # resource names; silently treating it as infinite would
@@ -231,6 +247,17 @@ class Environment:
                     raise ValueError(
                         f"reaction {r.name!r} binds unknown resource "
                         f"{p.resource!r}")
+                if p.product is not None:
+                    p_conv[i] = p.conversion
+                    if p.product in gres:
+                        p_prod[i] = gres[p.product]
+                    elif p.product in sres:
+                        p_prod[i] = sres[p.product]
+                        p_prod_spatial[i] = True
+                    else:
+                        raise ValueError(
+                            f"reaction {r.name!r} produces unknown "
+                            f"resource {p.product!r}")
             for q in r.requisites:
                 max_tc[i] = min(max_tc[i], q.max_task_count)
                 min_tc[i] = max(min_tc[i], q.min_task_count)
@@ -246,7 +273,11 @@ class Environment:
             "max_reaction_count": max_rc, "min_reaction_count": min_rc,
             "req_reaction_mask": req_mask, "noreq_reaction_mask": noreq_mask,
             "proc_res_idx": p_res, "proc_res_spatial": p_spatial,
+            "proc_res_deme": p_deme,
             "proc_max": p_max, "proc_frac": p_frac, "proc_depletable": p_depl,
+            "proc_product_idx": p_prod,
+            "proc_product_spatial": p_prod_spatial,
+            "proc_conversion": p_conv,
             "task_math_name": tuple(math_names),
         }
 
@@ -284,6 +315,8 @@ def load_environment(path: str) -> Environment:
                             min_number=float(kv.get("min", 0.0)),
                             max_fraction=float(kv.get("frac", 1.0)),
                             depletable=bool(int(kv.get("depletable", 1))),
+                            product=kv.get("product"),
+                            conversion=float(kv.get("conversion", 1.0)),
                         ))
                     elif head == "requisite":
                         q = Requisite()
@@ -325,6 +358,8 @@ def load_environment(path: str) -> Environment:
                             kv[k] = v
                     env.resources.append(Resource(
                         name=rname,
+                        deme=str(kv.get("demeresource", "0")).lower()
+                        in ("1", "true"),
                         inflow=float(kv.get("inflow", 0.0)),
                         outflow=float(kv.get("outflow", 0.0)),
                         initial=float(kv.get("initial", 0.0)),

@@ -137,6 +137,42 @@ def transsmt_instset() -> InstSet:
     return s
 
 
+_EXPERIMENTAL_NAMES = [
+    # ref support/config/instset-experimental.cfg (hw_type=3)
+    "nop-A", "nop-B", "nop-C", "nop-D",
+    "if-n-equ", "if-less", "if-label", "mov-head", "jmp-head", "get-head",
+    "label",
+    "shift-r", "shift-l", "inc", "dec", "push", "pop", "swap-stk", "swap",
+    "add", "sub", "nand",
+    "h-copy", "h-alloc", "h-divide",
+    "IO", "h-search",
+]
+
+_PRED_LOOK_NAMES = [
+    # ref tests/avatars-pred_look/config/instset.cfg (hw_type=3)
+    "nop-A", "nop-B", "nop-C", "nop-D", "nop-E", "nop-F", "nop-G", "nop-H",
+    "inc", "dec", "IO", "if-not-0", "if-equ-0",
+    "move", "rotate-x", "rotate-org-id", "look-ahead", "zero",
+    "set-forage-target",
+]
+
+
+def experimental_instset() -> InstSet:
+    """The stock experimental set (ref
+    support/config/instset-experimental.cfg, hw_type 3)."""
+    s = _make_set("experimental", _EXPERIMENTAL_NAMES)
+    s.hw_type = 3
+    return s
+
+
+def pred_look_instset() -> InstSet:
+    """The avatars-pred_look predator/prey sensing set (ref
+    tests/avatars-pred_look/config/instset.cfg, hw_type 3)."""
+    s = _make_set("pred_look", _PRED_LOOK_NAMES)
+    s.hw_type = 3
+    return s
+
+
 def heads_sex_instset() -> InstSet:
     """The heads_sex set: heads_default with h-divide replaced by
     divide-sex (ref support/config/instset-heads-sex.cfg)."""

@@ -44,7 +44,22 @@ class AvidaConfig:
     # --- World/topology ---
     WORLD_X: int = 60
     WORLD_Y: int = 60
-    WORLD_GEOMETRY: int = 2         # 1=bounded grid, 2=torus (nGeometry.h:30-37)
+    WORLD_GEOMETRY: int = 2         # nGeometry.h:30-37: 1=grid, 2=torus,
+                                    # 3=clique, 4=hex, 6=lattice(z=1),
+                                    # 7=random-connected, 8=scale-free
+    SCALE_FREE_M: int = 3           # connections per new cell (geometry 8)
+    SCALE_FREE_ALPHA: float = 1.0   # attachment power (1=linear)
+    SCALE_FREE_ZERO_APPEAL: float = 0.0  # appeal of zero-degree cells
+    # --- energy model (cAvidaConfig.h:649-667) ---
+    ENERGY_ENABLED: int = 0
+    ENERGY_GIVEN_ON_INJECT: float = 0.0
+    ENERGY_GIVEN_AT_BIRTH: float = 0.0
+    FRAC_PARENT_ENERGY_GIVEN_TO_ORG_AT_BIRTH: float = 0.5
+    FRAC_ENERGY_DECAY_AT_ORG_BIRTH: float = 0.0
+    ENERGY_CAP: float = -1.0
+    NUM_CYCLES_EXC_BEFORE_0_ENERGY: int = 200
+    FIX_METABOLIC_RATE: float = -1.0
+    DISPERSAL_RATE: float = 1.0
 
     # --- File paths ---
     DATA_DIR: str = "data"

@@ -31,6 +31,8 @@ class WorldParams(struct.PyTreeNode):
     """
     # hardware backend (cHardwareManager factory; models/registry.py)
     hw_type: int = struct.field(pytree_node=False, default=0)
+    num_registers: int = struct.field(pytree_node=False, default=3)
+    num_nops: int = struct.field(pytree_node=False, default=3)
     # parasites (TransSMT; cHardwareTransSMT.cc:218-248)
     parasite_virulence: float = struct.field(pytree_node=False, default=-1.0)
     # world shape
@@ -78,6 +80,20 @@ class WorldParams(struct.PyTreeNode):
     inherit_merit: bool = struct.field(pytree_node=False, default=True)
     max_steps_per_update: int = struct.field(pytree_node=False, default=0)
     use_pallas: int = struct.field(pytree_node=False, default=0)
+    # energy model (cPhenotype energy store; cAvidaConfig.h:649-667)
+    energy_enabled: bool = struct.field(pytree_node=False, default=False)
+    energy_given_on_inject: float = struct.field(pytree_node=False, default=0.0)
+    energy_given_at_birth: float = struct.field(pytree_node=False, default=0.0)
+    frac_parent_energy: float = struct.field(pytree_node=False, default=0.5)
+    frac_energy_decay_birth: float = struct.field(pytree_node=False, default=0.0)
+    energy_cap: float = struct.field(pytree_node=False, default=-1.0)
+    num_cycles_exc: int = struct.field(pytree_node=False, default=200)
+    fix_metabolic_rate: float = struct.field(pytree_node=False, default=-1.0)
+    inst_energy_cost: tuple = struct.field(pytree_node=False, default=())
+    dispersal_rate: float = struct.field(pytree_node=False, default=1.0)
+    # systematics: device-side newborn ring buffer (chunked-run phylogeny
+    # ingestion; 0 = off)
+    nb_cap: int = struct.field(pytree_node=False, default=0)
     # death
     death_method: int = struct.field(pytree_node=False, default=2)
     age_limit: int = struct.field(pytree_node=False, default=20)
@@ -112,6 +128,17 @@ class WorldParams(struct.PyTreeNode):
     proc_max: tuple = struct.field(pytree_node=False, default=())
     proc_frac: tuple = struct.field(pytree_node=False, default=())
     proc_depletable: tuple = struct.field(pytree_node=False, default=())
+    # reaction by-products (DoProcesses cc:1824-1830): produced into the
+    # pool = consumed * conversion
+    proc_product_idx: tuple = struct.field(pytree_node=False, default=())
+    proc_product_spatial: tuple = struct.field(pytree_node=False, default=())
+    proc_conversion: tuple = struct.field(pytree_node=False, default=())
+    # per-deme resource pools (cDeme resource slice; cResource deme flag)
+    num_deme_res: int = struct.field(pytree_node=False, default=0)
+    dres_inflow: tuple = struct.field(pytree_node=False, default=())
+    dres_outflow: tuple = struct.field(pytree_node=False, default=())
+    dres_initial: tuple = struct.field(pytree_node=False, default=())
+    proc_res_deme: tuple = struct.field(pytree_node=False, default=())
     # global resource pools (cResourceCount)
     num_global_res: int = struct.field(pytree_node=False, default=0)
     res_inflow: tuple = struct.field(pytree_node=False, default=())
@@ -165,6 +192,8 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
             "carrying-capacity policies (cPopulation.cc:5192-5238)")
     return WorldParams(
         hw_type=instset.hw_type,
+        num_registers=8 if instset.hw_type == 3 else 3,
+        num_nops=int(sum(bool(x) for x in tables["is_nop"])) or 3,
         parasite_virulence=cfg.PARASITE_VIRULENCE,
         world_x=cfg.WORLD_X, world_y=cfg.WORLD_Y, geometry=cfg.WORLD_GEOMETRY,
         max_memory=cfg.TPU_MAX_MEMORY,
@@ -216,6 +245,19 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         prefer_empty=bool(cfg.PREFER_EMPTY),
         allow_parent=bool(cfg.ALLOW_PARENT),
         divide_method=cfg.DIVIDE_METHOD,
+        energy_enabled=bool(cfg.ENERGY_ENABLED),
+        energy_given_on_inject=cfg.ENERGY_GIVEN_ON_INJECT,
+        energy_given_at_birth=cfg.ENERGY_GIVEN_AT_BIRTH,
+        frac_parent_energy=cfg.FRAC_PARENT_ENERGY_GIVEN_TO_ORG_AT_BIRTH,
+        frac_energy_decay_birth=cfg.FRAC_ENERGY_DECAY_AT_ORG_BIRTH,
+        energy_cap=cfg.ENERGY_CAP,
+        num_cycles_exc=cfg.NUM_CYCLES_EXC_BEFORE_0_ENERGY,
+        fix_metabolic_rate=cfg.FIX_METABOLIC_RATE,
+        inst_energy_cost=tuple(float(x) for x in instset.energy_cost)
+        if instset.energy_cost.any() else (),
+        dispersal_rate=cfg.DISPERSAL_RATE,
+        nb_cap=2 * cfg.WORLD_X * cfg.WORLD_Y
+        if cfg.get("TPU_SYSTEMATICS", 1) else 0,
         generation_inc_method=cfg.GENERATION_INC_METHOD,
         num_reactions=len(environment.reactions),
         task_logic_mask=tt(env_tables["task_logic_mask"]),
@@ -231,6 +273,15 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         proc_max=tuple(env_tables["proc_max"].tolist()),
         proc_frac=tuple(env_tables["proc_frac"].tolist()),
         proc_depletable=tuple(env_tables["proc_depletable"].tolist()),
+        proc_product_idx=tuple(env_tables["proc_product_idx"].tolist()),
+        proc_product_spatial=tuple(
+            env_tables["proc_product_spatial"].tolist()),
+        proc_conversion=tuple(env_tables["proc_conversion"].tolist()),
+        num_deme_res=len(environment.deme_resources()),
+        dres_inflow=tuple(r.inflow for r in environment.deme_resources()),
+        dres_outflow=tuple(r.outflow for r in environment.deme_resources()),
+        dres_initial=tuple(r.initial for r in environment.deme_resources()),
+        proc_res_deme=tuple(env_tables["proc_res_deme"].tolist()),
         num_global_res=len(environment.global_resources()),
         res_inflow=tuple(r.inflow for r in environment.global_resources()),
         res_outflow=tuple(r.outflow for r in environment.global_resources()),
@@ -358,6 +409,29 @@ class PopulationState(struct.PyTreeNode):
     germ_mem: jax.Array          # int8[D, L] germline genome (cGermline)
     germ_len: jax.Array          # int32[D]
 
+    # --- energy model (cPhenotype energy_store; only meaningful when
+    # ENERGY_ENABLED) ---
+    energy: jax.Array          # f32[N]
+
+    # --- per-deme resource pools (cDeme resource slice) ---
+    deme_resources: jax.Array  # f32[D, Rd]
+
+    # --- newborn record buffer (systematics chunked ingestion; size-0
+    # axes when nb_cap == 0) ---
+    nb_genome: jax.Array       # int8[CAP, L]
+    nb_len: jax.Array          # int32[CAP]
+    nb_cell: jax.Array         # int32[CAP]
+    nb_parent: jax.Array       # int32[CAP]
+    nb_update: jax.Array       # int32[CAP]
+    nb_count: jax.Array        # int32[] records written (may exceed CAP =
+                               # overflow; the host detects and falls back)
+
+    # --- experimental hardware (hw_type 3): spatial behaviour state ---
+    facing: jax.Array          # int32[N]  ring direction 0-7 (cell facing;
+                               # ref cPopulationCell rotation state)
+    forage_target: jax.Array   # int32[N]  (Inst_SetForageTarget; predator/
+                               # prey identity, -1 = unset default)
+
     # --- TransSMT hardware (hw_type 2; empty (size-0 axes) on heads
     # hardware).  Threads: 0 = host, 1 = parasite.  Memory spaces per
     # thread: base space (host base = the packed `tape`) + ONE auxiliary
@@ -417,14 +491,15 @@ class PopulationState(struct.PyTreeNode):
 
 def zeros_population(n: int, L: int, R: int, n_global_res: int = 0,
                      n_spatial_res: int = 0, n_demes: int = 1,
-                     smt: bool = False) -> PopulationState:
+                     smt: bool = False, num_registers: int = 3,
+                     nb_cap: int = 0, n_deme_res: int = 0) -> PopulationState:
     i32 = partial(jnp.zeros, dtype=jnp.int32)
     f32 = partial(jnp.zeros, dtype=jnp.float32)
     T = 2 if smt else 0          # SMT thread axis (host, parasite)
     Ls = L if smt else 0         # SMT memory-space width
     return PopulationState(
         tape=jnp.zeros((n, L), jnp.uint8), mem_len=i32(n),
-        regs=i32((n, 3)), heads=i32((n, 4)),
+        regs=i32((n, num_registers)), heads=i32((n, 4)),
         stacks=i32((n, 2, 10)), sp=i32((n, 2)), active_stack=i32(n),
         read_label=jnp.zeros((n, 10), jnp.int8), read_label_len=i32(n),
         mal_active=jnp.zeros(n, bool),
@@ -443,6 +518,12 @@ def zeros_population(n: int, L: int, R: int, n_global_res: int = 0,
         sterile=jnp.zeros(n, bool),
         breed_true=jnp.zeros(n, bool),
         divide_pending=jnp.zeros(n, bool),
+        energy=f32(n),
+        deme_resources=jnp.zeros((n_demes, n_deme_res), jnp.float32),
+        nb_genome=jnp.zeros((nb_cap, L), jnp.int8), nb_len=i32(nb_cap),
+        nb_cell=i32(nb_cap), nb_parent=i32(nb_cap), nb_update=i32(nb_cap),
+        nb_count=jnp.zeros((), jnp.int32),
+        facing=i32(n), forage_target=jnp.full(n, -1, jnp.int32),
         off_start=i32(n), off_len=i32(n),
         off_tape=jnp.zeros((n, L), jnp.uint8),
         off_copied_size=i32(n), off_sex=jnp.zeros(n, bool),
@@ -477,18 +558,81 @@ def make_cell_inputs(key: jax.Array, n: int) -> jax.Array:
     return tops[None, :] + low
 
 
+# world-level / cell-bound fields that are NOT per-organism rows
+WORLD_LEVEL_FIELDS = frozenset({
+    "resources", "res_grid", "grad_peak",
+    "bc_mem", "bc_len", "bc_merit", "bc_valid",
+    "deme_birth_count", "deme_age", "germ_mem", "germ_len", "deme_resources",
+
+    "nb_genome", "nb_len", "nb_cell", "nb_parent", "nb_update", "nb_count",
+})
+
+
+def seed_organism(params: WorldParams, st: PopulationState,
+                  seed_genome: np.ndarray, key: jax.Array,
+                  cell: int) -> PopulationState:
+    """Write ONE fresh organism into `cell` (ref cPopulation::Inject
+    cc:7377 + cPhenotype::SetupInject cc:599: merit = genome length,
+    copied = executed = length).  Every per-organism field at the cell
+    resets to its fresh-organism default first -- O(1) in world size, no
+    full-population rebuild."""
+    n, L, R = params.num_cells, params.max_memory, params.num_reactions
+    blank = zeros_population(1, L, R, params.num_global_res,
+                             params.num_spatial_res, 1,
+                             smt=(params.hw_type in (1, 2)),
+                             num_registers=params.num_registers)
+    c = cell
+    updates = {}
+    for name in st.__dataclass_fields__:
+        if name in WORLD_LEVEL_FIELDS:
+            continue
+        v = getattr(st, name)
+        if not hasattr(v, "shape") or v.ndim == 0 or v.shape[0] != n:
+            continue
+        updates[name] = v.at[c].set(getattr(blank, name)[0])
+    st = st.replace(**updates)
+
+    g = np.zeros(L, np.int8)
+    glen = len(seed_genome)
+    if glen > L:
+        raise ValueError(f"seed genome length {glen} exceeds max_memory {L}")
+    g[:glen] = seed_genome
+    k_in, _ = jax.random.split(key)
+    return st.replace(
+        tape=st.tape.at[c].set(jnp.asarray(g).astype(jnp.uint8)),
+        genome=st.genome.at[c].set(jnp.asarray(g)),
+        mem_len=st.mem_len.at[c].set(glen),
+        genome_len=st.genome_len.at[c].set(glen),
+        alive=st.alive.at[c].set(True),
+        merit=st.merit.at[c].set(float(glen)),
+        energy=st.energy.at[c].set(params.energy_given_on_inject),
+        cur_bonus=st.cur_bonus.at[c].set(params.default_bonus),
+        executed_size=st.executed_size.at[c].set(glen),
+        copied_size=st.copied_size.at[c].set(glen),
+        max_executed=st.max_executed.at[c].set(
+            params.age_limit * glen if params.death_method == 2
+            else (params.age_limit if params.death_method == 1 else 2**30)),
+        inputs=st.inputs.at[c].set(make_cell_inputs(k_in, 1)[0]),
+    )
+
+
 def init_population(params: WorldParams, seed_genome: np.ndarray,
                     key: jax.Array, inject_cell: int | None = None
                     ) -> PopulationState:
     """World with a single injected ancestor (ref ActivateOrganism +
-    cPhenotype::SetupInject, cPhenotype.cc:599: merit = genome length,
-    copied = executed = length)."""
+    cPhenotype::SetupInject, cPhenotype.cc:599)."""
     n, L, R = params.num_cells, params.max_memory, params.num_reactions
     st = zeros_population(n, L, R, params.num_global_res,
                           params.num_spatial_res, params.num_demes,
-                          smt=(params.hw_type in (1, 2)))
+                          smt=(params.hw_type in (1, 2)),
+                          num_registers=params.num_registers,
+                          nb_cap=params.nb_cap,
+                          n_deme_res=params.num_deme_res)
     k_inputs, key = jax.random.split(key)
     st = st.replace(inputs=make_cell_inputs(k_inputs, n),
+                    deme_resources=jnp.broadcast_to(
+                        jnp.asarray(params.dres_initial, jnp.float32)[None, :],
+                        (params.num_demes, params.num_deme_res)),
                     resources=jnp.asarray(params.res_initial, jnp.float32),
                     res_grid=jnp.broadcast_to(
                         jnp.asarray(params.sres_initial, jnp.float32)[:, None],
@@ -497,24 +641,9 @@ def init_population(params: WorldParams, seed_genome: np.ndarray,
         inject_cell = n // 2  # reference injects cell 0; center is equivalent on a torus
     g = np.zeros(L, np.int8)
     glen = len(seed_genome)
-    if glen > L:
-        raise ValueError(f"seed genome length {glen} exceeds max_memory {L}")
-    g[:glen] = seed_genome
     c = inject_cell
-    st = st.replace(
-        tape=st.tape.at[c].set(jnp.asarray(g).astype(jnp.uint8)),
-        genome=st.genome.at[c].set(jnp.asarray(g)),
-        mem_len=st.mem_len.at[c].set(glen),
-        genome_len=st.genome_len.at[c].set(glen),
-        alive=st.alive.at[c].set(True),
-        merit=st.merit.at[c].set(float(glen)),
-        cur_bonus=st.cur_bonus.at[c].set(params.default_bonus),
-        executed_size=st.executed_size.at[c].set(glen),
-        copied_size=st.copied_size.at[c].set(glen),
-        max_executed=st.max_executed.at[c].set(
-            params.age_limit * glen if params.death_method == 2
-            else (params.age_limit if params.death_method == 1 else 2**30)),
-    )
+    st = seed_organism(params, st, seed_genome, key, c)
+    g[:glen] = seed_genome
     if params.demes_use_germline:
         # every deme's germline starts at the ancestor (cGermline seeded at
         # world setup)

@@ -7,7 +7,7 @@ types).  Each entry maps a hardware type id to a module exposing
 name.  New hardware (transsmt, experimental, ...) registers here.
 """
 
-from avida_tpu.models import heads, transsmt
+from avida_tpu.models import experimental, heads, transsmt
 
 HARDWARE_REGISTRY = {
     0: {"name": "heads", "module": heads,
@@ -19,7 +19,9 @@ HARDWARE_REGISTRY = {
         "default_instset": "instset-transsmt.cfg"},
     2: {"name": "transsmt", "module": transsmt,
         "default_instset": "instset-transsmt.cfg"},
-    # experimental, bcr, gp8 -- planned
+    3: {"name": "experimental", "module": experimental,
+        "default_instset": "instset-experimental.cfg"},
+    # bcr, gp8 -- planned
 }
 
 

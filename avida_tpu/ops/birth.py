@@ -208,32 +208,125 @@ def local_torus_fast_path(params, sexual: bool) -> bool:
             and params.population_cap == 0 and params.pop_cap_eldest == 0)
 
 
-def neighbor_table(world_x: int, world_y: int, geometry: int) -> np.ndarray:
-    """Static [N, 8] neighbor cell ids (ref cPopulation::SetupCellGrid
-    cc:323 + cTopology.h wiring; geometry 1=bounded grid, 2=torus).
+def neighbor_table(world_x: int, world_y: int, geometry: int,
+                   seed: int = 0, scale_free_m: int = 3,
+                   scale_free_alpha: float = 1.0,
+                   scale_free_zero_appeal: float = 0.0) -> np.ndarray:
+    """Static [N, C] neighbor cell ids, -1 = padding slot (ref
+    cPopulation::SetupCellGrid cc:376-394 switching over nGeometry.h:30-37
+    via the cTopology.h builders).  Geometries:
 
-    For bounded grids, out-of-world neighbors are replaced by the cell itself
-    (self-loops never win placement over real neighbors when empty cells are
-    preferred; matches the reference's shorter connection lists closely
-    enough for the lockstep engine)."""
+      1 GRID   bounded 8-neighborhood (edge cells have shorter lists)
+      2 TORUS  wrapped 8-neighborhood (C=8, no padding)
+      3 CLIQUE every cell connects to every other (build_clique h:103)
+      4 HEX    grid minus the NE/SW diagonals (build_hex h:119)
+      6 LATTICE 3-D lattice with z=1 == bounded grid (build_lattice h:137)
+      7 RANDOM_CONNECTED random bidirectional graph grown to connectivity
+               (build_random_connected_network h:232)
+      8 SCALE_FREE preferential-attachment graph, P ~ (deg/|E|)^alpha +
+               zero_appeal, m edges per new vertex (build_scale_free h:376)
+
+    GLOBAL (0) and PARTIAL (5) are declared in nGeometry.h but have no
+    case in the reference's own SetupCellGrid switch -- they raise here
+    too.  Random geometries are frozen at world construction from `seed`
+    (the reference also builds them once at setup)."""
     n = world_x * world_y
-    out = np.zeros((n, 8), np.int32)
-    offs = [(-1, -1), (-1, 0), (-1, 1), (0, -1), (0, 1), (1, -1), (1, 0), (1, 1)]
-    for y in range(world_y):
-        for x in range(world_x):
-            c = y * world_x + x
-            for k, (dy, dx) in enumerate(offs):
-                ny, nx = y + dy, x + dx
-                if geometry == 2:  # torus
-                    ny %= world_y
-                    nx %= world_x
-                    out[c, k] = ny * world_x + nx
-                else:              # bounded grid
+    offs = [(-1, -1), (-1, 0), (-1, 1), (0, -1), (0, 1), (1, -1), (1, 0),
+            (1, 1)]
+
+    def grid_like(skip=()):
+        out = np.full((n, 8), -1, np.int32)
+        for y in range(world_y):
+            for x in range(world_x):
+                c = y * world_x + x
+                col = 0
+                for k, (dy, dx) in enumerate(offs):
+                    if (dy, dx) in skip:
+                        continue
+                    ny, nx = y + dy, x + dx
                     if 0 <= ny < world_y and 0 <= nx < world_x:
-                        out[c, k] = ny * world_x + nx
-                    else:
-                        out[c, k] = c
-    return out
+                        out[c, col] = ny * world_x + nx
+                        col += 1
+        return out
+
+    if geometry == 2:              # torus
+        out = np.zeros((n, 8), np.int32)
+        for y in range(world_y):
+            for x in range(world_x):
+                c = y * world_x + x
+                for k, (dy, dx) in enumerate(offs):
+                    out[c, k] = ((y + dy) % world_y) * world_x \
+                        + (x + dx) % world_x
+        return out
+    if geometry in (1, 6):         # bounded grid; lattice with z=1 == grid
+        return grid_like()
+    if geometry == 4:              # hex: drop NE (-1,+1) and SW (+1,-1)
+        return grid_like(skip={(-1, 1), (1, -1)})
+    if geometry == 3:              # clique
+        out = np.full((n, n - 1), -1, np.int32)
+        ids = np.arange(n)
+        for c in range(n):
+            out[c] = np.concatenate([ids[:c], ids[c + 1:]])
+        return out
+    if geometry in (7, 8):
+        rng = np.random.default_rng(seed + geometry)
+        adj = [set() for _ in range(n)]
+        if geometry == 7:          # random connected network
+            connected = set()
+            for i in range(n):
+                j = i
+                while j == i:
+                    j = int(rng.integers(0, n))
+                if j not in adj[i]:
+                    adj[i].add(j)
+                    adj[j].add(i)
+                    connected.update((i, j))
+            # grow to a single component like the reference's fix-up pass:
+            # connect any stranded cell to a connected one
+            comp = {0}
+            frontier = [0]
+            while frontier:
+                c = frontier.pop()
+                for d in adj[c]:
+                    if d not in comp:
+                        comp.add(d)
+                        frontier.append(d)
+            for i in range(n):
+                if i not in comp:
+                    j = int(rng.choice(sorted(comp)))
+                    adj[i].add(j)
+                    adj[j].add(i)
+                    comp.add(i)
+        else:                      # scale-free (build_scale_free h:376)
+            adj[0].add(1)
+            adj[1].add(0)
+            edge_count = 1
+            for u in range(2, n):
+                to_add = min(u, scale_free_m)
+                added = 0
+                v = 0
+                while added < to_add:
+                    if v not in adj[u] and v != u:
+                        p = (len(adj[v]) / edge_count) ** scale_free_alpha \
+                            + scale_free_zero_appeal
+                        if rng.random() < min(p, 1.0):
+                            adj[u].add(v)
+                            adj[v].add(u)
+                            edge_count += 1
+                            added += 1
+                    v += 1
+                    if v >= u:
+                        v = 0
+        deg = max(1, max(len(a) for a in adj))
+        out = np.full((n, deg), -1, np.int32)
+        for c in range(n):
+            for k, d in enumerate(sorted(adj[c])):
+                out[c, k] = d
+        return out
+    raise NotImplementedError(
+        f"WORLD_GEOMETRY {geometry}: GLOBAL (0) and PARTIAL (5) have no "
+        f"builder in the reference's cPopulation::SetupCellGrid either "
+        f"(cPopulation.cc:376-394); supported: 1-4, 6-8")
 
 
 def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
@@ -276,10 +369,6 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
     # ---- target selection (PositionOffspring, cc:5185: the 12
     # ePOSITION_OFFSPRING methods, Definitions.h:67-82) ----
     bm = params.birth_method
-    if bm in (9, 10, 11):
-        raise NotImplementedError(
-            f"BIRTH_METHOD {bm} (energy-used / dispersal placement) needs "
-            f"the energy model; use methods 0-8")
     fast = local_torus_fast_path(params, sexual)
     wx, wy = params.world_x, params.world_y
     offs_all = _OFFS_2D + (((0, 0),) if params.allow_parent else ())
@@ -289,7 +378,9 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
         dy, dx = offs_all[k]
         return _roll2d(x, -dy, -dx, wx, wy)
 
-    cand = neighbors                                  # [N, 8]
+    cand = neighbors                                  # [N, C]
+    pad = cand < 0           # -1 slots (short connection lists); a padded
+    cand = jnp.where(pad, rows[:, None], cand)        # slot never wins
     if params.num_demes > 1:
         # deme-local placement: candidates in a different deme collapse to
         # the parent cell (births stay inside the group; cross-deme birth
@@ -299,7 +390,9 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
         same_deme = (cand // cpd) == (rows // cpd)[:, None]
         cand = jnp.where(same_deme, cand, rows[:, None])
     if params.allow_parent and bm in (0, 1, 2, 3):
-        cand = jnp.concatenate([cand, rows[:, None]], axis=1)   # [N, 9]
+        cand = jnp.concatenate([cand, rows[:, None]], axis=1)   # [N, C+1]
+        pad = jnp.concatenate(
+            [pad, jnp.zeros((n, 1), bool)], axis=1)
     ncand = cand.shape[1]
     if fast:
         occupied = jnp.stack([nbr(st.alive, k) for k in range(ncand)],
@@ -327,6 +420,9 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
         score = u + empty_bonus
     else:
         score = u
+    # padding slots (cells with short connection lists) never win unless
+    # the cell has no real candidate at all
+    score = score - jnp.where(pad, 1e18, 0.0)
     choice = jnp.argmax(score, axis=1)
     if fast:
         target = jnp.zeros(n, jnp.int32)
@@ -360,9 +456,45 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
     elif bm == 7:          # PARENT_FACING: the faced connection; the
         # lockstep engine models no rotation, so facing = connection 0
         # (documented deviation)
-        target = neighbors[:, 0]
+        target = jnp.where(neighbors[:, 0] < 0, rows, neighbors[:, 0])
     elif bm == 8:          # NEXT_CELL
         target = (rows + 1) % n
+    elif bm == 9:          # FULL_SOUP_ENERGY_USED (cPopulation.cc:5332):
+        # the cell whose occupant has used the most time (empty cells count
+        # as INT_MAX, i.e. preferred); random tiebreak
+        k9 = jax.random.fold_in(k_place, 9)
+        score9 = jnp.where(st.alive, st.time_used.astype(jnp.float32),
+                           1e12) + jax.random.uniform(k9, (n,))
+        target = jnp.full(n, jnp.argmax(score9), jnp.int32)
+    elif bm == 10:         # NEIGHBORHOOD_ENERGY_USED (cc:5400): same rule
+        # among the parent's connections
+        occ_t = jnp.where(occupied, st.time_used[cand].astype(jnp.float32),
+                          1e12)
+        choice10 = jnp.argmax(occ_t + u, axis=1)
+        target = cand[rows, choice10]
+    elif bm == 11:         # DISPERSAL (cc:5363): a Poisson(DISPERSAL_RATE)
+        # number of random single-cell hops from the parent (capped at 8)
+        k11 = jax.random.fold_in(k_place, 11)
+        hops = jnp.clip(jax.random.poisson(
+            jax.random.fold_in(k11, 0), params.dispersal_rate, (n,)),
+            0, 8).astype(jnp.int32)
+        wx, wy = params.world_x, params.world_y
+        y = rows // wx
+        x = rows % wx
+        for h in range(8):
+            kd = jax.random.fold_in(k11, h + 1)
+            d = jax.random.randint(kd, (n,), 0, 8, jnp.int32)
+            step = h < hops
+            dy = jnp.where(d < 3, -1, jnp.where(d < 5, 0, 1))
+            dx_t = jnp.asarray([-1, 0, 1, -1, 1, -1, 0, 1], jnp.int32)
+            dx = dx_t[d]
+            if params.geometry == 2:
+                y = jnp.where(step, (y + dy) % wy, y)
+                x = jnp.where(step, (x + dx) % wx, x)
+            else:
+                y = jnp.where(step, jnp.clip(y + dy, 0, wy - 1), y)
+                x = jnp.where(step, jnp.clip(x + dx, 0, wx - 1), x)
+        target = y * wx + x
     if params.num_demes > 1 and bm in (5, 7, 8):
         # global/absolute targets must still respect deme boundaries:
         # a cross-deme target collapses to the parent cell (only
@@ -463,7 +595,11 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
         "last_merit_base": st.last_merit_base,
         "executed_size": st.executed_size,
         "copied_size": st.child_copied_size,
-        "generation": st.generation,             # parent already incremented
+        # GENERATION_INC_METHOD 1 (default): parent incremented at divide,
+        # child copies it; method 0: only the child increments
+        # (cPhenotype::SetupOffspring cc:476)
+        "generation": st.generation + (
+            0 if params.generation_inc_method == 1 else 1),
         "max_executed": max_exec,
         "breed_true": is_breed_true,
         "parent_id": rows.astype(jnp.int32),
@@ -491,6 +627,24 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
         "inj_mem": jnp.uint8(0), "inj_len": 0,
     }
 
+    if params.hw_type == 3:
+        # experimental hardware: offspring inherit the forage target
+        # (cPhenotype::SetupOffspring forage inheritance)
+        parent_updates["forage_target"] = st.forage_target
+    if params.energy_enabled:
+        # energy split at birth (cPhenotype::SetupOffspring energy branch +
+        # FRAC_PARENT_ENERGY_GIVEN_TO_ORG_AT_BIRTH / decay): the child
+        # receives its share when the birth actually lands; merit follows
+        # the energy (ConvertEnergyToMerit)
+        from avida_tpu.ops.interpreter import convert_energy_to_merit
+        keep = (1.0 - params.frac_energy_decay_birth)
+        child_energy = st.energy * keep * params.frac_parent_energy \
+            + params.energy_given_at_birth
+        if params.energy_cap > 0:
+            child_energy = jnp.minimum(child_energy, params.energy_cap)
+        parent_updates["energy"] = child_energy
+        parent_updates["merit"] = convert_energy_to_merit(
+            params, child_energy).astype(st.merit.dtype)
     new_fields = {}
     for name, src in parent_updates.items():
         dst = getattr(st, name)
@@ -508,6 +662,13 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
     # fresh per-cell input stream for the newborn (cell property, not
     # inherited -- indexed by target cell, so no gather either)
     new_fields["inputs"] = jnp.where(births[:, None], fresh_inputs, st.inputs)
+    if params.hw_type == 3:
+        # newborns face a random ring direction (cPopulationCell random
+        # rotation at activation)
+        k_face = jax.random.fold_in(key, 0xFACE)
+        new_fields["facing"] = jnp.where(
+            births, jax.random.randint(k_face, (n,), 0, 8, jnp.int32),
+            st.facing)
     if params.hw_type in (1, 2):
         # newborn SMT thread bases: host at space 0, parasite at space 2
         base = jnp.asarray([[0, 0, 0, 0], [2, 2, 2, 2]],
@@ -545,7 +706,8 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
                 "last_merit_base": st.last_merit_base,
                 "executed_size": st.executed_size,
                 "copied_size": st.child_copied_size,
-                "generation": st.generation,
+                "generation": st.generation + (
+                    0 if params.generation_inc_method == 1 else 1),
                 "max_executed": jnp.where(
                     params.death_method == 2, params.age_limit * dual_len,
                     jnp.where(params.death_method == 1, params.age_limit,
@@ -572,6 +734,29 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
         new_fields = jax.lax.cond(dual_born.any(), apply_dual,
                                   lambda nf: dict(nf), new_fields)
         births = births | b2
+
+    if st.nb_genome.shape[0] > 0:
+        # append this flush's newborns to the device-side record buffer
+        # (host systematics drains it at chunk boundaries; world.py)
+        CAP = st.nb_genome.shape[0]
+        rank = jnp.cumsum(births.astype(jnp.int32)) - 1
+        slot = st.nb_count + rank
+        ok = births & (slot < CAP)
+        idx = jnp.where(ok, slot, CAP)          # CAP = dropped
+        st_nb = dict(
+            nb_genome=st.nb_genome.at[idx].set(
+                new_fields["genome"], mode="drop"),
+            nb_len=st.nb_len.at[idx].set(new_fields["genome_len"],
+                                         mode="drop"),
+            nb_cell=st.nb_cell.at[idx].set(rows.astype(jnp.int32),
+                                           mode="drop"),
+            nb_parent=st.nb_parent.at[idx].set(
+                jnp.where(births, parent_idx, -1), mode="drop"),
+            nb_update=st.nb_update.at[idx].set(
+                jnp.full(n, update_no, jnp.int32), mode="drop"),
+            nb_count=st.nb_count + births.sum(),
+        )
+        new_fields.update(st_nb)
 
     if params.num_demes > 1:
         # per-deme birth tally (cDeme::IncBirthCount; feeds CompeteDemes
@@ -601,6 +786,17 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
     cleared = jnp.where(won | leftover | ~st.alive, False, st.divide_pending)
     st = st.replace(divide_pending=cleared,
                     off_sex=st.off_sex & cleared)
+    if params.energy_enabled:
+        # the winning parent keeps (1-decay)(1-frac) of its energy; its
+        # merit tracks the new store (cPhenotype::DivideReset energy branch)
+        from avida_tpu.ops.interpreter import convert_energy_to_merit
+        keep = (1.0 - params.frac_energy_decay_birth)
+        parent_after = st.energy * keep * (1.0 - params.frac_parent_energy)
+        new_energy = jnp.where(won, parent_after, st.energy)
+        st = st.replace(
+            energy=new_energy,
+            merit=jnp.where(won, convert_energy_to_merit(
+                params, new_energy).astype(st.merit.dtype), st.merit))
     if params.population_cap > 0 or params.pop_cap_eldest > 0:
         # carrying capacity (cPopulation::PositionOffspring pop-cap kills,
         # cc:5192-5238): when the population exceeds the cap, kill the

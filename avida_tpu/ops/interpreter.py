@@ -259,11 +259,13 @@ def micro_step(params, st, key, exec_mask):
     tape = tape | jnp.where(exec_here | exec_next, EXEC_BIT, jnp.uint8(0))
 
     # ---- register reads (pre-update values) ----
+    # NR = 3 for heads, 8 for experimental (cHardwareExperimental.h:66)
+    NR = params.num_registers
     regs0 = st.regs
-    r_onehot = jnp.arange(3)[None, :] == operand[:, None]   # [N,3]
+    r_onehot = jnp.arange(NR)[None, :] == operand[:, None]  # [N,NR]
     val = jnp.sum(jnp.where(r_onehot, regs0, 0), axis=1)
-    next_reg = (operand + 1) % 3
-    r2_onehot = jnp.arange(3)[None, :] == next_reg[:, None]
+    next_reg = (operand + 1) % NR
+    r2_onehot = jnp.arange(NR)[None, :] == next_reg[:, None]
     val2 = jnp.sum(jnp.where(r2_onehot, regs0, 0), axis=1)
     bx = regs0[:, 1]
     cx = regs0[:, 2]
@@ -292,7 +294,7 @@ def micro_step(params, st, key, exec_mask):
                              st.active_stack)
 
     # ---- h-search (cc:7245: complement label, find-forward from origin) ----
-    lbl_c = (label + 1) % 3             # complement rotation (Rotate(1,3))
+    lbl_c = (label + 1) % params.num_nops   # complement (Rotate(1, #nops))
     srch = is_op(SEM_H_SEARCH)
 
     def search_block(_):
@@ -342,6 +344,10 @@ def micro_step(params, st, key, exec_mask):
     skip = jnp.where(is_op(SEM_IF_N_EQU), val == val2, skip)
     skip = jnp.where(is_op(SEM_IF_LESS), val >= val2, skip)
     skip = jnp.where(is_op(SEM_IF_LABEL), ~rl_match, skip)
+    if params.hw_type == 3:
+        from avida_tpu.models.experimental import SEM_IF_EQU_0, SEM_IF_NOT_0
+        skip = jnp.where(is_op(SEM_IF_NOT_0), val == 0, skip)
+        skip = jnp.where(is_op(SEM_IF_EQU_0), val != 0, skip)
 
     # ---- h-alloc (Inst_MaxAlloc cc:3294 + Allocate_Main cc:1707) ----
     alloc_m0 = is_op(SEM_H_ALLOC)
@@ -437,14 +443,15 @@ def micro_step(params, st, key, exec_mask):
         return tasks_ops.apply_reactions(
             params, env_tables, io_m, logic_id, st.cur_bonus,
             st.cur_task_count, st.cur_reaction_count,
-            st.resources, st.res_grid,
+            st.resources, st.res_grid, st.deme_resources,
             input_buf=st.input_buf, input_buf_n=st.input_buf_n,
-            output=val)[:5]
+            output=val)[:6]
 
-    new_bonus, new_tc, new_rc, resources, res_grid = jax.lax.cond(
+    (new_bonus, new_tc, new_rc, resources, res_grid,
+     deme_resources) = jax.lax.cond(
         io_m.any(), io_block,
         lambda _: (st.cur_bonus, st.cur_task_count, st.cur_reaction_count,
-                   st.resources, st.res_grid),
+                   st.resources, st.res_grid, st.deme_resources),
         None)
     input_ptr = jnp.where(io_m, st.input_ptr + 1, st.input_ptr)
     input_buf = jnp.where(io_m[:, None],
@@ -461,20 +468,21 @@ def micro_step(params, st, key, exec_mask):
     # ---- register writes ----
     res = val
     wrote = jnp.zeros(n, bool)
+    a1m, a2m = (val, val2) if params.hw_type == 3 else (bx, cx)
     for s, v in ((SEM_SHIFT_R, val >> 1), (SEM_SHIFT_L, val << 1),
                  (SEM_INC, val + 1), (SEM_DEC, val - 1),
-                 (SEM_ADD, bx + cx), (SEM_SUB, bx - cx),
-                 (SEM_NAND, ~(bx & cx)), (SEM_POP, pop_val),
+                 (SEM_ADD, a1m + a2m), (SEM_SUB, a1m - a2m),
+                 (SEM_NAND, ~(a1m & a2m)), (SEM_POP, pop_val),
                  (SEM_IO, value_in), (SEM_SWAP, val2)):
         res = jnp.where(is_op(s), v, res)
         wrote = wrote | is_op(s)
 
     def setreg(regs, idx, v, m):
-        oh = (jnp.arange(3)[None, :] == idx[:, None]) & m[:, None]
+        oh = (jnp.arange(NR)[None, :] == idx[:, None]) & m[:, None]
         return jnp.where(oh, v[:, None], regs)
 
     def setreg_c(regs, idx, v, m):  # constant register index
-        oh = (jnp.arange(3)[None, :] == idx) & m[:, None]
+        oh = (jnp.arange(NR)[None, :] == idx) & m[:, None]
         return jnp.where(oh, v[:, None], regs)
 
     regs = setreg(regs0, operand, res, wrote)
@@ -494,6 +502,13 @@ def micro_step(params, st, key, exec_mask):
     regs = setreg_c(regs, 2, search_cx, srch)           # h-search: CX size
     # divide (DIVIDE_METHOD 1): hardware reset -> registers cleared
     regs = jnp.where(div_m[:, None], 0, regs)
+    if params.hw_type == 3:
+        (regs, facing, forage_target,
+         move_won, move_tgt) = _exp_spatial(params, st, sem, operand, val,
+                                            regs, setreg)
+    else:
+        facing, forage_target = st.facing, st.forage_target
+        move_won = None
 
     # ---- head writes ----
     heads = st.heads
@@ -545,11 +560,27 @@ def micro_step(params, st, key, exec_mask):
         ft_paid_lo = jnp.where(div_m, 0, ft_paid_lo)
         ft_paid_hi = jnp.where(div_m, 0, ft_paid_hi)
 
+    # energy model: charge the instruction's energy cost
+    # (cPhenotype::ReduceEnergy via SingleProcess_PayPreCosts energy branch,
+    # cHardwareBase.cc:1241; cPhenotype.cc:1974)
+    energy = st.energy
+    if params.energy_enabled and params.inst_energy_cost:
+        ecost_t = jnp.asarray(params.inst_energy_cost, jnp.float32)
+        charge = jnp.where(exec_mask, ecost_t[jnp.clip(cur_op, 0,
+                                                       num_insts - 1)], 0.0)
+        energy = jnp.maximum(energy - charge, 0.0)
+
     # phenotype DivideReset (cPhenotype.cc:824): merit from size & bonus
     merit_base = _calc_size_merit(params, gsize, st.copied_size, exec_count)
     fdt = st.merit.dtype
     new_merit = merit_base.astype(fdt) * cur_bonus if params.inherit_merit \
         else merit_base.astype(fdt)
+    if params.energy_enabled:
+        # merit = ConvertEnergyToMerit(energy) (cPhenotype.cc:2403); the
+        # parent->child energy split applies at the birth flush (documented
+        # lockstep deviation: the reference splits at ActivateOffspring,
+        # which immediately follows divide)
+        new_merit = convert_energy_to_merit(params, energy).astype(fdt)
     gestation = st.time_used + 1 - st.gestation_start  # +1: this cycle counts
     new_fitness = new_merit / jnp.maximum(gestation, 1).astype(fdt)
 
@@ -564,18 +595,32 @@ def micro_step(params, st, key, exec_mask):
     cur_bonus = jnp.where(div_m, params.default_bonus, cur_bonus)
     cur_task_count = jnp.where(div_m[:, None], 0, cur_task_count)
     cur_reaction_count = jnp.where(div_m[:, None], 0, cur_reaction_count)
-    generation = jnp.where(div_m, st.generation + 1, st.generation)
+    # GENERATION_INC_METHOD 1 (GENERATION_INC_BOTH, default): the parent's
+    # generation also increments at divide (cPhenotype::DivideReset
+    # cc:1052); method 0 increments only the offspring (ops/birth.py)
+    generation = jnp.where(div_m & (params.generation_inc_method == 1),
+                           st.generation + 1, st.generation)
     num_divides = jnp.where(div_m, st.num_divides + 1, st.num_divides)
 
     # ---- time accounting + death (SingleProcess tail, cc:1047-1051) ----
     time_used = st.time_used + exec_mask.astype(jnp.int32)
     cpu_cycles = st.cpu_cycles + exec_mask.astype(jnp.int32)
-    gestation_start = jnp.where(div_m, time_used, st.gestation_start)
+    if params.divide_method != 0:
+        # DIVIDE_METHOD 1/2 (SPLIT/BIRTH): the parent is "a second child" --
+        # its clock fully resets at divide (cPhenotype::DivideReset
+        # cc:1037-1039: gestation_start = cpu_cycles = time_used = 0)
+        time_used = jnp.where(div_m, 0, time_used)
+        cpu_cycles = jnp.where(div_m, 0, cpu_cycles)
+        gestation_start = jnp.where(div_m, 0, st.gestation_start)
+    else:
+        # DIVIDE_METHOD 0: mother untouched; subsequent gestations measure
+        # from the divide point (DivideReset cc:853-854)
+        gestation_start = jnp.where(div_m, time_used, st.gestation_start)
     died = exec_mask & (st.max_executed > 0) & (time_used >= st.max_executed)
     alive = st.alive & ~died
     insts_executed = st.insts_executed + exec_mask.astype(jnp.int32)
 
-    return st.replace(
+    new_st = st.replace(
         tape=tape, mem_len=mem_len,
         regs=regs, heads=heads, stacks=stacks, sp=sp, active_stack=active_stack,
         read_label=read_label, read_label_len=read_label_len,
@@ -597,7 +642,165 @@ def micro_step(params, st, key, exec_mask):
         insts_executed=insts_executed,
         cost_wait=cost_wait, ft_paid_lo=ft_paid_lo, ft_paid_hi=ft_paid_hi,
         resources=resources, res_grid=res_grid,
+        deme_resources=deme_resources,
+        facing=facing, forage_target=forage_target,
+        energy=energy,
     )
+    if params.hw_type == 3:
+        new_st = _apply_moves(new_st, move_won, move_tgt)
+    return new_st
+
+
+# ring of facing directions, clockwise from north (experimental hardware;
+# ref cPopulationCell connection-list rotation order)
+_RING = ((-1, 0), (-1, 1), (0, 1), (1, 1), (1, 0), (1, -1), (0, -1), (-1, -1))
+
+
+def _facing_step(params, rows, facing, dist):
+    """Cell `dist` steps from each row's cell in its facing direction.
+    Returns (cell_id, valid): torus wraps; bounded grids invalidate rays
+    that leave the world."""
+    wx, wy = params.world_x, params.world_y
+    y0 = rows // wx
+    x0 = rows % wx
+    dy = jnp.zeros_like(rows)
+    dx = jnp.zeros_like(rows)
+    for k, (ky, kx) in enumerate(_RING):
+        sel = facing == k
+        dy = jnp.where(sel, ky, dy)
+        dx = jnp.where(sel, kx, dx)
+    y = y0 + dy * dist
+    x = x0 + dx * dist
+    if params.geometry == 2:
+        return (y % wy) * wx + (x % wx), jnp.ones_like(rows, bool)
+    valid = (y >= 0) & (y < wy) & (x >= 0) & (x < wx)
+    return jnp.clip(y, 0, wy - 1) * wx + jnp.clip(x, 0, wx - 1), valid
+
+
+def _exp_spatial(params, st, sem, operand, val, regs, setreg):
+    """Experimental-hardware spatial semantics: rotate-x, rotate-org-id,
+    look-ahead, set-forage-target, and move INTENTS (applied after the
+    state merge by _apply_moves).
+
+    Re-derived from cHardwareExperimental.cc: Inst_RotateX (cc:3441,
+    facing += ?BX? mod 8, result echoed to the register), Inst_RotateOrgID
+    (cc:3489, face the neighbor whose org id -- cell index here -- matches
+    ?BX?), Inst_Move (cc:3138, step into the faced cell; success flag to
+    ?BX?), Inst_SetForageTarget, and GoLook (cc:3895) writing the 8-field
+    sensor result into registers ?BX?..?BX?+7.  The sensor subset
+    implemented: habitat -2 (organism search) along the facing ray,
+    reporting distance / count / id / forage target of the first organism
+    seen (cOrgSensor::FindOrg)."""
+    from avida_tpu.models.experimental import (
+        SEM_LOOK_AHEAD, SEM_MOVE, SEM_ROTATE_ORG_ID, SEM_ROTATE_X,
+        SEM_SET_FORAGE, SEM_ZERO)
+    n = st.alive.shape[0]
+    rows = jnp.arange(n)
+    NR = params.num_registers
+
+    def is_op(x):
+        return sem == x
+
+    # rotate-x: facing += val (mod 8), register echoes the rotation
+    rotx = is_op(SEM_ROTATE_X)
+    facing = jnp.where(rotx, (st.facing + val) % 8, st.facing)
+    regs = setreg(regs, operand, jnp.where(rotx, val % 8, 0), rotx)
+
+    # rotate-org-id: face the ring direction whose neighbor cell holds the
+    # sought organism (org id = cell index)
+    rotid = is_op(SEM_ROTATE_ORG_ID)
+    for k in range(8):
+        nb, valid = _facing_step(params, rows, jnp.full(n, k, jnp.int32),
+                                 jnp.ones_like(rows))
+        hit = rotid & valid & st.alive[nb] & (nb == val)
+        facing = jnp.where(hit, k, facing)
+
+    # set-forage-target
+    setft = is_op(SEM_SET_FORAGE)
+    forage_target = jnp.where(setft, val, st.forage_target)
+
+    # zero ?BX?
+    regs = setreg(regs, operand, jnp.zeros(n, jnp.int32), is_op(SEM_ZERO))
+
+    # look-ahead (gated: the [N, D] ray scan only runs when some lane looks)
+    look = is_op(SEM_LOOK_AHEAD)
+    D = max(params.world_x, params.world_y)
+
+    def ray(_):
+        dists = jnp.arange(1, D + 1)
+        cells, valid = jax.vmap(
+            lambda d: _facing_step(params, rows, facing, d),
+            out_axes=1)(dists)                      # [N, D]
+        occ = st.alive[cells] & valid & (cells != rows[:, None])
+        found = occ.any(axis=1)
+        first = jnp.argmax(occ, axis=1)             # ray index of first org
+        tgt_cell = cells[rows, jnp.clip(first, 0, D - 1)]
+        dist = jnp.where(found, first + 1, -1)
+        return (found, dist, tgt_cell,
+                jnp.where(found, st.forage_target[tgt_cell], -9))
+
+    found, dist, tgt_cell, tgt_ft = jax.lax.cond(
+        look.any(), ray,
+        lambda _: (jnp.zeros(n, bool), jnp.full(n, -1, jnp.int32),
+                   rows, jnp.full(n, -9, jnp.int32)), None)
+    # GoLook register outputs (reg_defs, cc:3910-3918), organism habitat
+    look_out = (jnp.full(n, -2, jnp.int32),                # habitat
+                dist,                                      # distance
+                jnp.zeros(n, jnp.int32),                   # search_type
+                jnp.where(found, tgt_cell, -1),            # id_sought
+                found.astype(jnp.int32),                   # count
+                jnp.zeros(n, jnp.int32),                   # value
+                jnp.full(n, -9, jnp.int32),                # group
+                tgt_ft)                                    # ft
+    for j, ov in enumerate(look_out):
+        regs = setreg(regs, (operand + j) % NR, ov, look)
+
+    # move: intent -> conflict resolution (lowest mover index claims the
+    # faced empty cell; semantics per the birth engine's lockstep rule)
+    move = is_op(SEM_MOVE)
+    mtgt, mvalid = _facing_step(params, rows, facing, jnp.ones_like(rows))
+    intend = move & mvalid & ~st.alive[mtgt] & st.alive
+    BIG = jnp.int32(2**30)
+    claim = jnp.full(n, BIG, jnp.int32)
+    claim = claim.at[jnp.where(intend, mtgt, rows)].min(
+        jnp.where(intend, rows, BIG))
+    won = intend & (claim[mtgt] == rows)
+    regs = setreg(regs, operand, won.astype(jnp.int32), move)
+    return regs, facing, forage_target, won, mtgt
+
+
+# world-level / cell-bound fields that do NOT travel with a moving organism
+_NON_ORG_FIELDS = frozenset({
+    "inputs", "resources", "res_grid", "grad_peak",
+    "bc_mem", "bc_len", "bc_merit", "bc_valid",
+    "deme_birth_count", "deme_age", "germ_mem", "germ_len", "deme_resources",
+
+    "nb_genome", "nb_len", "nb_cell", "nb_parent", "nb_update", "nb_count",
+})
+
+
+def _apply_moves(st, won, target):
+    """Relocate move winners into their target cells: a permutation gather
+    over every organism-bound field (the cell-bound input stream stays).
+    Gated on any move actually happening this cycle."""
+    n = st.alive.shape[0]
+    rows = jnp.arange(n)
+    perm = rows.at[jnp.where(won, target, n)].set(rows, mode="drop")
+    perm = perm.at[jnp.where(won, rows, n)].set(
+        jnp.where(won, target, rows), mode="drop")
+
+    def do(stx):
+        updates = {}
+        for name in stx.__dataclass_fields__:
+            if name in _NON_ORG_FIELDS:
+                continue
+            v = getattr(stx, name)
+            if not hasattr(v, "shape") or v.ndim == 0 or v.shape[0] != n:
+                continue
+            updates[name] = v[perm]
+        return stx.replace(**updates)
+
+    return jax.lax.cond(won.any(), do, lambda x: x, st)
 
 
 def extract_offspring(params, st, key, use_off_tape=False):
@@ -641,6 +844,22 @@ def extract_offspring(params, st, key, use_off_tape=False):
     k_u, k_mpos, k_ipos, k_dpos, k_iinst = jax.random.split(key, 5)
     u_mut = jax.random.uniform(k_u, (n, 3))
     r_inst2 = random_inst(params, k_iinst, (n, 2))
+    # DIV_MUT_PROB: per-SITE substitution rate applied on divide
+    # (cHardwareBase::Divide_DoMutations cc:434: num_mut ~ Binomial(len, p),
+    # each hitting a uniform random site); capped at 8 substitutions per
+    # divide -- the tail beyond 8 is negligible at any sane rate
+    if params.div_mut_prob > 0:
+        k_dm = jax.random.fold_in(key, 0xD1)
+        n_sub = jnp.clip(jax.random.binomial(
+            k_dm, jnp.maximum(off_len, 1).astype(jnp.float32),
+            params.div_mut_prob), 0, 8).astype(jnp.int32)
+        for k in range(8):
+            kk = jax.random.fold_in(k_dm, k + 1)
+            site = jax.random.randint(kk, (n,), 0, jnp.maximum(off_len, 1))
+            rv = random_inst(params, jax.random.fold_in(kk, 3), (n,))
+            do = div_m & (k < n_sub) & (off_len > 0)
+            hit = (cols[None, :] == site[:, None]) & do[:, None]
+            off = jnp.where(hit, rv[:, None].astype(jnp.int8), off)
     # point substitution
     if params.divide_mut_prob > 0:
         mpos = jax.random.randint(k_mpos, (n,), 0, jnp.maximum(off_len, 1))
@@ -739,6 +958,14 @@ def extract_offspring(params, st, key, use_off_tape=False):
                             jnp.where(dele, off_len - size, off_len))
         off = jnp.where(cols[None, :] < off_len[:, None], off, jnp.int8(0))
     return off, off_len
+
+
+def convert_energy_to_merit(params, energy):
+    """cPhenotype::ConvertEnergyToMerit (cPhenotype.cc:2403): 100 x energy
+    / NUM_CYCLES_EXC_BEFORE_0_ENERGY, or a fixed metabolic rate."""
+    if params.fix_metabolic_rate > 0.0:
+        return jnp.full_like(energy, 100.0 * params.fix_metabolic_rate)
+    return 100.0 * energy / max(params.num_cycles_exc, 1)
 
 
 def _calc_size_merit(params, genome_len, copied_size, executed_size):

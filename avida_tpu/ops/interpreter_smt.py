@@ -321,14 +321,15 @@ def micro_step_smt(params, st, key, exec_mask):
         return tasks_ops.apply_reactions(
             params, env_tables, io_host, logic_id, st.cur_bonus,
             st.cur_task_count, st.cur_reaction_count,
-            st.resources, st.res_grid,
+            st.resources, st.res_grid, st.deme_resources,
             input_buf=st.input_buf, input_buf_n=st.input_buf_n,
-            output=value_out)[:5]
+            output=value_out)[:6]
 
-    new_bonus, new_tc, new_rc, resources, res_grid = jax.lax.cond(
+    (new_bonus, new_tc, new_rc, resources, res_grid,
+     deme_resources) = jax.lax.cond(
         io_host.any(), io_block,
         lambda _: (st.cur_bonus, st.cur_task_count, st.cur_reaction_count,
-                   st.resources, st.res_grid), None)
+                   st.resources, st.res_grid, st.deme_resources), None)
     input_ptr = jnp.where(io_m, st.input_ptr + 1, st.input_ptr)
     input_buf = jnp.where(io_m[:, None],
                           jnp.stack([value_in, st.input_buf[:, 0],
@@ -567,6 +568,7 @@ def micro_step_smt(params, st, key, exec_mask):
         exec_mask.astype(jnp.int32),
         alive=alive, insts_executed=insts_executed,
         resources=resources, res_grid=res_grid,
+        deme_resources=deme_resources,
     )
 
 

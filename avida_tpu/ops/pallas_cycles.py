@@ -159,6 +159,10 @@ def eligible(params) -> bool:
         return False
     if params.inst_cost or params.inst_ft_cost:
         return False     # cost engine not implemented in-kernel
+    if params.energy_enabled:
+        return False     # energy store/merit not implemented in-kernel
+    if any(pi >= 0 for pi in getattr(params, "proc_product_idx", ())):
+        return False     # by-products couple organisms through pools
     if any(getattr(params, "task_math_name", ())):
         return False     # in-kernel reactions evaluate logic ids only
     n_i = params.num_insts
@@ -991,8 +995,11 @@ def _make_kernel(params, L, B, num_steps):
             child_copied = jnp.where(div_m, copied_count,
                                      ivec_ref[IV_CHILD_COPIED, :][None, :])
             cur_bonus = jnp.where(div_m, params.default_bonus, cur_bonus)
-            generation = ivec_ref[IV_GENERATION, :][None, :] + \
-                div_m.astype(jnp.int32)
+            # GENERATION_INC_METHOD 1 (default): parent increments too
+            # (cPhenotype::DivideReset cc:1052)
+            gen_inc = (div_m.astype(jnp.int32)
+                       if params.generation_inc_method == 1 else 0)
+            generation = ivec_ref[IV_GENERATION, :][None, :] + gen_inc
             num_divides = ivec_ref[IV_NUM_DIVIDES, :][None, :] + \
                 div_m.astype(jnp.int32)
             off_copied = jnp.where(div_m, copied_count,
@@ -1002,7 +1009,14 @@ def _make_kernel(params, L, B, num_steps):
             time_used = time_used0 + exec_mask.astype(jnp.int32)
             cpu_cycles = ivec_ref[IV_CPU_CYCLES, :][None, :] + \
                 exec_mask.astype(jnp.int32)
-            gest_start = jnp.where(div_m, time_used, gest_start)
+            if params.divide_method != 0:
+                # DIVIDE_METHOD 1/2: parent clock resets at divide
+                # (cPhenotype::DivideReset cc:1037-1039)
+                time_used = jnp.where(div_m, 0, time_used)
+                cpu_cycles = jnp.where(div_m, 0, cpu_cycles)
+                gest_start = jnp.where(div_m, 0, gest_start)
+            else:
+                gest_start = jnp.where(div_m, time_used, gest_start)
             max_exec = ivec_ref[IV_MAX_EXEC, :][None, :]
             died = exec_mask & (max_exec > 0) & (time_used >= max_exec)
             alive = alive & ~died

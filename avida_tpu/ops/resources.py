@@ -175,6 +175,61 @@ def step_spatial(params, res_grid):
     return jnp.maximum(g, 0.0).reshape(R, Y * X)
 
 
+def step_deme(params, deme_resources):
+    """Per-deme pool inflow/outflow (cDeme resource slice; same
+    integration as the global cResourceCount)."""
+    if params.num_deme_res == 0:
+        return deme_resources
+    inflow = jnp.asarray(params.dres_inflow, jnp.float32)[None, :]
+    outflow = jnp.asarray(params.dres_outflow, jnp.float32)[None, :]
+    return (deme_resources + inflow) * (1.0 - outflow)
+
+
+def consume_deme(params, env_tables, rewarded, deme_resources):
+    """Draw-down of deme-bound reaction resources: the global-pool
+    contention rule applied independently inside each deme band (bands are
+    contiguous: deme d = cells [d*cpd, (d+1)*cpd)).
+
+    Returns (amount[N, NR] for deme-bound reactions (0 elsewhere),
+             new_deme_resources[D, Rd])."""
+    NR = rewarded.shape[1]
+    n = rewarded.shape[0]
+    D = max(params.num_demes, 1)
+    cpd = n // D
+    res_idx = env_tables["proc_res_idx"]
+    is_deme = jnp.asarray(params.proc_res_deme, bool)
+    max_num = env_tables["proc_max"]
+    frac = env_tables["proc_frac"]
+    depletable = env_tables["proc_depletable"]
+
+    rw = rewarded.astype(jnp.float32)
+    didx = jnp.clip(res_idx, 0, max(params.num_deme_res - 1, 0))
+    # availability per (org, reaction): the org's deme pool level
+    deme_avail = deme_resources[:, didx]                  # [D, NR]
+    avail = jnp.repeat(deme_avail, cpd, axis=0)           # [N, NR]
+    wanted = jnp.minimum(avail * frac[None, :], max_num[None, :]) * rw
+    wanted = jnp.where(is_deme[None, :], wanted, 0.0)
+
+    onehot = (jnp.arange(max(params.num_deme_res, 1))[:, None]
+              == res_idx[None, :]) & is_deme[None, :]     # [Rd, NR]
+    want_depl = jnp.where(depletable[None, :], wanted, 0.0)
+    # per-deme demand: band-sum then project onto resource rows
+    band = want_depl.reshape(D, cpd, NR).sum(axis=1)      # [D, NR]
+    demand = jnp.einsum("dr,gr->dg", band, onehot.astype(jnp.float32))
+    scale_res = jnp.where(demand > deme_resources,
+                          deme_resources / jnp.maximum(demand, 1e-30), 1.0)
+    scale_rxn = jnp.einsum("dg,gr->dr", scale_res, onehot.astype(jnp.float32))
+    scale_rxn = jnp.where(depletable[None, :] & is_deme[None, :],
+                          scale_rxn, 1.0)                  # [D, NR]
+    got = wanted * jnp.repeat(scale_rxn, cpd, axis=0)
+    drawn = jnp.where(depletable[None, :], got, 0.0)
+    drawn_d = jnp.einsum("dr,gr->dg",
+                         drawn.reshape(D, cpd, NR).sum(axis=1),
+                         onehot.astype(jnp.float32))
+    new_pools = jnp.maximum(deme_resources - drawn_d, 0.0)
+    return got, new_pools
+
+
 def consume(params, env_tables, rewarded, task_quality, resources, res_grid):
     """Resource draw-down for this cycle's rewarded reactions.
 

@@ -182,6 +182,7 @@ def compute_logic_id(input_buf, input_buf_n, output):
 
 def apply_reactions(params, env_tables, io_mask, logic_id, cur_bonus,
                     cur_task_count, cur_reaction_count, resources, res_grid,
+                    deme_resources=None,
                     input_buf=None, input_buf_n=None, output=None):
     """Trigger reactions for organisms performing IO this step.
 
@@ -232,6 +233,25 @@ def apply_reactions(params, env_tables, io_mask, logic_id, cur_bonus,
     # resource consumption -> per-(org, reaction) amounts (1.0 if infinite)
     amount, resources, res_grid = res_ops.consume(
         params, env_tables, rewarded, 1.0, resources, res_grid)
+    if params.num_deme_res and deme_resources is not None:
+        amt_d, deme_resources = res_ops.consume_deme(
+            params, env_tables, rewarded, deme_resources)
+        is_deme = jnp.asarray(params.proc_res_deme, bool)
+        amount = jnp.where(is_deme[None, :], amt_d, amount)
+
+    # by-products: produced = consumed * conversion into the product pool
+    # (DoProcesses cc:1824-1830); gated statically on any product binding
+    prod_idx = tuple(getattr(params, "proc_product_idx", ()))
+    if any(pi >= 0 for pi in prod_idx):
+        conv = jnp.asarray(params.proc_conversion, resources.dtype)
+        produced = jnp.where(rewarded, amount, 0.0) * conv[None, :]
+        for r, pi in enumerate(prod_idx):
+            if pi < 0:
+                continue
+            if params.proc_product_spatial[r]:
+                res_grid = res_grid.at[pi].add(produced[:, r])
+            else:
+                resources = resources.at[pi].add(produced[:, r].sum())
 
     fdt = cur_bonus.dtype
     fval = value[None, :].astype(fdt)
@@ -247,7 +267,7 @@ def apply_reactions(params, env_tables, io_mask, logic_id, cur_bonus,
     new_task_count = cur_task_count + performed.astype(jnp.int32)
     new_reaction_count = cur_reaction_count + rewarded.astype(jnp.int32)
     return (new_bonus, new_task_count, new_reaction_count,
-            resources, res_grid, rewarded.any(axis=1))
+            resources, res_grid, deme_resources, rewarded.any(axis=1))
 
 
 def env_tables_to_device(params):

@@ -64,7 +64,9 @@ def update_step(params, st, key, neighbors, update_no):
 
     # resource dynamics integrate once per update (ops/resources.py)
     st = st.replace(resources=res_ops.step_global(params, st.resources),
-                    res_grid=res_ops.step_spatial(params, st.res_grid))
+                    res_grid=res_ops.step_spatial(params, st.res_grid),
+                    deme_resources=res_ops.step_deme(params,
+                                                     st.deme_resources))
     st = res_ops.step_gradient(params, st, jax.random.fold_in(key, 0x6AD),
                                update_no)
 

@@ -53,7 +53,10 @@ _FIELD_SPECS = {"res_grid": P(None, CELL_AXIS), "resources": P(),
                 # themselves are the sharded axis; deme counters/germlines
                 # ride along)
                 "deme_birth_count": P(), "deme_age": P(),
-                "germ_mem": P(), "germ_len": P()}
+                "germ_mem": P(), "germ_len": P(),
+                "deme_resources": P(),
+                "nb_genome": P(), "nb_len": P(), "nb_cell": P(),
+                "nb_parent": P(), "nb_update": P(), "nb_count": P()}
 
 
 def shard_population(st, mesh: Mesh):

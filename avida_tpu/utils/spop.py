@@ -84,9 +84,13 @@ def load_population(path: str, params, key):
             assert len(seq) == length, f"sequence length mismatch in {path}"
             cells = [int(c) for c in t[17].split(",")]
             offsets = [int(o) for o in t[18].split(",")]
+            parents = t[3]
             for c, off in zip(cells, offsets):
                 orgs.append({"cell": c, "genome": seq, "merit": merit,
-                             "gest_offset": off, "generation": gen_born})
+                             "gest_offset": off, "generation": gen_born,
+                             "id": int(t[0]),
+                             "parent": int(parents.split(",")[0])
+                             if parents not in ("(none)", "") else -1})
     return orgs
 
 
@@ -99,7 +103,9 @@ def restore_population(params, orgs, key, neighbors=None):
     n, L, R = params.num_cells, params.max_memory, params.num_reactions
     st = zeros_population(n, L, R, params.num_global_res,
                           params.num_spatial_res, params.num_demes,
-                          smt=(params.hw_type in (1, 2)))
+                          smt=(params.hw_type in (1, 2)),
+                          num_registers=params.num_registers,
+                          nb_cap=params.nb_cap)
     k_in, key = jax.random.split(key)
     st = st.replace(
         inputs=make_cell_inputs(k_in, n),

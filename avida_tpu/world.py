@@ -17,7 +17,8 @@ import numpy as np
 
 from avida_tpu.config import (AvidaConfig, load_avida_cfg, load_instset,
                               default_instset, heads_sex_instset,
-                              transsmt_instset,
+                              transsmt_instset, experimental_instset,
+                              pred_look_instset,
                               load_organism, load_environment, load_events)
 from avida_tpu.config.environment import default_logic9_environment
 from avida_tpu.config.events import Event, parse_event_line
@@ -34,6 +35,17 @@ _DEFAULT_ANCESTOR_NAMES = (
     + ["nop-C"] * 86
     + ["h-search", "h-copy", "if-label", "nop-C", "nop-A", "h-divide",
        "mov-head", "nop-A", "nop-B"]
+)
+
+# Reference experimental ancestor (support/config/experimental.org):
+# 4-nop hardware, so the copy-loop label is D/A (complement under
+# Rotate(1,4): D->A? no -- C,A in 3-nop space becomes D,A here) and the
+# end label A,B is addressed through the `label` marker instruction.
+_EXPERIMENTAL_ANCESTOR_NAMES = (
+    ["h-alloc", "h-search", "nop-D", "nop-A", "mov-head", "nop-C", "add"]
+    + ["nop-C"] * 81
+    + ["h-search", "h-copy", "if-label", "nop-D", "nop-A", "h-divide",
+       "mov-head", "nop-A", "add", "label", "nop-A", "nop-B"]
 )
 
 # Reference transsmt ancestor (support/config/default-transsmt.org): search
@@ -61,6 +73,8 @@ def default_ancestor(instset) -> np.ndarray:
     name_to_op = {n: i for i, n in enumerate(instset.inst_names)}
     if "Divide" in name_to_op or "Divide-Erase" in name_to_op:
         names = _TRANSSMT_ANCESTOR_NAMES       # transsmt hardware
+    elif "nop-D" in name_to_op and "h-divide" in name_to_op:
+        names = _EXPERIMENTAL_ANCESTOR_NAMES   # 4+-nop experimental
     elif "h-divide" not in name_to_op and "divide-sex" in name_to_op:
         # sexual ancestor: same replicator with divide-sex
         # (ref support/config/default-heads-sex.org)
@@ -95,6 +109,10 @@ class World:
             self.instset = load_instset(os.path.join(config_dir, cfg.INST_SET))
         elif "transsmt" in cfg.INST_SET or "smt" in cfg.INST_SET:
             self.instset = transsmt_instset()
+        elif "pred" in cfg.INST_SET:
+            self.instset = pred_look_instset()
+        elif "experimental" in cfg.INST_SET:
+            self.instset = experimental_instset()
         elif "sex" in cfg.INST_SET:
             self.instset = heads_sex_instset()
         else:
@@ -124,7 +142,12 @@ class World:
 
         self.params = make_world_params(cfg, self.instset, self.environment)
         self.neighbors = jnp.asarray(birth_ops.neighbor_table(
-            cfg.WORLD_X, cfg.WORLD_Y, cfg.WORLD_GEOMETRY))
+            cfg.WORLD_X, cfg.WORLD_Y, cfg.WORLD_GEOMETRY,
+            seed=max(cfg.RANDOM_SEED, 0),
+            scale_free_m=getattr(cfg, "SCALE_FREE_M", 3),
+            scale_free_alpha=getattr(cfg, "SCALE_FREE_ALPHA", 1.0),
+            scale_free_zero_appeal=getattr(cfg, "SCALE_FREE_ZERO_APPEAL",
+                                           0.0)))
 
         seed = cfg.RANDOM_SEED if cfg.RANDOM_SEED >= 0 else int.from_bytes(os.urandom(4), "little")
         self.key = jax.random.key(seed)
@@ -198,20 +221,11 @@ class World:
             self.state = init_population(self.params, genome, k,
                                          inject_cell=cell)
         else:
-            fresh = init_population(self.params, genome, k, inject_cell=cell)
-            c = cell
-            # overwrite only per-organism arrays (cell axis = dim 0);
-            # world-level state (resources, birth-chamber store) is
-            # untouched by an Inject
-            world_fields = {"resources", "res_grid", "grad_peak",
-                            "bc_mem", "bc_len", "bc_merit", "bc_valid"}
-            updates = {
-                name: getattr(self.state, name).at[c].set(
-                    getattr(fresh, name)[c])
-                for name in self.state.__dataclass_fields__
-                if name not in world_fields
-            }
-            self.state = self.state.replace(**updates)
+            # one-row write (cPopulation::Inject semantics): O(1) in world
+            # size, no full-population rebuild
+            from avida_tpu.core.state import seed_organism
+            self.state = seed_organism(self.params, self.state, genome, k,
+                                       cell)
         if self.systematics is not None:
             self.systematics.classify_seed(cell, genome, update=self.update)
 
@@ -620,14 +634,13 @@ class World:
         cat = np.where(child_fit == 0.0, 0,
                        np.where(child_fit < neut_min, 1,
                                 np.where(child_fit <= neut_max, 2, 3)))
-        probs = [self._revert["fatal"], self._revert["neg"],
-                 self._revert["neut"], self._revert["pos"]]
+        probs = np.asarray([self._revert["fatal"], self._revert["neg"],
+                            self._revert["neut"], self._revert["pos"]],
+                           np.float64)                      # [4, 2]
         u = self._revert_rng.random((2, cells.size))
-        want_revert = np.asarray([u[0, i] < probs[cat[i]][0]
-                                  for i in range(cells.size)])
+        want_revert = u[0] < probs[cat, 0]
         revert = want_revert & parent_ok
-        sterilize = np.asarray([u[1, i] < probs[cat[i]][1]
-                                for i in range(cells.size)])
+        sterilize = u[1] < probs[cat, 1]
         # fatal reversions with no parent genome left are refused outright
         kill_fallback = want_revert & ~parent_ok & (cat == 0)
         if not (revert.any() or sterilize.any() or kill_fallback.any()):
@@ -702,25 +715,47 @@ class World:
         return nxt
 
     def _feed_systematics(self):
-        """Hand this update's newborn rows to the host-side phylogeny.
-
-        Only small per-cell vectors plus the gathered newborn genomes cross
-        the device boundary (SURVEY §5: update-granularity transfers only).
-        """
+        """Drain the device-side newborn record buffer into the host
+        phylogeny (chunked-run capable: records carry their update number,
+        so a K-update scan feeds K groups in order -- including newborns
+        that were overwritten later in the chunk, which the old
+        state-scan feed missed).  Overflow (more births than the 2N-record
+        buffer) falls back to a state scan for the window and warns."""
         st = self.state
+        count = int(np.asarray(st.nb_count))
+        cap = st.nb_genome.shape[0]
         alive = np.asarray(st.alive)
-        born = np.asarray(st.birth_update) == self.update
-        cells = np.nonzero(born & alive)[0]
-        if cells.size:
-            idx = jnp.asarray(cells)
-            genomes = np.asarray(st.genome[idx])
-            lens = np.asarray(st.genome_len[idx])
-            parents = np.asarray(st.parent_id[idx])
+        if count > cap:
+            import sys
+            print(f"[avida-tpu] warning: newborn buffer overflow "
+                  f"({count} > {cap}); phylogeny may miss overwritten "
+                  f"newborns this window", file=sys.stderr)
+            count = cap
+        if count:
+            genomes = np.asarray(st.nb_genome[:count])
+            lens = np.asarray(st.nb_len[:count])
+            cells = np.asarray(st.nb_cell[:count])
+            parents = np.asarray(st.nb_parent[:count])
+            updates = np.asarray(st.nb_update[:count])
+            # feed groups in update order (records are already appended in
+            # update order; split on the update column)
+            start = 0
+            for i in range(1, count + 1):
+                if i == count or updates[i] != updates[start]:
+                    u = int(updates[start])
+                    # deaths resolve against the end-of-window occupancy for
+                    # every group (intermediate occupancy is not retained)
+                    self.systematics.process(
+                        u, alive, cells[start:i], genomes[start:i],
+                        lens[start:i], parents[start:i])
+                    start = i
         else:
-            genomes = np.zeros((0, self.params.max_memory), np.int8)
-            lens = parents = np.zeros(0, np.int32)
-        self.systematics.process(self.update, alive, cells, genomes, lens,
-                                 parents)
+            self.systematics.process(
+                self.update, alive, np.zeros(0, np.int64),
+                np.zeros((0, self.params.max_memory), np.int8),
+                np.zeros(0, np.int32), np.zeros(0, np.int32))
+        if count or int(np.asarray(st.nb_count)):
+            self.state = st.replace(nb_count=jnp.zeros((), jnp.int32))
 
     def run(self, max_updates: int | None = None):
         if self.state is None:
@@ -732,7 +767,7 @@ class World:
         # event-free stretches run as one device program; anything needing
         # per-update host work (systematics, generation triggers) forces
         # single stepping
-        can_chunk = (self.systematics is None and not self._revert_on and
+        can_chunk = (not self._revert_on and
                      not any(ev.trigger in ("generation", "births")
                              for ev in self.events))
         while not self._exit:
@@ -746,13 +781,16 @@ class World:
                 due = self._next_event_due()
                 if max_updates is not None:
                     due = min(due, max_updates)
-                gap = int(max(1.0, min(due - self.update, 128.0)))
+                cap_stretch = 128.0 if self.systematics is None else 8.0
+                gap = int(max(1.0, min(due - self.update, cap_stretch)))
                 # power-of-two stretch buckets: at most 8 compiled variants
                 # of the scanned update program instead of one per distinct
                 # gap length
                 stretch = 1 << (gap.bit_length() - 1)
             if stretch > 1:
                 self._pending_exec.append(self.run_updates(stretch))
+                if self.systematics is not None:
+                    self._feed_systematics()
             else:
                 # queue the device vector; host-sync at report boundaries
                 self._pending_exec.append(self.run_update())

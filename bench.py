@@ -40,7 +40,7 @@ def build(world_x, world_y, max_memory, seed):
     # and re-granted, preserving long-run merit proportionality.  The
     # DEFAULT config is uncapped = reference-faithful scheduling; the
     # bench opts into the cap (BENCH_CAP env overrides; 0 = uncapped).
-    cfg.TPU_MAX_STEPS_PER_UPDATE = int(os.environ.get("BENCH_CAP", "45"))
+    cfg.TPU_MAX_STEPS_PER_UPDATE = int(os.environ.get("BENCH_CAP", "30"))
     w = World(cfg=cfg)
     anc = default_ancestor(w.instset)
 

@@ -1,0 +1,51 @@
+// apto-shim (see platform.h header note) -- umbrella header
+#ifndef AptoCore_h
+#define AptoCore_h
+
+#include "platform.h"
+#include "core/Definitions.h"
+#include "core/Algorithms.h"
+#include "core/Array.h"
+#include "core/FileSystem.h"
+#include "core/Functor.h"
+#include "core/List.h"
+#include "core/Map.h"
+#include "core/Mutex.h"
+#include "core/Pair.h"
+#include "core/Set.h"
+#include "core/SmartPtr.h"
+#include "core/String.h"
+#include "core/StringBuffer.h"
+#include "core/StringUtils.h"
+#include "core/Thread.h"
+#include "core/TypeList.h"
+#include "scheduler.h"
+
+namespace Apto {
+
+// 2-D coordinate (apto/core/Coord.h upstream)
+template <class T>
+class Coord
+{
+public:
+  T x;
+  T y;
+  Coord() : x(0), y(0) {}
+  Coord(T in_x, T in_y) : x(in_x), y(in_y) {}
+  bool operator==(const Coord& rhs) const { return x == rhs.x && y == rhs.y; }
+  bool operator!=(const Coord& rhs) const { return !(*this == rhs); }
+  Coord operator+(const Coord& rhs) const { return Coord(x + rhs.x, y + rhs.y); }
+  Coord operator-(const Coord& rhs) const { return Coord(x - rhs.x, y - rhs.y); }
+  Coord operator*(T s) const { return Coord(x * s, y * s); }
+  Coord& operator+=(const Coord& rhs) { x += rhs.x; y += rhs.y; return *this; }
+  Coord& operator-=(const Coord& rhs) { x -= rhs.x; y -= rhs.y; return *this; }
+  void Set(T in_x, T in_y) { x = in_x; y = in_y; }
+  T& X() { return x; }
+  T& Y() { return y; }
+  T X() const { return x; }
+  T Y() const { return y; }
+};
+
+}  // namespace Apto
+
+#endif

@@ -1,0 +1,51 @@
+// apto-shim (see platform.h header note)
+#ifndef AptoCoreAlgorithms_h
+#define AptoCoreAlgorithms_h
+
+#include "Array.h"
+
+#include <algorithm>
+#include <vector>
+
+namespace Apto {
+
+template <class T> inline T Abs(const T& v) { return (v < T(0)) ? -v : v; }
+
+template <class T> inline const T& Min(const T& a, const T& b)
+{ return (b < a) ? b : a; }
+template <class T> inline const T& Max(const T& a, const T& b)
+{ return (a < b) ? b : a; }
+
+// QSort over an Apto::Array range [from, to] (inclusive, upstream API).
+template <class T, template <class> class P>
+inline void QSort(Array<T, P>& array, int from, int to)
+{
+  if (from < 0 || to >= array.GetSize() || from >= to) return;
+  // simple in-place sort via std::sort on a copy window
+  std::vector<T> tmp;
+  tmp.reserve(to - from + 1);
+  for (int i = from; i <= to; i++) tmp.push_back(array[i]);
+  std::sort(tmp.begin(), tmp.end());
+  for (int i = from; i <= to; i++) array[i] = tmp[i - from];
+}
+
+// QSort with an int comparator functor (negative = less-than)
+template <class T, template <class> class P, class Cmp>
+inline void QSort(Array<T, P>& array, Cmp comparator)
+{
+  std::vector<T> tmp;
+  tmp.reserve(array.GetSize());
+  for (int i = 0; i < array.GetSize(); i++) tmp.push_back(array[i]);
+  std::stable_sort(tmp.begin(), tmp.end(),
+                   [&comparator](const T& a, const T& b)
+                   { return comparator(a, b) < 0; });
+  for (int i = 0; i < array.GetSize(); i++) array[i] = tmp[i];
+}
+
+template <class T, template <class> class P>
+inline void QSort(Array<T, P>& array)
+{ QSort(array, 0, array.GetSize() - 1); }
+
+}  // namespace Apto
+
+#endif

@@ -1,0 +1,266 @@
+// apto-shim (see platform.h header note)
+#ifndef AptoCoreArray_h
+#define AptoCoreArray_h
+
+#include "Definitions.h"
+
+#include <algorithm>
+
+namespace Apto {
+
+// Apto::Array<T, StoragePolicy> -- dynamic array.  The upstream policies
+// (Basic/Smart/ManagedPointer) change growth/ownership strategy; the shim
+// backs every policy with one plain heap buffer (NOT std::vector: the
+// vector<bool> proxy specialization breaks `bool&` references that
+// avida-core takes into arrays).
+template <class T, template <class> class Policy = Basic>
+class Array
+{
+private:
+  T* m_data;
+  int m_size;
+  int m_cap;
+
+  void grow(int need)
+  {
+    if (need <= m_cap) return;
+    int cap = (m_cap > 0) ? m_cap : 4;
+    while (cap < need) cap *= 2;
+    T* nd = new T[cap];
+    for (int i = 0; i < m_size; i++) nd[i] = m_data[i];
+    delete[] m_data;
+    m_data = nd;
+    m_cap = cap;
+  }
+
+public:
+  typedef T ValueType;
+
+  Array() : m_data(NULL), m_size(0), m_cap(0) {}
+  explicit Array(int size) : m_data(NULL), m_size(0), m_cap(0)
+  { Resize(size); }
+  Array(int size, const T& init) : m_data(NULL), m_size(0), m_cap(0)
+  { Resize(size, init); }
+  Array(const Array& rhs) : m_data(NULL), m_size(0), m_cap(0) { *this = rhs; }
+  template <template <class> class P2>
+  Array(const Array<T, P2>& rhs) : m_data(NULL), m_size(0), m_cap(0)
+  { *this = rhs; }
+  ~Array() { delete[] m_data; }
+
+  template <template <class> class P2>
+  Array& operator=(const Array<T, P2>& rhs)
+  {
+    ResizeClear(rhs.GetSize());
+    for (int i = 0; i < m_size; i++) m_data[i] = rhs[i];
+    return *this;
+  }
+  Array& operator=(const Array& rhs)
+  {
+    if (this == &rhs) return *this;
+    ResizeClear(rhs.GetSize());
+    for (int i = 0; i < m_size; i++) m_data[i] = rhs.m_data[i];
+    return *this;
+  }
+
+  inline int GetSize() const { return m_size; }
+
+  inline void ResizeClear(const int in_size)
+  {
+    delete[] m_data;
+    m_data = NULL;
+    m_size = m_cap = 0;
+    Resize(in_size);
+  }
+  inline void Resize(int new_size)
+  {
+    if (new_size < 0) new_size = 0;
+    if (new_size > m_size) {
+      grow(new_size);
+      for (int i = m_size; i < new_size; i++) m_data[i] = T();
+    }
+    m_size = new_size;
+  }
+  inline void Resize(int new_size, const T& empty_value)
+  {
+    int old = m_size;
+    Resize(new_size);
+    for (int i = old; i < m_size; i++) m_data[i] = empty_value;
+  }
+
+  T& operator[](const int index)
+  {
+    assert(index >= 0 && index < m_size);
+    return m_data[index];
+  }
+  const T& operator[](const int index) const
+  {
+    assert(index >= 0 && index < m_size);
+    return m_data[index];
+  }
+
+  inline T& Get(const int index) { return (*this)[index]; }
+  inline const T& Get(const int index) const { return (*this)[index]; }
+
+  inline void Push(const T& value)
+  {
+    grow(m_size + 1);
+    m_data[m_size++] = value;
+  }
+  inline T Pop()
+  {
+    T v = m_data[m_size - 1];
+    m_size--;
+    return v;
+  }
+
+  inline void Swap(int idx1, int idx2)
+  { std::swap(m_data[idx1], m_data[idx2]); }
+  inline void Swap(Array& rhs)
+  {
+    std::swap(m_data, rhs.m_data);
+    std::swap(m_size, rhs.m_size);
+    std::swap(m_cap, rhs.m_cap);
+  }
+
+  Array operator+(const Array& rhs) const
+  {
+    Array out(*this);
+    for (int i = 0; i < rhs.GetSize(); i++) out.Push(rhs[i]);
+    return out;
+  }
+
+  inline void SetAll(const T& value)
+  { for (int i = 0; i < m_size; i++) m_data[i] = value; }
+
+  inline void Clear() { m_size = 0; }
+  inline void SetReserve(int reserve) { grow(reserve); }
+
+  inline void RemoveAt(int index)
+  {
+    for (int i = index; i < m_size - 1; i++) m_data[i] = m_data[i + 1];
+    m_size--;
+  }
+
+  // Range view [from, to] inclusive (upstream Array::Range) -- enough API
+  // for the cTopology builders: GetSize + operator[]
+  class RangeView
+  {
+  private:
+    Array* m_arr;
+    int m_from;
+    int m_size;
+  public:
+    RangeView(Array* arr, int from, int to)
+      : m_arr(arr), m_from(from), m_size(to - from + 1) {}
+    int GetSize() const { return m_size; }
+    T& operator[](int i) { return (*m_arr)[m_from + i]; }
+    const T& operator[](int i) const { return (*m_arr)[m_from + i]; }
+    RangeView Range(int from, int to)
+    { return RangeView(m_arr, m_from + from, m_from + to); }
+  };
+  RangeView Range(int from, int to) { return RangeView(this, from, to); }
+
+  // iterator API (upstream exposes Iterator/ConstIterator with
+  // Next()/Get() protocol)
+  class Iterator
+  {
+  private:
+    Array& m_arr;
+    int m_index;
+  public:
+    explicit Iterator(Array& arr) : m_arr(arr), m_index(-1) {}
+    T* Get() { return (m_index >= 0 && m_index < m_arr.GetSize()) ? &m_arr[m_index] : NULL; }
+    T* Next() { m_index++; return Get(); }
+  };
+  class ConstIterator
+  {
+  private:
+    const Array& m_arr;
+    int m_index;
+  public:
+    explicit ConstIterator(const Array& arr) : m_arr(arr), m_index(-1) {}
+    const T* Get() { return (m_index >= 0 && m_index < m_arr.GetSize()) ? &m_arr[m_index] : NULL; }
+    const T* Next() { m_index++; return Get(); }
+  };
+  Iterator Begin() { return Iterator(*this); }
+  ConstIterator Begin() const { return ConstIterator(*this); }
+};
+
+// ManagedPointer storage: elements live behind stable heap pointers and
+// are never copied/assigned -- required for types with private assignment
+// (e.g. hardware Thread classes).  Grow/shrink moves pointers only.
+template <class T>
+class Array<T, ManagedPointer>
+{
+private:
+  T** m_ptrs;
+  int m_size;
+  int m_cap;
+
+  void grow(int need)
+  {
+    if (need <= m_cap) return;
+    int cap = (m_cap > 0) ? m_cap : 4;
+    while (cap < need) cap *= 2;
+    T** np_ = new T*[cap];
+    for (int i = 0; i < m_size; i++) np_[i] = m_ptrs[i];
+    delete[] m_ptrs;
+    m_ptrs = np_;
+    m_cap = cap;
+  }
+
+public:
+  typedef T ValueType;
+
+  Array() : m_ptrs(NULL), m_size(0), m_cap(0) {}
+  explicit Array(int size) : m_ptrs(NULL), m_size(0), m_cap(0)
+  { Resize(size); }
+  ~Array()
+  {
+    for (int i = 0; i < m_size; i++) delete m_ptrs[i];
+    delete[] m_ptrs;
+  }
+
+  inline int GetSize() const { return m_size; }
+
+  inline void Resize(int new_size)
+  {
+    if (new_size < 0) new_size = 0;
+    for (int i = new_size; i < m_size; i++) delete m_ptrs[i];
+    grow(new_size);
+    for (int i = m_size; i < new_size; i++) m_ptrs[i] = new T();
+    m_size = new_size;
+  }
+  inline void ResizeClear(const int in_size)
+  {
+    for (int i = 0; i < m_size; i++) delete m_ptrs[i];
+    m_size = 0;
+    Resize(in_size);
+  }
+
+  inline void Push(const T& value)
+  {
+    grow(m_size + 1);
+    m_ptrs[m_size] = new T(value);
+    m_size++;
+  }
+
+  T& operator[](const int index)
+  {
+    assert(index >= 0 && index < m_size);
+    return *m_ptrs[index];
+  }
+  const T& operator[](const int index) const
+  {
+    assert(index >= 0 && index < m_size);
+    return *m_ptrs[index];
+  }
+
+private:
+  Array(const Array&);
+  Array& operator=(const Array&);
+};
+
+}  // namespace Apto
+
+#endif

@@ -1,0 +1,5 @@
+// apto-shim (see platform.h header note)
+#ifndef AptoCoreConditionVariable_h
+#define AptoCoreConditionVariable_h
+#include "Mutex.h"
+#endif

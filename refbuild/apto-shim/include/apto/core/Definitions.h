@@ -1,0 +1,44 @@
+// apto-shim (see platform.h header note)
+#ifndef AptoCoreDefinitions_h
+#define AptoCoreDefinitions_h
+
+#include "../platform.h"
+
+namespace Apto {
+
+class NullType {};
+struct EmptyType {};
+
+// --- container inner-storage policies (tag types; the shim's containers
+// all use the same std-backed storage, the tags only select defaults) ---
+template <class T> class Basic;
+template <class T> class Smart;
+template <class T> class ManagedPointer;
+
+// --- map/set storage-policy tags: template <Key, Value> class ---
+template <class K, class V> class DefaultHashBTree {};
+template <class K, class V> class HashBTree {};
+// hash-table storage with static table size + hash functor + allocator
+// (inherited from by avida-core property-map storage helpers)
+template <class K, class V, int TableSize,
+          template <class, int> class HashF, class Alloc>
+class HashStaticTableLinkedList {};
+// primary hash functor; avida-core specializes this for its own key types
+template <class T, int HashFactor> class HashKey
+{
+public:
+  static int Hash(const T&) { return 0; }
+};
+
+// --- Map defaults-policy tags ---
+class ImplicitDefault {};
+class ExplicitDefault {};
+class Multi {};
+
+// --- multithreading policy tags for ref counting ---
+class ThreadSafe;
+class SingleThreaded;
+
+}  // namespace Apto
+
+#endif

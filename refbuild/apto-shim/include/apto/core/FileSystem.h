@@ -1,0 +1,103 @@
+// apto-shim (see platform.h header note)
+#ifndef AptoCoreFileSystem_h
+#define AptoCoreFileSystem_h
+
+#include "String.h"
+
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <dirent.h>
+#include <unistd.h>
+#include <cstdio>
+
+namespace Apto {
+namespace FileSystem {
+
+inline String PathAppend(const String& path, const String& path_add)
+{
+  return path + "/" + path_add;
+}
+
+inline String GetCWD()
+{
+  char buf[4096];
+  if (getcwd(buf, sizeof(buf))) return String(buf);
+  return String(".");
+}
+
+inline String GetAbsolutePath(const String& path, const String& working_dir)
+{
+  if (path.GetSize() == 0) return working_dir;
+  if (path[0] == '/') return path;
+  return PathAppend(working_dir, path);
+}
+
+inline bool IsFile(const String& path)
+{
+  struct stat st;
+  return stat((const char*)path, &st) == 0 && S_ISREG(st.st_mode);
+}
+
+inline bool IsDir(const String& path)
+{
+  struct stat st;
+  return stat((const char*)path, &st) == 0 && S_ISDIR(st.st_mode);
+}
+
+inline bool MkDir(const String& path)
+{
+  if (IsDir(path)) return true;
+  return mkdir((const char*)path, 0777) == 0;
+}
+
+inline bool RmDir(const String& path, bool recursive = false)
+{
+  if (!recursive) return rmdir((const char*)path) == 0;
+  DIR* d = opendir((const char*)path);
+  if (d) {
+    struct dirent* e;
+    while ((e = readdir(d))) {
+      String name(e->d_name);
+      if (name == "." || name == "..") continue;
+      String sub = PathAppend(path, name);
+      if (IsDir(sub)) RmDir(sub, true);
+      else unlink((const char*)sub);
+    }
+    closedir(d);
+  }
+  return rmdir((const char*)path) == 0;
+}
+
+inline bool CpFile(const String& from, const String& to)
+{
+  FILE* in = fopen((const char*)from, "rb");
+  if (!in) return false;
+  FILE* out = fopen((const char*)to, "wb");
+  if (!out) { fclose(in); return false; }
+  char buf[65536];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof(buf), in)) > 0) fwrite(buf, 1, n, out);
+  fclose(in);
+  fclose(out);
+  return true;
+}
+
+template <class ArrayT>
+inline bool ReadDir(const String& path, ArrayT& entries)
+{
+  DIR* d = opendir((const char*)path);
+  if (!d) return false;
+  struct dirent* e;
+  while ((e = readdir(d))) {
+    String name(e->d_name);
+    if (name == "." || name == "..") continue;
+    entries.Push(name);
+  }
+  closedir(d);
+  return true;
+}
+
+}  // namespace FileSystem
+}  // namespace Apto
+
+#endif

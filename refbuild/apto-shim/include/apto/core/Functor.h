@@ -1,0 +1,78 @@
+// apto-shim (see platform.h header note)
+#ifndef AptoCoreFunctor_h
+#define AptoCoreFunctor_h
+
+#include "Definitions.h"
+#include "TypeList.h"
+
+#include <functional>
+#include <type_traits>
+#include <utility>
+
+namespace Apto {
+
+namespace Internal {
+// Map the typelist parameter (TL::Create<...> or NullType) to an argument
+// pack via std::function.
+template <class R, class TList> struct FunctorType;
+template <class R> struct FunctorType<R, NullType>
+{ typedef std::function<R()> Type; };
+template <class R, class... Ts> struct FunctorType<R, TL::Create<Ts...> >
+{ typedef std::function<R(Ts...)> Type; };
+}  // namespace Internal
+
+// Apto::Functor<ReturnType, TypeListOfArgs> -- callable wrapper accepting
+// free functions, (object ptr, member fn ptr), lambdas and other functors.
+template <class R, class TList = NullType, class Alloc = NullType>
+class Functor
+{
+public:
+  typedef typename Internal::FunctorType<R, TList>::Type FnType;
+
+private:
+  FnType m_fn;
+
+public:
+  Functor() {}
+  Functor(const FnType& fn) : m_fn(fn) {}
+  template <class F> Functor(F fn) : m_fn(fn) {}
+  template <class Obj, class R2, class... As>
+  Functor(Obj* obj, R2 (Obj::*fn)(As...))
+  { m_fn = [obj, fn](As... args) -> R { return (obj->*fn)(args...); }; }
+  template <class Obj, class R2, class... As>
+  Functor(Obj* obj, R2 (Obj::*fn)(As...) const)
+  { m_fn = [obj, fn](As... args) -> R { return (obj->*fn)(args...); }; }
+  template <class Obj, class R2, class... As>
+  Functor(const Obj* obj, R2 (Obj::*fn)(As...) const)
+  { m_fn = [obj, fn](As... args) -> R { return (obj->*fn)(args...); }; }
+
+  template <class... A> R operator()(A&&... args) const
+  { return m_fn(std::forward<A>(args)...); }
+
+  operator bool() const { return (bool)m_fn; }
+  const FnType& Fn() const { return m_fn; }
+};
+
+// BindFirst: curry the first argument of a functor.  The bound value is
+// captured by DECAYED copy (upstream binds a copy too), so reference-typed
+// first parameters (const int&) accept plain values.
+template <class R, class T1, class V>
+Functor<R, NullType> BindFirst(const Functor<R, TL::Create<T1> >& f, V v)
+{
+  typename Functor<R, TL::Create<T1> >::FnType fn = f.Fn();
+  typename std::decay<V>::type bound = v;
+  return Functor<R, NullType>([fn, bound]() -> R { return fn(bound); });
+}
+template <class R, class T1, class... Rest, class V>
+Functor<R, TL::Create<Rest...> >
+BindFirst(const Functor<R, TL::Create<T1, Rest...> >& f, V v)
+{
+  typename Functor<R, TL::Create<T1, Rest...> >::FnType fn = f.Fn();
+  typename std::decay<V>::type bound = v;
+  return Functor<R, TL::Create<Rest...> >(
+    [fn, bound](Rest... rest) -> R { return fn(bound, rest...); });
+}
+
+}  // namespace Apto
+
+#endif

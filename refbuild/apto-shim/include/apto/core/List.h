@@ -1,0 +1,152 @@
+// apto-shim (see platform.h header note)
+#ifndef AptoCoreList_h
+#define AptoCoreList_h
+
+#include "Definitions.h"
+
+#include <list>
+#include <algorithm>
+
+namespace Apto {
+
+// storage-policy tags for List
+template <class T> class DL;         // doubly-linked (default upstream)
+template <class T> class SparseVector;
+
+// Apto::List<T, StoragePolicy> -- std::list-backed for every policy.
+template <class T, template <class> class Policy = DL>
+class List
+{
+private:
+  std::list<T> m_list;
+
+public:
+  typedef T ValueType;
+
+  List() {}
+
+  inline int GetSize() const { return (int)m_list.size(); }
+  inline void Clear() { m_list.clear(); }
+
+  inline T& GetFirst() { return m_list.front(); }
+  inline const T& GetFirst() const { return m_list.front(); }
+  inline T& GetLast() { return m_list.back(); }
+  inline const T& GetLast() const { return m_list.back(); }
+
+  // Entry handles: O(1) removal tokens handed out by Push/PushRear
+  // (upstream apto/core/List.h SparseVector interface)
+  class EntryHandle
+  {
+    friend class List;
+  private:
+    List* m_list;
+    typename std::list<T>::iterator m_it;
+    bool m_valid;
+  public:
+    EntryHandle() : m_list(NULL), m_valid(false) {}
+    bool IsValid() const { return m_valid; }
+    void Remove()
+    {
+      if (m_valid && m_list) m_list->m_list.erase(m_it);
+      m_valid = false;
+    }
+  };
+
+  inline void Push(const T& value) { m_list.push_front(value); }
+  inline void PushRear(const T& value) { m_list.push_back(value); }
+  inline void Push(const T& value, EntryHandle** handle)
+  {
+    m_list.push_front(value);
+    *handle = new EntryHandle();
+    (*handle)->m_list = this;
+    (*handle)->m_it = m_list.begin();
+    (*handle)->m_valid = true;
+  }
+  inline void PushRear(const T& value, EntryHandle** handle)
+  {
+    m_list.push_back(value);
+    *handle = new EntryHandle();
+    (*handle)->m_list = this;
+    (*handle)->m_it = --m_list.end();
+    (*handle)->m_valid = true;
+  }
+  inline T Pop() { T v = m_list.front(); m_list.pop_front(); return v; }
+  inline T PopRear() { T v = m_list.back(); m_list.pop_back(); return v; }
+
+  bool Remove(const T& value)
+  {
+    typename std::list<T>::iterator it =
+      std::find(m_list.begin(), m_list.end(), value);
+    if (it == m_list.end()) return false;
+    m_list.erase(it);
+    return true;
+  }
+  bool Contains(const T& value) const
+  {
+    return std::find(m_list.begin(), m_list.end(), value) != m_list.end();
+  }
+
+  template <template <class> class P2>
+  List& operator=(const List<T, P2>& rhs)
+  {
+    m_list.assign(rhs.Std().begin(), rhs.Std().end());
+    return *this;
+  }
+
+  const std::list<T>& Std() const { return m_list; }
+  std::list<T>& Std() { return m_list; }
+
+  class Iterator
+  {
+  private:
+    std::list<T>* m_list;
+    typename std::list<T>::iterator m_it;
+    bool m_started;
+  public:
+    Iterator() : m_list(NULL), m_started(false) {}
+    explicit Iterator(List& list)
+      : m_list(&list.m_list), m_started(false) {}
+    T* Get()
+    {
+      if (!m_started || !m_list || m_it == m_list->end()) return NULL;
+      return &*m_it;
+    }
+    T* Next()
+    {
+      if (!m_list) return NULL;
+      if (!m_started) { m_it = m_list->begin(); m_started = true; }
+      else if (m_it != m_list->end()) ++m_it;
+      return Get();
+    }
+  };
+  class ConstIterator
+  {
+  private:
+    const std::list<T>* m_list;
+    typename std::list<T>::const_iterator m_it;
+    bool m_started;
+  public:
+    ConstIterator() : m_list(NULL), m_started(false) {}
+    explicit ConstIterator(const List& list)
+      : m_list(&list.m_list), m_started(false) {}
+    const T* Get()
+    {
+      if (!m_started || !m_list || m_it == m_list->end()) return NULL;
+      return &*m_it;
+    }
+    const T* Next()
+    {
+      if (!m_list) return NULL;
+      if (!m_started) { m_it = m_list->begin(); m_started = true; }
+      else if (m_it != m_list->end()) ++m_it;
+      return Get();
+    }
+  };
+
+  Iterator Begin() { return Iterator(*this); }
+  ConstIterator Begin() const { return ConstIterator(*this); }
+};
+
+}  // namespace Apto
+
+#endif

@@ -1,0 +1,140 @@
+// apto-shim (see platform.h header note)
+#ifndef AptoCoreMap_h
+#define AptoCoreMap_h
+
+#include "Definitions.h"
+#include "Pair.h"
+
+#include <map>
+
+namespace Apto {
+
+// Apto::Map<K, V, HashPolicy, EntryPolicy> -- backed by std::map (ordered;
+// upstream's HashBTree is also ordered-ish for iteration stability).
+template <class K, class V,
+          template <class, class> class StoragePolicy = DefaultHashBTree,
+          class DefaultsPolicy = ImplicitDefault>
+class Map
+{
+private:
+  typedef std::map<K, V> StdMap;
+  StdMap m_map;
+
+public:
+  typedef K KeyType;
+  typedef V ValueType;
+
+  Map() {}
+
+  inline int GetSize() const { return (int)m_map.size(); }
+
+  inline void Clear() { m_map.clear(); }
+
+  // operator[] inserts default (upstream Get(key) semantics)
+  V& operator[](const K& key) { return m_map[key]; }
+  const V& operator[](const K& key) const { return Get(key); }
+
+  V& Get(const K& key) { return m_map[key]; }
+  const V& Get(const K& key) const
+  {
+    static V s_default = V();
+    typename StdMap::const_iterator it = m_map.find(key);
+    return (it == m_map.end()) ? s_default : it->second;
+  }
+  bool Get(const K& key, V& out) const
+  {
+    typename StdMap::const_iterator it = m_map.find(key);
+    if (it == m_map.end()) return false;
+    out = it->second;
+    return true;
+  }
+  V GetWithDefault(const K& key, const V& default_value) const
+  {
+    typename StdMap::const_iterator it = m_map.find(key);
+    return (it == m_map.end()) ? default_value : it->second;
+  }
+  inline void Set(const K& key, const V& value) { m_map[key] = value; }
+
+  bool Has(const K& key) const { return m_map.find(key) != m_map.end(); }
+  bool Remove(const K& key) { return m_map.erase(key) > 0; }
+
+  bool operator==(const Map& rhs) const { return m_map == rhs.m_map; }
+  bool operator!=(const Map& rhs) const { return !(*this == rhs); }
+
+  class KeyIterator
+  {
+  private:
+    StdMap* m_map;
+    typename StdMap::iterator m_it;
+    bool m_started;
+  public:
+    explicit KeyIterator(StdMap& map) : m_map(&map), m_started(false) {}
+    const K* Get()
+    {
+      if (!m_started || m_it == m_map->end()) return NULL;
+      return &m_it->first;
+    }
+    const K* Next()
+    {
+      if (!m_started) { m_it = m_map->begin(); m_started = true; }
+      else if (m_it != m_map->end()) ++m_it;
+      return Get();
+    }
+  };
+
+  class ValueIterator
+  {
+  private:
+    StdMap* m_map;
+    typename StdMap::iterator m_it;
+    bool m_started;
+  public:
+    explicit ValueIterator(StdMap& map) : m_map(&map), m_started(false) {}
+    V* Get()
+    {
+      if (!m_started || m_it == m_map->end()) return NULL;
+      return &m_it->second;
+    }
+    V* Next()
+    {
+      if (!m_started) { m_it = m_map->begin(); m_started = true; }
+      else if (m_it != m_map->end()) ++m_it;
+      return Get();
+    }
+  };
+
+  class Iterator
+  {
+  private:
+    StdMap* m_map;
+    typename StdMap::iterator m_it;
+    bool m_started;
+    Pair<K, V*> m_cur;
+  public:
+    explicit Iterator(StdMap& map) : m_map(&map), m_started(false) {}
+    Pair<K, V*>* Get()
+    {
+      if (!m_started || m_it == m_map->end()) return NULL;
+      m_cur = Pair<K, V*>(m_it->first, &m_it->second);
+      return &m_cur;
+    }
+    Pair<K, V*>* Next()
+    {
+      if (!m_started) { m_it = m_map->begin(); m_started = true; }
+      else if (m_it != m_map->end()) ++m_it;
+      return Get();
+    }
+  };
+  typedef Iterator ConstIterator;
+
+  KeyIterator Keys() { return KeyIterator(m_map); }
+  KeyIterator Keys() const { return KeyIterator(const_cast<StdMap&>(m_map)); }
+  ValueIterator Values() { return ValueIterator(m_map); }
+  ValueIterator Values() const { return ValueIterator(const_cast<StdMap&>(m_map)); }
+  Iterator Begin() { return Iterator(m_map); }
+  Iterator Begin() const { return Iterator(const_cast<StdMap&>(m_map)); }
+};
+
+}  // namespace Apto
+
+#endif

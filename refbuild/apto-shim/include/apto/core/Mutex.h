@@ -1,0 +1,80 @@
+// apto-shim (see platform.h header note)
+#ifndef AptoCoreMutex_h
+#define AptoCoreMutex_h
+
+#include "Definitions.h"
+
+#include <pthread.h>
+
+namespace Apto {
+
+class Mutex
+{
+  friend class ConditionVariable;
+private:
+  pthread_mutex_t m_mutex;
+  Mutex(const Mutex&);
+  Mutex& operator=(const Mutex&);
+public:
+  Mutex() { pthread_mutex_init(&m_mutex, NULL); }
+  ~Mutex() { pthread_mutex_destroy(&m_mutex); }
+  void Lock() { pthread_mutex_lock(&m_mutex); }
+  void Unlock() { pthread_mutex_unlock(&m_mutex); }
+};
+
+class MutexAutoLock
+{
+private:
+  Mutex& m_mutex;
+  MutexAutoLock(const MutexAutoLock&);
+public:
+  explicit MutexAutoLock(Mutex& mutex) : m_mutex(mutex) { m_mutex.Lock(); }
+  ~MutexAutoLock() { m_mutex.Unlock(); }
+};
+
+class ConditionVariable
+{
+private:
+  pthread_cond_t m_cond;
+public:
+  ConditionVariable() { pthread_cond_init(&m_cond, NULL); }
+  ~ConditionVariable() { pthread_cond_destroy(&m_cond); }
+  void Wait(Mutex& mutex) { pthread_cond_wait(&m_cond, &mutex.m_mutex); }
+  void Signal() { pthread_cond_signal(&m_cond); }
+  void Broadcast() { pthread_cond_broadcast(&m_cond); }
+};
+
+class RWLock
+{
+private:
+  pthread_rwlock_t m_lock;
+public:
+  RWLock() { pthread_rwlock_init(&m_lock, NULL); }
+  ~RWLock() { pthread_rwlock_destroy(&m_lock); }
+  void ReadLock() { pthread_rwlock_rdlock(&m_lock); }
+  void ReadUnlock() { pthread_rwlock_unlock(&m_lock); }
+  void WriteLock() { pthread_rwlock_wrlock(&m_lock); }
+  void WriteUnlock() { pthread_rwlock_unlock(&m_lock); }
+};
+
+class RWLockAutoRead
+{
+private:
+  RWLock& m_lock;
+public:
+  explicit RWLockAutoRead(RWLock& lock) : m_lock(lock) { m_lock.ReadLock(); }
+  ~RWLockAutoRead() { m_lock.ReadUnlock(); }
+};
+
+class RWLockAutoWrite
+{
+private:
+  RWLock& m_lock;
+public:
+  explicit RWLockAutoWrite(RWLock& lock) : m_lock(lock) { m_lock.WriteLock(); }
+  ~RWLockAutoWrite() { m_lock.WriteUnlock(); }
+};
+
+}  // namespace Apto
+
+#endif

@@ -1,0 +1,35 @@
+// apto-shim (see platform.h header note)
+#ifndef AptoCorePair_h
+#define AptoCorePair_h
+
+namespace Apto {
+
+template <class V1, class V2 = V1>
+class Pair
+{
+public:
+  V1 m_v1;
+  V2 m_v2;
+
+  Pair() : m_v1(), m_v2() {}
+  Pair(const V1& v1) : m_v1(v1), m_v2() {}
+  Pair(const V1& v1, const V2& v2) : m_v1(v1), m_v2(v2) {}
+
+  V1& Value1() { return m_v1; }
+  const V1& Value1() const { return m_v1; }
+  V2& Value2() { return m_v2; }
+  const V2& Value2() const { return m_v2; }
+
+  bool operator==(const Pair& rhs) const
+  { return m_v1 == rhs.m_v1 && m_v2 == rhs.m_v2; }
+  bool operator<(const Pair& rhs) const
+  {
+    if (m_v1 < rhs.m_v1) return true;
+    if (rhs.m_v1 < m_v1) return false;
+    return m_v2 < rhs.m_v2;
+  }
+};
+
+}  // namespace Apto
+
+#endif

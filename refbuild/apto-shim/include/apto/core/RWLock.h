@@ -1,0 +1,5 @@
+// apto-shim (see platform.h header note)
+#ifndef AptoCoreRWLock_h
+#define AptoCoreRWLock_h
+#include "Mutex.h"
+#endif

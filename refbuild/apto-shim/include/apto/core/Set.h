@@ -1,0 +1,70 @@
+// apto-shim (see platform.h header note)
+#ifndef AptoCoreSet_h
+#define AptoCoreSet_h
+
+#include "Definitions.h"
+
+#include <set>
+
+namespace Apto {
+
+template <class T,
+          template <class, class> class StoragePolicy = DefaultHashBTree,
+          class DefaultsPolicy = ImplicitDefault>
+class Set
+{
+private:
+  std::set<T> m_set;
+
+public:
+  typedef T ValueType;
+
+  Set() {}
+  template <template <class, class> class S2, class D2>
+  Set(const Set<T, S2, D2>& rhs) { *this = rhs; }
+  template <template <class, class> class S2, class D2>
+  Set& operator=(const Set<T, S2, D2>& rhs)
+  {
+    m_set = rhs.Std();
+    return *this;
+  }
+  const std::set<T>& Std() const { return m_set; }
+
+  inline int GetSize() const { return (int)m_set.size(); }
+  inline void Clear() { m_set.clear(); }
+
+  inline void Insert(const T& value) { m_set.insert(value); }
+  inline bool Has(const T& value) const { return m_set.count(value) > 0; }
+  inline bool Remove(const T& value) { return m_set.erase(value) > 0; }
+
+  bool operator==(const Set& rhs) const { return m_set == rhs.m_set; }
+  bool operator!=(const Set& rhs) const { return !(*this == rhs); }
+
+  class Iterator
+  {
+  private:
+    std::set<T>* m_set;
+    typename std::set<T>::iterator m_it;
+    bool m_started;
+  public:
+    explicit Iterator(std::set<T>& s) : m_set(&s), m_started(false) {}
+    const T* Get()
+    {
+      if (!m_started || m_it == m_set->end()) return NULL;
+      return &*m_it;
+    }
+    const T* Next()
+    {
+      if (!m_started) { m_it = m_set->begin(); m_started = true; }
+      else if (m_it != m_set->end()) ++m_it;
+      return Get();
+    }
+  };
+  typedef Iterator ConstIterator;
+  Iterator Begin() { return Iterator(m_set); }
+  Iterator Begin() const { return Iterator(const_cast<std::set<T>&>(m_set)); }
+};
+
+}  // namespace Apto
+
+#endif

@@ -1,0 +1,96 @@
+// apto-shim (see platform.h header note)
+#ifndef AptoCoreSmartPtr_h
+#define AptoCoreSmartPtr_h
+
+#include "Definitions.h"
+
+#include <memory>
+
+namespace Apto {
+
+// Upstream SmartPtr takes storage/ownership/conversion policy params; all
+// shim instantiations share std::shared_ptr semantics (matching the
+// default InternalRCObject policy, the only one avida-core uses).
+class InternalRCObject {};
+class ThreadSafeRefCount {};
+
+template <class T, class OwnershipPolicy = InternalRCObject>
+class SmartPtr
+{
+private:
+  std::shared_ptr<T> m_ptr;
+  template <class T2, class P2> friend class SmartPtr;
+
+public:
+  SmartPtr() {}
+  explicit SmartPtr(T* ptr) : m_ptr(ptr) {}
+  SmartPtr(const std::shared_ptr<T>& p) : m_ptr(p) {}
+  template <class T2, class P2>
+  SmartPtr(const SmartPtr<T2, P2>& rhs) : m_ptr(rhs.m_ptr) {}
+
+  template <class T2, class P2>
+  SmartPtr& operator=(const SmartPtr<T2, P2>& rhs) { m_ptr = rhs.m_ptr; return *this; }
+
+  T& operator*() const { return *m_ptr; }
+  T* operator->() const { return m_ptr.get(); }
+  T* GetPointer() const { return m_ptr.get(); }
+
+  operator bool() const { return (bool)m_ptr; }
+  bool operator!() const { return !m_ptr; }
+  template <class T2, class P2>
+  bool operator==(const SmartPtr<T2, P2>& rhs) const { return m_ptr == rhs.m_ptr; }
+  template <class T2, class P2>
+  bool operator!=(const SmartPtr<T2, P2>& rhs) const { return m_ptr != rhs.m_ptr; }
+  bool operator==(const T* rhs) const { return m_ptr.get() == rhs; }
+  bool operator!=(const T* rhs) const { return m_ptr.get() != rhs; }
+
+  template <class T2>
+  void DynamicCastFrom(const SmartPtr<T2>& rhs)
+  { m_ptr = std::dynamic_pointer_cast<T>(rhs.m_ptr); }
+
+  const std::shared_ptr<T>& Std() const { return m_ptr; }
+};
+
+template <class T, class P>
+inline T* GetInternalPtr(const SmartPtr<T, P>& p) { return p.GetPointer(); }
+
+// RefCountObject: intrusive ref-count base upstream; the shim keeps the
+// API (AddReference/RemoveReference) for classes that inherit it, but
+// SmartPtr above ignores it (shared_ptr external counting).
+template <class ThreadingPolicy = SingleThreaded>
+class RefCountObject
+{
+private:
+  int m_count;
+public:
+  RefCountObject() : m_count(0) {}
+  RefCountObject(const RefCountObject&) : m_count(0) {}
+  RefCountObject& operator=(const RefCountObject&) { return *this; }
+  virtual ~RefCountObject() {}
+  void AddReference() { m_count++; }
+  void RemoveReference() { if (--m_count == 0) delete this; }
+  int RefCount() const { return m_count; }
+};
+
+class MTRefCountObject : public RefCountObject<ThreadSafe> {};
+
+// --- singleton holder (apto/core/SingletonHolder.h upstream) ---
+class CreateWithNew {};
+class DestroyAtExit {};
+
+template <class T, class CreatePolicy = CreateWithNew,
+          class LifetimePolicy = DestroyAtExit,
+          class ThreadingPolicy = SingleThreaded>
+class SingletonHolder
+{
+public:
+  static T& Instance()
+  {
+    static T s_instance;
+    return s_instance;
+  }
+};
+
+}  // namespace Apto
+
+#endif

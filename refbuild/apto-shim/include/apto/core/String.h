@@ -1,0 +1,151 @@
+// apto-shim (see platform.h header note)
+#ifndef AptoCoreString_h
+#define AptoCoreString_h
+
+#include "Definitions.h"
+
+#include <string>
+#include <cstring>
+#include <cstdio>
+#include <cctype>
+
+namespace Apto {
+
+// Apto::BasicString<ThreadingPolicy> -- immutable-ish ref-counted string
+// upstream; plain std::string wrapper here.  Apto::String = the default
+// instantiation (typedef at the bottom).
+template <class ThreadingPolicy = SingleThreaded>
+class BasicString
+{
+private:
+  std::string m_str;
+
+public:
+  BasicString() {}
+  BasicString(const char* str) : m_str(str ? str : "") {}
+  BasicString(int size, const char* str) : m_str(str, str + size) {}
+  BasicString(const std::string& s) : m_str(s) {}
+  template <class P2> BasicString(const BasicString<P2>& rhs)
+    : m_str(rhs.GetData(), rhs.GetData() + rhs.GetSize()) {}
+
+  inline int GetSize() const { return (int)m_str.size(); }
+  inline const char* GetData() const { return m_str.c_str(); }
+  inline const char* GetCString() const { return m_str.c_str(); }
+  inline operator const char*() const { return m_str.c_str(); }
+
+  inline const std::string& StdString() const { return m_str; }
+
+  BasicString& operator=(const BasicString& rhs) { m_str = rhs.m_str; return *this; }
+  BasicString& operator=(const char* rhs) { m_str = rhs ? rhs : ""; return *this; }
+
+  template <class P2> bool operator==(const BasicString<P2>& rhs) const
+  { return m_str == rhs.StdString(); }
+  bool operator==(const char* rhs) const { return m_str == (rhs ? rhs : ""); }
+  template <class P2> bool operator!=(const BasicString<P2>& rhs) const
+  { return !(*this == rhs); }
+  bool operator!=(const char* rhs) const { return !(*this == rhs); }
+  template <class P2> bool operator<(const BasicString<P2>& rhs) const
+  { return m_str < rhs.StdString(); }
+  bool operator<(const char* rhs) const { return m_str < std::string(rhs ? rhs : ""); }
+  template <class P2> bool operator>(const BasicString<P2>& rhs) const
+  { return m_str > rhs.StdString(); }
+  template <class P2> bool operator<=(const BasicString<P2>& rhs) const
+  { return m_str <= rhs.StdString(); }
+  template <class P2> bool operator>=(const BasicString<P2>& rhs) const
+  { return m_str >= rhs.StdString(); }
+
+  char operator[](int index) const { return m_str[index]; }
+
+  BasicString operator+(const BasicString& rhs) const { return BasicString(m_str + rhs.m_str); }
+  BasicString operator+(const char* rhs) const { return BasicString(m_str + (rhs ? rhs : "")); }
+  BasicString operator+(char c) const { std::string s(m_str); s += c; return BasicString(s); }
+  BasicString& operator+=(const BasicString& rhs) { m_str += rhs.m_str; return *this; }
+  BasicString& operator+=(const char* rhs) { m_str += (rhs ? rhs : ""); return *this; }
+  BasicString& operator+=(char c) { m_str += c; return *this; }
+
+  inline BasicString Substring(int idx = 0, int length = -1) const
+  {
+    if (idx < 0) idx = 0;
+    if (idx > GetSize()) idx = GetSize();
+    if (length < 0) length = GetSize() - idx;
+    return BasicString(m_str.substr(idx, length));
+  }
+  inline bool IsEmpty() const { return m_str.empty(); }
+
+  int Find(char c, int pos = 0) const
+  {
+    std::string::size_type r = m_str.find(c, pos);
+    return (r == std::string::npos) ? -1 : (int)r;
+  }
+  int Find(const char* str, int pos = 0) const
+  {
+    std::string::size_type r = m_str.find(str, pos);
+    return (r == std::string::npos) ? -1 : (int)r;
+  }
+
+  inline bool BeginsWith(const BasicString& prefix) const
+  { return m_str.compare(0, prefix.m_str.size(), prefix.m_str) == 0; }
+
+  BasicString Pop(char delim)
+  {
+    // returns up to delim, leaves remainder in this (upstream semantics)
+    std::string::size_type r = m_str.find(delim);
+    if (r == std::string::npos) {
+      BasicString head(m_str);
+      m_str.clear();
+      return head;
+    }
+    BasicString head(m_str.substr(0, r));
+    m_str = m_str.substr(r + 1);
+    return head;
+  }
+
+  BasicString AsLower() const
+  {
+    std::string out(m_str);
+    for (std::string::size_type i = 0; i < out.size(); i++)
+      out[i] = (char)tolower(out[i]);
+    return BasicString(out);
+  }
+  BasicString AsUpper() const
+  {
+    std::string out(m_str);
+    for (std::string::size_type i = 0; i < out.size(); i++)
+      out[i] = (char)toupper(out[i]);
+    return BasicString(out);
+  }
+
+  BasicString ToLower() const { return AsLower(); }
+  BasicString ToUpper() const { return AsUpper(); }
+
+  BasicString Clone() const { return BasicString(m_str); }
+
+  bool IsNumber(int pos) const
+  {
+    if (pos < 0 || pos >= GetSize()) return false;
+    return isdigit(m_str[pos]) || m_str[pos] == '-' || m_str[pos] == '+';
+  }
+  bool IsNumber() const
+  {
+    if (m_str.empty()) return false;
+    char* end = NULL;
+    strtod(m_str.c_str(), &end);
+    return end && *end == '\0';
+  }
+
+  BasicString Trim() const
+  {
+    std::string::size_type b = m_str.find_first_not_of(" \t\r\n");
+    if (b == std::string::npos) return BasicString();
+    std::string::size_type e = m_str.find_last_not_of(" \t\r\n");
+    return BasicString(m_str.substr(b, e - b + 1));
+  }
+
+  class StringTransparentConversion;
+};
+
+typedef BasicString<SingleThreaded> String;
+
+}  // namespace Apto
+
+#endif

@@ -1,0 +1,35 @@
+// apto-shim (see platform.h header note)
+#ifndef AptoCoreStringBuffer_h
+#define AptoCoreStringBuffer_h
+
+#include "String.h"
+
+namespace Apto {
+
+// mutable string builder (upstream apto/core/StringBuffer.h)
+class StringBuffer
+{
+private:
+  std::string m_str;
+
+public:
+  StringBuffer() {}
+  StringBuffer(const char* str) : m_str(str ? str : "") {}
+  StringBuffer(const String& str) : m_str((const char*)str) {}
+
+  inline int GetSize() const { return (int)m_str.size(); }
+  inline operator const char*() const { return m_str.c_str(); }
+  inline const char* GetData() const { return m_str.c_str(); }
+
+  char operator[](int i) const { return m_str[i]; }
+  char& operator[](int i) { return m_str[i]; }
+
+  StringBuffer& operator+=(char c) { m_str += c; return *this; }
+  StringBuffer& operator+=(const char* s) { m_str += (s ? s : ""); return *this; }
+  StringBuffer& operator+=(const String& s) { m_str += (const char*)s; return *this; }
+  StringBuffer& operator=(const char* s) { m_str = (s ? s : ""); return *this; }
+};
+
+}  // namespace Apto
+
+#endif

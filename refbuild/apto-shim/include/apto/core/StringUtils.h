@@ -1,0 +1,68 @@
+// apto-shim (see platform.h header note)
+#ifndef AptoCoreStringUtils_h
+#define AptoCoreStringUtils_h
+
+#include "String.h"
+
+#include <cstdarg>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+
+namespace Apto {
+
+// Apto::StrAs -- proxy with implicit conversions string -> number.
+class StrAs
+{
+private:
+  std::string m_str;
+public:
+  StrAs(const String& s) : m_str((const char*)s) {}
+  StrAs(const char* s) : m_str(s ? s : "") {}
+  template <class P> StrAs(const BasicString<P>& s) : m_str(s.StdString()) {}
+
+  operator int() const { return (int)strtol(m_str.c_str(), NULL, 10); }
+  operator long() const { return strtol(m_str.c_str(), NULL, 10); }
+  operator unsigned int() const { return (unsigned int)strtoul(m_str.c_str(), NULL, 10); }
+  operator double() const { return strtod(m_str.c_str(), NULL); }
+  operator float() const { return (float)strtod(m_str.c_str(), NULL); }
+  operator String() const { return String(m_str.c_str()); }
+  operator bool() const
+  {
+    if (m_str == "true" || m_str == "TRUE" || m_str == "1") return true;
+    return strtol(m_str.c_str(), NULL, 10) != 0;
+  }
+
+  bool operator==(const char* rhs) const { return m_str == (rhs ? rhs : ""); }
+  bool operator!=(const char* rhs) const { return !(*this == rhs); }
+};
+
+inline String AsStr(int v)
+{ char b[32]; snprintf(b, sizeof(b), "%d", v); return String(b); }
+inline String AsStr(long v)
+{ char b[32]; snprintf(b, sizeof(b), "%ld", v); return String(b); }
+inline String AsStr(unsigned int v)
+{ char b[32]; snprintf(b, sizeof(b), "%u", v); return String(b); }
+inline String AsStr(double v)
+{ char b[48]; snprintf(b, sizeof(b), "%f", v); return String(b); }
+inline String AsStr(const char* v) { return String(v); }
+inline String AsStr(const String& v) { return v; }
+
+// fuzzy-match suggestion helper (error messages only); the shim returns
+// the empty string ("no suggestion")
+template <class Iter>
+inline String NearMatch(const String&, Iter) { return String(); }
+
+inline String FormatStr(const char* fmt, ...)
+{
+  char buf[4096];
+  va_list args;
+  va_start(args, fmt);
+  vsnprintf(buf, sizeof(buf), fmt, args);
+  va_end(args);
+  return String(buf);
+}
+
+}  // namespace Apto
+
+#endif

@@ -1,0 +1,48 @@
+// apto-shim (see platform.h header note)
+#ifndef AptoCoreThread_h
+#define AptoCoreThread_h
+
+#include "Definitions.h"
+#include "Mutex.h"
+
+#include <pthread.h>
+
+namespace Apto {
+
+class Thread
+{
+private:
+  pthread_t m_thread;
+  bool m_running;
+
+  static void* EntryPoint(void* arg)
+  {
+    static_cast<Thread*>(arg)->Run();
+    return NULL;
+  }
+
+protected:
+  virtual void Run() = 0;
+
+public:
+  Thread() : m_running(false) {}
+  virtual ~Thread() { if (m_running) Join(); }
+
+  bool Start()
+  {
+    if (m_running) return true;
+    m_running = (pthread_create(&m_thread, NULL, EntryPoint, this) == 0);
+    return m_running;
+  }
+  void Join()
+  {
+    if (m_running) {
+      pthread_join(m_thread, NULL);
+      m_running = false;
+    }
+  }
+};
+
+}  // namespace Apto
+
+#endif

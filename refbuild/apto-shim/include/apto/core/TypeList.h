@@ -1,0 +1,33 @@
+// apto-shim (see platform.h header note)
+#ifndef AptoCoreTypeList_h
+#define AptoCoreTypeList_h
+
+#include "Definitions.h"
+
+namespace Apto {
+namespace TL {
+
+template <class T, class U> struct TypeList
+{
+  typedef T Head;
+  typedef U Tail;
+};
+
+// Upstream TL::Create<T1, ..., Tn> is a macro-generated typelist builder;
+// avida-core uses the Create<...> instantiation ITSELF as the type
+// parameter (e.g. Apto::Functor<R, Apto::TL::Create<int, double> >), so
+// the shim's Functor machinery pattern-matches directly on Create<...>.
+template <class... Ts> struct Create
+{
+  // cons-list view, for completeness
+  typedef NullType TList;
+};
+template <class T, class... Ts> struct Create<T, Ts...>
+{
+  typedef TypeList<T, typename Create<Ts...>::TList> TList;
+};
+
+}  // namespace TL
+}  // namespace Apto
+
+#endif

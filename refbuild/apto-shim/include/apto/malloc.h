@@ -1,0 +1,21 @@
+// apto-shim (see platform.h header note)
+#ifndef AptoMalloc_h
+#define AptoMalloc_h
+
+#include <cstdlib>
+
+namespace Apto {
+
+class BasicMalloc {};
+
+namespace Malloc {
+template <class SuperMalloc> class TCFreeList {};
+template <int Size, class M1, class M2> class FixedSegment {};
+}  // namespace Malloc
+
+// Apto::ClassAllocator<Alloc> -- upstream overrides operator new/delete to
+// route through the allocator policy; the shim inherits default global new.
+template <class Alloc> class ClassAllocator {};
+
+}  // namespace Apto
+#endif

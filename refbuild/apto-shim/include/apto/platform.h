@@ -1,0 +1,43 @@
+// apto-shim: minimal reimplementation of the apto utility library API used
+// by avida-core, written from scratch over the C++ standard library so the
+// reference simulator can be BUILT AND MEASURED in this environment (the
+// real apto submodule is empty and cannot be fetched).  Semantics-bearing
+// pieces (Random, schedulers) are documented in their headers; containers
+// are API-compatible wrappers with no attempt at ABI or performance parity.
+#ifndef AptoPlatform_h
+#define AptoPlatform_h
+
+#include <cstddef>
+#include <cassert>
+
+#define APTO_PLATFORM(X) APTO_PLATFORM_IS_##X
+#define APTO_PLATFORM_IS_WINDOWS 0
+#define APTO_PLATFORM_IS_FREEBSD 0
+#define APTO_PLATFORM_IS_UNIX 1
+#define APTO_PLATFORM_IS_APPLE 0
+
+#ifndef NULL
+#define NULL 0
+#endif
+
+#ifndef LIB_EXPORT
+#define LIB_EXPORT
+#endif
+#ifndef LIB_IMPORT
+#define LIB_IMPORT
+#endif
+#ifndef LIB_LOCAL
+#define LIB_LOCAL
+#endif
+#ifndef LIB_HIDDEN
+#define LIB_HIDDEN
+#endif
+
+namespace Apto {
+namespace Platform {
+inline void Initialize() {}
+inline int AvailableCPUs() { return 1; }
+}  // namespace Platform
+}  // namespace Apto
+
+#endif

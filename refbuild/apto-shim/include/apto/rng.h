@@ -1,0 +1,127 @@
+// apto-shim (see platform.h header note)
+//
+// Apto::Random / Apto::RNG::AvidaRNG.  SEMANTICS NOTE: the upstream
+// AvidaRNG is a specific lagged generator whose exact stream cannot be
+// reproduced here (the submodule is unavailable); this shim uses
+// std::mt19937 underneath.  Every DISTRIBUTION (uniform, P, binomial,
+// normal, poisson) follows the documented upstream contract, so
+// population-level statistics are comparable, but per-seed golden files
+// will differ -- which is true of any cross-RNG comparison and is exactly
+// why the avida-tpu baseline protocol is distributional (BASELINE.md).
+#ifndef AptoRNG_h
+#define AptoRNG_h
+
+#include "core/Definitions.h"
+
+#include <cmath>
+#include <random>
+
+namespace Apto {
+
+class Random
+{
+protected:
+  std::mt19937 m_gen;
+  int m_seed;
+
+public:
+  explicit Random(int seed = -1) { ResetSeed(seed); }
+  virtual ~Random() {}
+
+  int GetSeed() const { return m_seed; }
+  int MaxSeed() const { return 0x7FFFFFFF; }
+
+  void ResetSeed(int seed)
+  {
+    m_seed = seed;
+    if (seed <= 0) {
+      std::random_device rd;
+      m_seed = (int)(rd() & 0x7FFFFFFF);
+      if (m_seed <= 0) m_seed = 1;
+    }
+    m_gen.seed((unsigned int)m_seed);
+  }
+  void Seed(int seed) { ResetSeed(seed); }
+  int Seed() const { return m_seed; }
+
+  // uniform double in [0, 1)
+  double GetDouble()
+  {
+    return (m_gen() >> 5) * (1.0 / 67108864.0) / 2.0 +
+           (m_gen() >> 6) * (1.0 / 67108864.0 / 67108864.0);
+  }
+  double GetDouble(double max) { return GetDouble() * max; }
+  double GetDouble(double min, double max)
+  { return GetDouble() * (max - min) + min; }
+
+  // uniform unsigned int in [0, max)
+  unsigned int GetUInt(unsigned int max)
+  {
+    if (max == 0) return 0;
+    std::uniform_int_distribution<unsigned int> d(0, max - 1);
+    return d(m_gen);
+  }
+  unsigned int GetUInt(unsigned int min, unsigned int max)
+  { return GetUInt(max - min) + min; }
+
+  // uniform int
+  int GetInt() { return (int)(m_gen() & 0x7FFFFFFF); }
+  int GetInt(int max) { return (int)GetUInt((unsigned int)(max > 0 ? max : 0)); }
+  int GetInt(int min, int max) { return GetInt(max - min) + min; }
+
+  // biased coin
+  bool P(double p) { return GetDouble() < p; }
+
+  // std::random_shuffle generator protocol: g(n) in [0, n)
+  long operator()(long n) { return (long)GetUInt((unsigned int)n); }
+
+  // random selection of k distinct ints in [0, num) -- upstream Choose
+  template <class ArrayT>
+  void Choose(int num, ArrayT& out)
+  {
+    for (int i = 0; i < out.GetSize(); i++) {
+      bool again = true;
+      while (again) {
+        out[i] = GetInt(num);
+        again = false;
+        for (int j = 0; j < i; j++) if (out[j] == out[i]) { again = true; break; }
+      }
+    }
+  }
+
+  double GetRandNormal()
+  {
+    std::normal_distribution<double> d(0.0, 1.0);
+    return d(m_gen);
+  }
+  double GetRandNormal(double mean, double variance)
+  { return mean + GetRandNormal() * std::sqrt(variance); }
+
+  unsigned int GetRandPoisson(double mean)
+  {
+    if (mean <= 0.0) return 0;
+    std::poisson_distribution<unsigned int> d(mean);
+    return d(m_gen);
+  }
+  unsigned int GetRandPoisson(double n, double p) { return GetRandPoisson(n * p); }
+
+  unsigned int GetFullRandBinomial(double n, double p)
+  {
+    std::binomial_distribution<unsigned int> d((unsigned int)n, p);
+    return d(m_gen);
+  }
+  unsigned int GetRandBinomial(double n, double p)
+  { return GetFullRandBinomial(n, p); }
+};
+
+namespace RNG {
+class AvidaRNG : public Random
+{
+public:
+  explicit AvidaRNG(int seed = -1) : Random(seed) {}
+};
+}  // namespace RNG
+
+}  // namespace Apto
+
+#endif

@@ -1,0 +1,170 @@
+// apto-shim (see platform.h header note)
+//
+// Apto::Scheduler::{RoundRobin, Probabilistic, Integrated,
+// ProbabilisticIntegrated}.  Semantics contract (cAvidaConfig.h:545):
+//   RoundRobin     -- SLICING_METHOD 0: equal cycles to every nonzero-
+//                     priority entry, cyclic order.
+//   Probabilistic  -- SLICING_METHOD 1: each Next() draws an entry with
+//                     probability priority/sum(priorities).  Implemented
+//                     as a Fenwick (binary-indexed) tree: O(log n) draw
+//                     and priority update -- distributionally identical
+//                     to upstream's weighted index tree.
+//   Integrated     -- SLICING_METHOD 2: deterministic allocation
+//                     proportional to priority.  Implemented as stride
+//                     scheduling (min-pass entry runs, pass += 1/priority)
+//                     which yields the same deterministic-proportional
+//                     contract as upstream's binary merit decomposition.
+#ifndef AptoScheduler_h
+#define AptoScheduler_h
+
+#include "core/Definitions.h"
+#include "core/SmartPtr.h"
+#include "rng.h"
+
+#include <set>
+#include <utility>
+#include <vector>
+
+namespace Apto {
+
+class PriorityScheduler
+{
+public:
+  virtual ~PriorityScheduler() {}
+  virtual void AdjustPriority(int entry_id, double priority) = 0;
+  virtual int Next() = 0;
+};
+
+namespace Scheduler {
+
+class RoundRobin : public PriorityScheduler
+{
+private:
+  std::vector<double> m_priority;
+  int m_last;
+
+public:
+  explicit RoundRobin(int entry_count)
+    : m_priority(entry_count, 0.0), m_last(entry_count - 1) {}
+
+  void AdjustPriority(int entry_id, double priority)
+  { m_priority[entry_id] = priority; }
+
+  int Next()
+  {
+    const int n = (int)m_priority.size();
+    for (int i = 1; i <= n; i++) {
+      int cand = (m_last + i) % n;
+      if (m_priority[cand] > 0.0) { m_last = cand; return cand; }
+    }
+    return -1;
+  }
+};
+
+class Probabilistic : public PriorityScheduler
+{
+private:
+  // Fenwick tree over entry weights
+  std::vector<double> m_tree;   // 1-based
+  std::vector<double> m_weight;
+  double m_total;
+  SmartPtr<Random> m_rng;
+
+  void add(int idx, double delta)
+  {
+    for (int i = idx + 1; i <= (int)m_weight.size(); i += i & (-i))
+      m_tree[i] += delta;
+  }
+
+public:
+  Probabilistic(int entry_count, SmartPtr<Random> rng)
+    : m_tree(entry_count + 1, 0.0), m_weight(entry_count, 0.0),
+      m_total(0.0), m_rng(rng) {}
+
+  void AdjustPriority(int entry_id, double priority)
+  {
+    double delta = priority - m_weight[entry_id];
+    if (delta == 0.0) return;
+    m_weight[entry_id] = priority;
+    m_total += delta;
+    add(entry_id, delta);
+  }
+
+  int Next()
+  {
+    if (m_total <= 0.0) return -1;
+    double u = m_rng->GetDouble() * m_total;
+    // descend the Fenwick tree
+    int pos = 0;
+    int mask = 1;
+    const int n = (int)m_weight.size();
+    while ((mask << 1) <= n) mask <<= 1;
+    for (; mask; mask >>= 1) {
+      int next = pos + mask;
+      if (next <= n && m_tree[next] < u) {
+        u -= m_tree[next];
+        pos = next;
+      }
+    }
+    if (pos >= n) pos = n - 1;
+    // pos is 0-based entry index after descent
+    if (m_weight[pos] <= 0.0) {
+      // numerical edge: walk to a weighted entry
+      for (int i = 0; i < n; i++) if (m_weight[i] > 0.0) return i;
+      return -1;
+    }
+    return pos;
+  }
+};
+
+class Integrated : public PriorityScheduler
+{
+private:
+  // stride scheduling: entry with the smallest pass runs next
+  typedef std::pair<double, int> Key;     // (pass, id)
+  std::set<Key> m_queue;
+  std::vector<double> m_pass;
+  std::vector<double> m_priority;
+  double m_clock;
+
+public:
+  explicit Integrated(int entry_count)
+    : m_pass(entry_count, 0.0), m_priority(entry_count, 0.0), m_clock(0.0) {}
+
+  void AdjustPriority(int entry_id, double priority)
+  {
+    if (m_priority[entry_id] > 0.0)
+      m_queue.erase(Key(m_pass[entry_id], entry_id));
+    m_priority[entry_id] = priority;
+    if (priority > 0.0) {
+      // (re)join at the current virtual clock
+      m_pass[entry_id] = (m_pass[entry_id] > m_clock) ? m_pass[entry_id]
+                                                      : m_clock;
+      m_queue.insert(Key(m_pass[entry_id], entry_id));
+    }
+  }
+
+  int Next()
+  {
+    if (m_queue.empty()) return -1;
+    Key k = *m_queue.begin();
+    m_queue.erase(m_queue.begin());
+    int id = k.second;
+    m_clock = k.first;
+    m_pass[id] = k.first + 1.0 / m_priority[id];
+    m_queue.insert(Key(m_pass[id], id));
+    return id;
+  }
+};
+
+class ProbabilisticIntegrated : public Probabilistic
+{
+public:
+  ProbabilisticIntegrated(int entry_count, SmartPtr<Random> rng)
+    : Probabilistic(entry_count, rng) {}
+};
+
+}  // namespace Scheduler
+}  // namespace Apto
+
+#endif

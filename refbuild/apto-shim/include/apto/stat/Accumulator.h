@@ -1,0 +1,49 @@
+// apto-shim (see platform.h header note)
+#ifndef AptoStatAccumulator_h
+#define AptoStatAccumulator_h
+
+#include "../core/Definitions.h"
+
+#include <cmath>
+
+namespace Apto {
+namespace Stat {
+
+// Streaming accumulator: count/sum/sum-of-squares statistics
+// (upstream apto/stat/Accumulator.h API, reconstructed from call sites).
+template <class T>
+class Accumulator
+{
+private:
+  T m_sum;
+  T m_sum2;   // sum of squares
+  int m_n;
+
+public:
+  Accumulator() : m_sum(0), m_sum2(0), m_n(0) {}
+
+  void Clear() { m_sum = 0; m_sum2 = 0; m_n = 0; }
+  void Add(T value) { m_sum += value; m_sum2 += value * value; m_n++; }
+
+  int Count() const { return m_n; }
+  T Sum() const { return m_sum; }
+  T SumOfSquares() const { return m_sum2; }
+
+  double Mean() const { return m_n ? (double)m_sum / m_n : 0.0; }
+  double Average() const { return Mean(); }
+
+  double Variance() const
+  {
+    if (m_n < 2) return 0.0;
+    double mean = Mean();
+    return ((double)m_sum2 - m_n * mean * mean) / (m_n - 1);
+  }
+  double StdDeviation() const { return std::sqrt(Variance()); }
+  double StdError() const
+  { return m_n ? std::sqrt(Variance() / m_n) : 0.0; }
+};
+
+}  // namespace Stat
+}  // namespace Apto
+
+#endif

@@ -1,0 +1,5 @@
+// apto-shim: everything is header-only; this TU exists so the build
+// produces a real static library for avida-core's FIND_LIBRARY.
+#include "apto/core.h"
+#include "apto/rng.h"
+#include "apto/scheduler.h"

@@ -1,0 +1,4 @@
+CMakeFiles/avida-core.dir/source/analyze/cGenotypeData.cc.o: \
+ /root/reference/avida-core/source/analyze/cGenotypeData.cc \
+ /usr/include/stdc-predef.h \
+ /root/reference/avida-core/source/analyze/cGenotypeData.h

@@ -1,0 +1,4 @@
+CMakeFiles/avida-core.dir/source/main/cBirthSelectionHandler.cc.o: \
+ /root/reference/avida-core/source/main/cBirthSelectionHandler.cc \
+ /usr/include/stdc-predef.h \
+ /root/reference/avida-core/source/main/cBirthSelectionHandler.h

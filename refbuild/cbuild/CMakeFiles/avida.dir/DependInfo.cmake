@@ -1,0 +1,21 @@
+
+# Consider dependencies only in project.
+set(CMAKE_DEPENDS_IN_PROJECT_ONLY OFF)
+
+# The set of languages for which implicit dependencies are needed:
+set(CMAKE_DEPENDS_LANGUAGES
+  )
+
+# The set of dependency files which are needed:
+set(CMAKE_DEPENDS_DEPENDENCY_FILES
+  "/root/reference/avida-core/source/targets/avida/Avida2Driver.cc" "CMakeFiles/avida.dir/source/targets/avida/Avida2Driver.cc.o" "gcc" "CMakeFiles/avida.dir/source/targets/avida/Avida2Driver.cc.o.d"
+  "/root/reference/avida-core/source/targets/avida/primitive.cc" "CMakeFiles/avida.dir/source/targets/avida/primitive.cc.o" "gcc" "CMakeFiles/avida.dir/source/targets/avida/primitive.cc.o.d"
+  )
+
+# Targets to which this target links.
+set(CMAKE_TARGET_LINKED_INFO_FILES
+  "/root/repo/refbuild/cbuild/CMakeFiles/avida-core.dir/DependInfo.cmake"
+  )
+
+# Fortran module output directory.
+set(CMAKE_Fortran_TARGET_MODULE_DIR "")

@@ -1,0 +1,13 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/avida.dir/source/targets/avida/Avida2Driver.cc.o"
+  "CMakeFiles/avida.dir/source/targets/avida/Avida2Driver.cc.o.d"
+  "CMakeFiles/avida.dir/source/targets/avida/primitive.cc.o"
+  "CMakeFiles/avida.dir/source/targets/avida/primitive.cc.o.d"
+  "bin/avida"
+  "bin/avida.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/avida.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

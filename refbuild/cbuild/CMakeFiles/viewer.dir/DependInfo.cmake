@@ -1,0 +1,26 @@
+
+# Consider dependencies only in project.
+set(CMAKE_DEPENDS_IN_PROJECT_ONLY OFF)
+
+# The set of languages for which implicit dependencies are needed:
+set(CMAKE_DEPENDS_LANGUAGES
+  )
+
+# The set of dependency files which are needed:
+set(CMAKE_DEPENDS_DEPENDENCY_FILES
+  "/root/reference/avida-core/source/viewer/ClassificationInfo.cc" "CMakeFiles/viewer.dir/source/viewer/ClassificationInfo.cc.o" "gcc" "CMakeFiles/viewer.dir/source/viewer/ClassificationInfo.cc.o.d"
+  "/root/reference/avida-core/source/viewer/Color.cc" "CMakeFiles/viewer.dir/source/viewer/Color.cc.o" "gcc" "CMakeFiles/viewer.dir/source/viewer/Color.cc.o.d"
+  "/root/reference/avida-core/source/viewer/Driver.cc" "CMakeFiles/viewer.dir/source/viewer/Driver.cc.o" "gcc" "CMakeFiles/viewer.dir/source/viewer/Driver.cc.o.d"
+  "/root/reference/avida-core/source/viewer/Freezer.cc" "CMakeFiles/viewer.dir/source/viewer/Freezer.cc.o" "gcc" "CMakeFiles/viewer.dir/source/viewer/Freezer.cc.o.d"
+  "/root/reference/avida-core/source/viewer/GraphicsContext.cc" "CMakeFiles/viewer.dir/source/viewer/GraphicsContext.cc.o" "gcc" "CMakeFiles/viewer.dir/source/viewer/GraphicsContext.cc.o.d"
+  "/root/reference/avida-core/source/viewer/Listener.cc" "CMakeFiles/viewer.dir/source/viewer/Listener.cc.o" "gcc" "CMakeFiles/viewer.dir/source/viewer/Listener.cc.o.d"
+  "/root/reference/avida-core/source/viewer/Map.cc" "CMakeFiles/viewer.dir/source/viewer/Map.cc.o" "gcc" "CMakeFiles/viewer.dir/source/viewer/Map.cc.o.d"
+  "/root/reference/avida-core/source/viewer/OrganismTrace.cc" "CMakeFiles/viewer.dir/source/viewer/OrganismTrace.cc.o" "gcc" "CMakeFiles/viewer.dir/source/viewer/OrganismTrace.cc.o.d"
+  )
+
+# Targets to which this target links.
+set(CMAKE_TARGET_LINKED_INFO_FILES
+  )
+
+# Fortran module output directory.
+set(CMAKE_Fortran_TARGET_MODULE_DIR "")

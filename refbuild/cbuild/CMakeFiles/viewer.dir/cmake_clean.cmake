@@ -1,0 +1,25 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/viewer.dir/source/viewer/ClassificationInfo.cc.o"
+  "CMakeFiles/viewer.dir/source/viewer/ClassificationInfo.cc.o.d"
+  "CMakeFiles/viewer.dir/source/viewer/Color.cc.o"
+  "CMakeFiles/viewer.dir/source/viewer/Color.cc.o.d"
+  "CMakeFiles/viewer.dir/source/viewer/Driver.cc.o"
+  "CMakeFiles/viewer.dir/source/viewer/Driver.cc.o.d"
+  "CMakeFiles/viewer.dir/source/viewer/Freezer.cc.o"
+  "CMakeFiles/viewer.dir/source/viewer/Freezer.cc.o.d"
+  "CMakeFiles/viewer.dir/source/viewer/GraphicsContext.cc.o"
+  "CMakeFiles/viewer.dir/source/viewer/GraphicsContext.cc.o.d"
+  "CMakeFiles/viewer.dir/source/viewer/Listener.cc.o"
+  "CMakeFiles/viewer.dir/source/viewer/Listener.cc.o.d"
+  "CMakeFiles/viewer.dir/source/viewer/Map.cc.o"
+  "CMakeFiles/viewer.dir/source/viewer/Map.cc.o.d"
+  "CMakeFiles/viewer.dir/source/viewer/OrganismTrace.cc.o"
+  "CMakeFiles/viewer.dir/source/viewer/OrganismTrace.cc.o.d"
+  "lib/libviewer.a"
+  "lib/libviewer.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/viewer.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,32 @@
+#!/bin/bash
+# Run the reference avida (built against the apto shim) on the stock
+# logic-9 config for N seeds, recording updates-to-first-EQU from tasks.dat
+# (printed every 100 updates by the stock events.cfg).  Results ->
+# refbuild/ref_equ_results.txt (one "seed first_equ_update" line each).
+set -u
+BIN=/root/repo/refbuild/cbuild/bin/avida
+CFG=/root/reference/avida-core/support/config
+OUT=/root/repo/refbuild/ref_equ
+SEEDS=${SEEDS:-20}
+MAXU=${MAXU:-20000}
+PAR=${PAR:-5}
+mkdir -p "$OUT"
+run_seed() {
+  s=$1
+  d="$OUT/seed$s"
+  mkdir -p "$d" && cd "$d"
+  cp "$CFG"/avida.cfg "$CFG"/environment.cfg "$CFG"/events.cfg \
+     "$CFG"/instset-heads.cfg "$CFG"/default-heads.org . 2>/dev/null
+  # exit at MAXU instead of 100k updates
+  sed -i "s/^u 100000 exit/u $MAXU exit/" events.cfg
+  "$BIN" -s "$s" -set WORLD_X 60 -set WORLD_Y 60 > avida.log 2>&1
+  # first tasks.dat row (update, ..., equ is column 10: not nand and orn or
+  # andn nor xor equ) with nonzero EQU count
+  first=$(awk '!/^#/ && NF>=10 && $10 > 0 {print $1; exit}' data/tasks.dat)
+  echo "$s ${first:--1}" >> /root/repo/refbuild/ref_equ_results.txt
+}
+export -f run_seed
+export BIN CFG OUT MAXU
+: > /root/repo/refbuild/ref_equ_results.txt
+seq 1001 $((1000 + SEEDS)) | xargs -P "$PAR" -I{} bash -c 'run_seed {}'
+echo done

@@ -1,0 +1,141 @@
+"""Experimental hardware (hw_type 3): 8-register CPU + sensing/movement.
+
+Covers the round-4 cHardwareExperimental core (VERDICT r3 directive #3):
+ - the stock experimental instset replicates (experimental.org ancestor,
+   4-nop labels, 8 registers);
+ - the avatars-pred_look sensing set: rotate-x changes facing, look-ahead
+   reports the first organism on the facing ray into the 8 sensor
+   registers (GoLook cc:3895), move relocates the organism with lockstep
+   conflict resolution, set-forage-target stores predator/prey identity.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from avida_tpu.config import AvidaConfig
+from avida_tpu.world import World
+
+
+def _world(instset, wx=8, wy=8, seed=5):
+    cfg = AvidaConfig()
+    cfg.WORLD_X = wx
+    cfg.WORLD_Y = wy
+    cfg.RANDOM_SEED = seed
+    cfg.INST_SET = instset
+    cfg.AVE_TIME_SLICE = 100
+    cfg.set("TPU_SYSTEMATICS", 0)
+    return World(cfg=cfg)
+
+
+def _prog(w, names, pad_to=24):
+    name_to_op = {n: i for i, n in enumerate(w.instset.inst_names)}
+    ops = [name_to_op[n] for n in names]
+    # pad with nop-A so the IP wraps through no-ops
+    ops += [name_to_op["nop-A"]] * (pad_to - len(ops))
+    return np.asarray(ops, np.int8)
+
+
+def test_experimental_replicates():
+    w = _world("instset-experimental.cfg")
+    assert w.params.hw_type == 3
+    assert w.params.num_registers == 8
+    w.inject()
+    for u in range(8):
+        w.run_update()
+        w.update += 1
+    assert int(np.asarray(w.state.alive).sum()) > 1
+
+
+def test_rotate_and_move():
+    from avida_tpu.ops.interpreter import micro_step
+    import jax
+    import jax.numpy as jnp
+
+    w = _world("pred_look.cfg")
+    walker = _prog(w, ["move", "nop-B"])
+    cell = 4 * 8 + 4                      # (y=4, x=4)
+    w.inject(genome=walker, cell=cell)
+    st = w.state.replace(facing=w.state.facing.at[cell].set(0))
+    exec_mask = jnp.zeros(64, bool).at[cell].set(True)
+    st = micro_step(w.params, st, jax.random.key(0), exec_mask)
+    alive = np.asarray(st.alive)
+    assert not alive[cell], "organism should have moved off its start cell"
+    occupied = np.flatnonzero(alive)
+    assert len(occupied) == 1
+    y, x = divmod(int(occupied[0]), 8)
+    assert (x, y) == (4, 3), "facing 0 = one step north"
+
+
+def test_look_ahead_sees_organism():
+    from avida_tpu.ops.interpreter import micro_step
+
+    w = _world("pred_look.cfg")
+    looker_cell = 4 * 8 + 4
+    target_cell = 1 * 8 + 4               # 3 cells north
+    looker = _prog(w, ["look-ahead", "nop-B"])
+    blocker = _prog(w, ["nop-A"])
+    w.inject(genome=looker, cell=looker_cell)
+    w.inject(genome=blocker, cell=target_cell)
+    st = w.state.replace(
+        facing=w.state.facing.at[looker_cell].set(0),
+        forage_target=w.state.forage_target.at[target_cell].set(7))
+    import jax
+    import jax.numpy as jnp
+    exec_mask = jnp.zeros(64, bool).at[looker_cell].set(True)
+    st = micro_step(w.params, st, jax.random.key(0), exec_mask)
+    regs = np.asarray(st.regs)[looker_cell]
+    # GoLook output registers from ?BX?=1: habitat, distance, search_type,
+    # id_sought, count, value, group, ft
+    assert regs[1] == -2                  # habitat: organism search
+    assert regs[2] == 3                   # distance to the blocker
+    assert regs[4] == target_cell         # id of the organism seen
+    assert regs[5] == 1                   # count
+    assert regs[0] == 7                   # ft wraps to register 0 (1+7)%8
+
+
+def test_set_forage_target_and_rotate_x():
+    from avida_tpu.ops.interpreter import micro_step
+    import jax
+    import jax.numpy as jnp
+
+    w = _world("pred_look.cfg")
+    cell = 9
+    # inc; inc; set-forage-target  -> ft = 2
+    # every operand-taking instruction is followed by an explicit nop-B
+    # (the padding nop would otherwise be consumed as the modifier)
+    prog = _prog(w, ["inc", "inc", "set-forage-target", "inc",
+                     "rotate-x", "nop-B"])
+    w.inject(genome=prog, cell=cell)
+    st = w.state
+    exec_mask = jnp.zeros(64, bool).at[cell].set(True)
+    for _ in range(5):
+        st = micro_step(w.params, st, jax.random.key(1), exec_mask)
+    assert int(np.asarray(st.forage_target)[cell]) == 2
+    # rotate-x by BX=3: facing moved 3 ring steps
+    assert int(np.asarray(st.facing)[cell]) == 3
+
+
+def test_move_conflict_lowest_index_wins():
+    from avida_tpu.ops.interpreter import micro_step
+    import jax
+    import jax.numpy as jnp
+
+    w = _world("pred_look.cfg")
+    # two movers both facing the same empty cell: (3,4) from north and south
+    mover = _prog(w, ["move", "nop-B"])
+    a, b, tgt = 2 * 8 + 4, 4 * 8 + 4, 3 * 8 + 4
+    w.inject(genome=mover, cell=a)
+    w.inject(genome=mover, cell=b)
+    st = w.state.replace(
+        facing=w.state.facing.at[a].set(4).at[b].set(0))  # a south, b north
+    exec_mask = jnp.zeros(64, bool).at[a].set(True).at[b].set(True)
+    st = micro_step(w.params, st, jax.random.key(2), exec_mask)
+    alive = np.asarray(st.alive)
+    assert alive[tgt], "the contested cell should now be occupied"
+    assert not alive[a], "lower-index mover a should have won the move"
+    assert alive[b], "loser b stays put"
+    # loser's move register reports failure, winner's reports success
+    assert int(np.asarray(st.regs)[tgt, 1]) == 1
+    assert int(np.asarray(st.regs)[b, 1]) == 0

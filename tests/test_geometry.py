@@ -1,0 +1,101 @@
+"""World geometries beyond grid/torus (nGeometry.h:30-37, cTopology.h
+builders): clique, hex, lattice, random-connected, scale-free -- all as
+static [N, C] neighbor tables with -1 padding for short connection lists.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from avida_tpu.ops.birth import neighbor_table
+
+
+def _degrees(t):
+    return (t >= 0).sum(axis=1)
+
+
+def test_hex_six_neighbors():
+    t = neighbor_table(5, 5, 4)
+    d = _degrees(t)
+    # interior cells: 6 connections (grid minus NE/SW diagonals)
+    assert d[2 * 5 + 2] == 6
+    # NE/SW diagonal neighbors are absent for the center cell
+    c = 2 * 5 + 2
+    assert (1 * 5 + 3) not in set(t[c][t[c] >= 0])   # NE of (2,2)
+    assert (3 * 5 + 1) not in set(t[c][t[c] >= 0])   # SW
+
+
+def test_grid_edge_lists_short():
+    t = neighbor_table(4, 4, 1)
+    d = _degrees(t)
+    assert d[0] == 3          # corner
+    assert d[1] == 5          # edge
+    assert d[1 * 4 + 1] == 8  # interior
+
+
+def test_lattice_z1_equals_grid():
+    assert (neighbor_table(4, 4, 6) == neighbor_table(4, 4, 1)).all()
+
+
+def test_clique_all_pairs():
+    t = neighbor_table(3, 3, 3)
+    assert t.shape == (9, 8)
+    for c in range(9):
+        assert set(t[c]) == set(range(9)) - {c}
+
+
+def test_random_connected_is_connected_and_symmetric():
+    t = neighbor_table(6, 6, 7, seed=11)
+    n = 36
+    adj = {c: set(t[c][t[c] >= 0]) for c in range(n)}
+    for c in range(n):
+        for d in adj[c]:
+            assert c in adj[d], "graph must be bidirectional"
+    seen = {0}
+    frontier = [0]
+    while frontier:
+        c = frontier.pop()
+        for d in adj[c]:
+            if d not in seen:
+                seen.add(d)
+                frontier.append(d)
+    assert len(seen) == n, "graph must be a single component"
+
+
+def test_scale_free_hubs_and_m():
+    t = neighbor_table(8, 8, 8, seed=5, scale_free_m=3)
+    d = _degrees(t)
+    assert d.min() >= 1
+    # preferential attachment: max degree well above the median
+    assert d.max() >= 2 * np.median(d)
+    adj = {c: set(t[c][t[c] >= 0]) for c in range(64)}
+    for c in adj:
+        for e in adj[c]:
+            assert c in adj[e]
+
+
+def test_unwired_geometries_raise():
+    with pytest.raises(NotImplementedError):
+        neighbor_table(4, 4, 0)
+    with pytest.raises(NotImplementedError):
+        neighbor_table(4, 4, 5)
+
+
+def test_world_runs_on_hex():
+    from avida_tpu.config import AvidaConfig
+    from avida_tpu.world import World
+
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 8
+    cfg.WORLD_Y = 8
+    cfg.WORLD_GEOMETRY = 4
+    cfg.RANDOM_SEED = 3
+    cfg.AVE_TIME_SLICE = 100
+    cfg.set("TPU_SYSTEMATICS", 0)
+    w = World(cfg=cfg)
+    w.inject()
+    for u in range(8):
+        w.run_update()
+        w.update += 1
+    assert int(np.asarray(w.state.alive).sum()) > 1
