@@ -384,7 +384,10 @@ class Analyzer:
         cBirthChamber::RegionSwap): appends the recombinants to the
         batch."""
         reps = int(args[0]) if args else 1
-        rng = np.random.default_rng(getattr(self, "_recomb_seed", 0) + 1)
+        # advance the stream per invocation (the reference draws from the
+        # advancing global RNG; a fixed seed would repeat crossover points)
+        self._recomb_seed = getattr(self, "_recomb_seed", 0) + 1
+        rng = np.random.default_rng(self._recomb_seed)
         out = []
         for _ in range(reps):
             for i in range(0, len(self.batch) - 1, 2):

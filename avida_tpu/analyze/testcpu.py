@@ -49,10 +49,14 @@ class TestResult:
 def _sandbox_state(params, genomes, lens, key):
     g = genomes.shape[0]
     st = zeros_population(g, params.max_memory, params.num_reactions,
-                          params.num_global_res, params.num_spatial_res)
+                          params.num_global_res, params.num_spatial_res,
+                          n_deme_res=params.num_deme_res)
     k_in, _ = jax.random.split(key)
     st = st.replace(
         inputs=make_cell_inputs(k_in, g),
+        deme_resources=jnp.broadcast_to(
+            jnp.asarray(params.dres_initial, jnp.float32)[None, :],
+            (1, params.num_deme_res)),
         tape=genomes.astype(jnp.uint8),
         genome=genomes.astype(jnp.int8),
         mem_len=lens, genome_len=lens,

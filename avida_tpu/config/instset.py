@@ -29,7 +29,13 @@ class InstSet:
     energy_cost: np.ndarray
     prob_fail: np.ndarray
     addl_time_cost: np.ndarray
+    res_cost: np.ndarray = None  # resource-bin cost (cInstSet.h:69); the
+    #                              tpu build refuses nonzero values at load
     params: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.res_cost is None:
+            self.res_cost = np.zeros(len(self.inst_names), np.float64)
 
     @property
     def num_insts(self) -> int:
@@ -64,7 +70,8 @@ def load_instset(path: str) -> InstSet:
     name = "default"
     hw_type = 0
     params = {}
-    names, red, cost, ftc, ec, pf, atc = [], [], [], [], [], [], []
+    names, red, cost, ftc, ec, pf, atc, rsc = ([], [], [], [], [], [], [],
+                                               [])
     with open(path) as f:
         for raw in f:
             line = raw.split("#", 1)[0].strip()
@@ -87,6 +94,7 @@ def load_instset(path: str) -> InstSet:
                 ec.append(kv.get("energy_cost", 0))
                 pf.append(kv.get("prob_fail", 0.0))
                 atc.append(kv.get("addl_time_cost", 0))
+                rsc.append(kv.get("res_cost", 0.0))
     if not names:
         raise ValueError(f"no INST lines found in {path}")
     return InstSet(
@@ -97,6 +105,7 @@ def load_instset(path: str) -> InstSet:
         energy_cost=np.asarray(ec, np.float64),
         prob_fail=np.asarray(pf, np.float64),
         addl_time_cost=np.asarray(atc, np.int32),
+        res_cost=np.asarray(rsc, np.float64),
         params=params,
     )
 

@@ -55,6 +55,10 @@ class WorldParams(struct.PyTreeNode):
     mut_cdf: tuple = struct.field(pytree_node=False, default=())
     inst_cost: tuple = struct.field(pytree_node=False, default=())
     inst_ft_cost: tuple = struct.field(pytree_node=False, default=())
+    # per-opcode execution-failure probability / extra time_used charge
+    # (cInstSet.h:66,67 prob_fail + addl_time_cost; cHardwareCPU.cc:985-1015)
+    inst_prob_fail: tuple = struct.field(pytree_node=False, default=())
+    inst_addl_time_cost: tuple = struct.field(pytree_node=False, default=())
     # mutation rates
     copy_mut_prob: float = struct.field(pytree_node=False, default=0.0075)
     copy_ins_prob: float = struct.field(pytree_node=False, default=0.0)
@@ -176,11 +180,19 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
     def tt(a):
         return tuple(map(tuple, a)) if a.ndim == 2 else tuple(a.tolist())
 
-    if instset.hw_type in (1, 2) and (instset.cost.any()
-                                      or instset.ft_cost.any()):
+    if getattr(instset, "res_cost", None) is not None \
+            and np.asarray(instset.res_cost).any():
         raise NotImplementedError(
-            "instruction costs are not implemented for TransSMT hardware "
-            "yet; zero the cost/ft_cost columns or use heads hardware")
+            "instset res_cost (resource-bin execution costs, cInstSet.h:69) "
+            "is not implemented; zero the res_cost column")
+    if instset.hw_type in (1, 2) and (instset.cost.any()
+                                      or instset.ft_cost.any()
+                                      or instset.prob_fail.any()
+                                      or instset.addl_time_cost.any()):
+        raise NotImplementedError(
+            "instruction costs/prob_fail/addl_time_cost are not implemented "
+            "for TransSMT hardware yet; zero those columns or use heads "
+            "hardware")
     for r in environment.spatial_resources():
         if r.is_gradient and (r.peakx >= cfg.WORLD_X or r.peaky >= cfg.WORLD_Y):
             raise ValueError(
@@ -209,6 +221,10 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
                    if instset.cost.any() else ()),
         inst_ft_cost=(tuple(instset.ft_cost.tolist())
                       if instset.ft_cost.any() else ()),
+        inst_prob_fail=(tuple(float(x) for x in instset.prob_fail)
+                        if instset.prob_fail.any() else ()),
+        inst_addl_time_cost=(tuple(int(x) for x in instset.addl_time_cost)
+                             if instset.addl_time_cost.any() else ()),
         copy_mut_prob=cfg.COPY_MUT_PROB,
         copy_ins_prob=cfg.COPY_INS_PROB,
         copy_del_prob=cfg.COPY_DEL_PROB,
@@ -412,6 +428,8 @@ class PopulationState(struct.PyTreeNode):
     # --- energy model (cPhenotype energy_store; only meaningful when
     # ENERGY_ENABLED) ---
     energy: jax.Array          # f32[N]
+    energy_spent: jax.Array    # f32[N]  lifetime energy consumed (BIRTH_METHOD
+                               #         9/10 rank cells by it, cPopulation.cc:5332)
 
     # --- per-deme resource pools (cDeme resource slice) ---
     deme_resources: jax.Array  # f32[D, Rd]
@@ -518,7 +536,7 @@ def zeros_population(n: int, L: int, R: int, n_global_res: int = 0,
         sterile=jnp.zeros(n, bool),
         breed_true=jnp.zeros(n, bool),
         divide_pending=jnp.zeros(n, bool),
-        energy=f32(n),
+        energy=f32(n), energy_spent=f32(n),
         deme_resources=jnp.zeros((n_demes, n_deme_res), jnp.float32),
         nb_genome=jnp.zeros((nb_cap, L), jnp.int8), nb_len=i32(nb_cap),
         nb_cell=i32(nb_cap), nb_parent=i32(nb_cap), nb_update=i32(nb_cap),

@@ -231,8 +231,10 @@ def neighbor_table(world_x: int, world_y: int, geometry: int,
     too.  Random geometries are frozen at world construction from `seed`
     (the reference also builds them once at setup)."""
     n = world_x * world_y
-    offs = [(-1, -1), (-1, 0), (-1, 1), (0, -1), (0, 1), (1, -1), (1, 0),
-            (1, 1)]
+    # column k of the table MUST be the _OFFS_2D[k] displacement: the torus
+    # fast path (local_torus_fast_path) replaces gathers on this table with
+    # rolls by _OFFS_2D[k], so the two orderings may never diverge
+    offs = _OFFS_2D
 
     def grid_like(skip=()):
         out = np.full((n, 8), -1, np.int32)
@@ -400,30 +402,41 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
     else:
         occupied = st.alive[cand]                     # [N, C]
     u = jax.random.uniform(k_place, (n, ncand))
-    # dominant over any occupant age (int32 < 2.2e9) or merit
-    empty_bonus = jnp.where(~occupied, 1e12, 0.0)
+    # Empty-first methods pick lexicographically: a uniformly-random empty
+    # candidate when one exists, else the best occupied one.  (Adding a
+    # large empty_bonus to a shared score would swallow the random
+    # tiebreak in float32 -- 1e12 + u rounds back to 1e12 -- making every
+    # "random among ties" pick deterministically lowest-index.)
+    real = ~pad              # padding slots (short connection lists) never
+    #                          win unless the cell has no real candidate
+    empty_cand = real & ~occupied
+    has_empty = empty_cand.any(axis=1)
+    empty_pick = jnp.argmax(jnp.where(empty_cand, u, -1.0), axis=1)
+
+    def pick_empty_first(occ_score):
+        occ_pick = jnp.argmax(jnp.where(real, occ_score, -jnp.inf), axis=1)
+        return jnp.where(has_empty, empty_pick, occ_pick)
+
     if bm == 0:            # RANDOM neighbor (PREFER_EMPTY optional)
-        score = u + (jnp.where(~occupied, 10.0, 0.0)
-                     if params.prefer_empty else 0.0)
+        if params.prefer_empty:
+            choice = pick_empty_first(u)
+        else:
+            choice = jnp.argmax(jnp.where(real, u, -1.0), axis=1)
     elif bm == 1:          # AGE: replace the oldest neighbor; empty first
         # stale stats of DEAD former occupants must not leak into scores
         occ = (jnp.stack([nbr(st.time_used, k) for k in range(ncand)], axis=1)
                if fast else st.time_used[cand])
         occ_age = jnp.where(occupied, occ, 0)
-        score = occ_age.astype(jnp.float32) + u + empty_bonus
+        choice = pick_empty_first(occ_age.astype(jnp.float32) + u)
     elif bm == 2:          # MERIT: replace the lowest-merit neighbor
         occ = (jnp.stack([nbr(st.merit, k) for k in range(ncand)], axis=1)
                if fast else st.merit[cand])
         occ_merit = jnp.where(occupied, occ, 0)
-        score = -occ_merit.astype(jnp.float32) + u + empty_bonus
+        choice = pick_empty_first(-occ_merit.astype(jnp.float32) + u)
     elif bm == 3:          # EMPTY: only empty neighbor cells qualify
-        score = u + empty_bonus
+        choice = empty_pick
     else:
-        score = u
-    # padding slots (cells with short connection lists) never win unless
-    # the cell has no real candidate at all
-    score = score - jnp.where(pad, 1e18, 0.0)
-    choice = jnp.argmax(score, axis=1)
+        choice = jnp.argmax(jnp.where(real, u, -1.0), axis=1)
     if fast:
         target = jnp.zeros(n, jnp.int32)
         for k in range(ncand):
@@ -460,17 +473,24 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
     elif bm == 8:          # NEXT_CELL
         target = (rows + 1) % n
     elif bm == 9:          # FULL_SOUP_ENERGY_USED (cPopulation.cc:5332):
-        # the cell whose occupant has used the most time (empty cells count
-        # as INT_MAX, i.e. preferred); random tiebreak
-        k9 = jax.random.fold_in(k_place, 9)
-        score9 = jnp.where(st.alive, st.time_used.astype(jnp.float32),
-                           1e12) + jax.random.uniform(k9, (n,))
-        target = jnp.full(n, jnp.argmax(score9), jnp.int32)
+        # the cell whose occupant has used the most energy (time used when
+        # the energy model is off); empty cells count as INT_MAX, i.e.
+        # preferred; random tiebreak
+        used9 = (st.energy_spent if params.energy_enabled
+                 else st.time_used.astype(jnp.float32))
+        u9 = jax.random.uniform(jax.random.fold_in(k_place, 9), (n,))
+        any_dead = (~st.alive).any()
+        dead_pick = jnp.argmax(jnp.where(st.alive, -1.0, u9))
+        live_pick = jnp.argmax(jnp.where(st.alive, used9 + u9, -jnp.inf))
+        target = jnp.full(n, jnp.where(any_dead, dead_pick, live_pick),
+                          jnp.int32)
     elif bm == 10:         # NEIGHBORHOOD_ENERGY_USED (cc:5400): same rule
-        # among the parent's connections
-        occ_t = jnp.where(occupied, st.time_used[cand].astype(jnp.float32),
-                          1e12)
-        choice10 = jnp.argmax(occ_t + u, axis=1)
+        # among the parent's connections (empty-first, random tiebreak,
+        # padded slots excluded -- same lexicographic pick as bm 0-3)
+        used10 = (st.energy_spent if params.energy_enabled
+                  else st.time_used.astype(jnp.float32))
+        choice10 = pick_empty_first(
+            jnp.where(occupied, used10[cand], 0.0) + u)
         target = cand[rows, choice10]
     elif bm == 11:         # DISPERSAL (cc:5363): a Poisson(DISPERSAL_RATE)
         # number of random single-cell hops from the parent (capped at 8)
@@ -619,6 +639,7 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
         "birth_update": update_no, "insts_executed": 0, "budget_carry": 0,
         # cost engine starts clean (no inherited debt or paid ft bits)
         "cost_wait": 0, "ft_paid_lo": 0, "ft_paid_hi": 0,
+        "energy_spent": 0.0,
         # TransSMT state (size-0 axes on heads hardware; writes are no-ops)
         "smt_aux": jnp.uint8(0), "smt_aux_len": 0,
         "pmem": jnp.uint8(0), "pmem_len": 0, "parasite_active": False,

@@ -218,7 +218,19 @@ def micro_step(params, st, key, exec_mask):
         eff_exec = exec_mask
         cost_wait = st.cost_wait
         ft_paid_lo, ft_paid_hi = st.ft_paid_lo, st.ft_paid_hi
-    sem = jnp.where(eff_exec, sem_t[cur_op], -1)
+
+    # ---- probabilistic execution failure (cHardwareCPU.cc:988-990:
+    # the instruction still pays its costs, is flagged executed, and IP
+    # advances, but the effect is suppressed; the following nop modifier
+    # is NOT consumed -- it executes as a no-op next cycle, matching the
+    # reference's per-cycle timing) ----
+    if params.inst_prob_fail:
+        pf_t = jnp.asarray(params.inst_prob_fail, jnp.float32)
+        u_fail = jax.random.uniform(jax.random.fold_in(key, 0xFA11), (n,))
+        inst_failed = eff_exec & (u_fail < pf_t[cur_op])
+    else:
+        inst_failed = jnp.zeros(n, bool)
+    sem = jnp.where(eff_exec & ~inst_failed, sem_t[cur_op], -1)
 
     def is_op(s):
         return sem == s
@@ -229,7 +241,8 @@ def micro_step(params, st, key, exec_mask):
     next_op = jnp.where(ip == mlen - 1, op0, s_ip1 & 63)
     next_op = jnp.clip(next_op, 0, num_insts - 1)
     next_is_nop = is_nop_t[next_op]
-    mod_kind = jnp.where(exec_mask, mod_kind_t[cur_op], MOD_NONE)
+    mod_kind = jnp.where(exec_mask & ~inst_failed, mod_kind_t[cur_op],
+                         MOD_NONE)
     wants_mod = (mod_kind == MOD_REG) | (mod_kind == MOD_HEAD)
     has_mod = wants_mod & next_is_nop
     operand = jnp.where(has_mod, nop_mod_t[next_op], default_op_t[cur_op])
@@ -564,11 +577,15 @@ def micro_step(params, st, key, exec_mask):
     # (cPhenotype::ReduceEnergy via SingleProcess_PayPreCosts energy branch,
     # cHardwareBase.cc:1241; cPhenotype.cc:1974)
     energy = st.energy
+    energy_spent = st.energy_spent
     if params.energy_enabled and params.inst_energy_cost:
         ecost_t = jnp.asarray(params.inst_energy_cost, jnp.float32)
         charge = jnp.where(exec_mask, ecost_t[jnp.clip(cur_op, 0,
                                                        num_insts - 1)], 0.0)
-        energy = jnp.maximum(energy - charge, 0.0)
+        # only energy actually available is consumed (store floors at 0)
+        spent = jnp.minimum(charge, energy)
+        energy = energy - spent
+        energy_spent = energy_spent + spent
 
     # phenotype DivideReset (cPhenotype.cc:824): merit from size & bonus
     merit_base = _calc_size_merit(params, gsize, st.copied_size, exec_count)
@@ -604,6 +621,12 @@ def micro_step(params, st, key, exec_mask):
 
     # ---- time accounting + death (SingleProcess tail, cc:1047-1051) ----
     time_used = st.time_used + exec_mask.astype(jnp.int32)
+    if params.inst_addl_time_cost:
+        # cHardwareCPU.cc:985,1015: IncTimeUsed(addl_time_cost) on top of
+        # the regular cycle -- charged even when prob_fail suppressed the
+        # effect (the fetch precedes the failure draw)
+        atc_t = jnp.asarray(params.inst_addl_time_cost, jnp.int32)
+        time_used = time_used + jnp.where(eff_exec, atc_t[cur_op], 0)
     cpu_cycles = st.cpu_cycles + exec_mask.astype(jnp.int32)
     if params.divide_method != 0:
         # DIVIDE_METHOD 1/2 (SPLIT/BIRTH): the parent is "a second child" --
@@ -644,7 +667,7 @@ def micro_step(params, st, key, exec_mask):
         resources=resources, res_grid=res_grid,
         deme_resources=deme_resources,
         facing=facing, forage_target=forage_target,
-        energy=energy,
+        energy=energy, energy_spent=energy_spent,
     )
     if params.hw_type == 3:
         new_st = _apply_moves(new_st, move_won, move_tgt)

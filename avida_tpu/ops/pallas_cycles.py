@@ -159,6 +159,8 @@ def eligible(params) -> bool:
         return False
     if params.inst_cost or params.inst_ft_cost:
         return False     # cost engine not implemented in-kernel
+    if params.inst_prob_fail or params.inst_addl_time_cost:
+        return False     # probabilistic failure / extra time not in-kernel
     if params.energy_enabled:
         return False     # energy store/merit not implemented in-kernel
     if any(pi >= 0 for pi in getattr(params, "proc_product_idx", ())):

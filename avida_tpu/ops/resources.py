@@ -250,6 +250,12 @@ def consume(params, env_tables, rewarded, task_quality, resources, res_grid):
     depletable = env_tables["proc_depletable"]    # bool[NR]
 
     rw = rewarded.astype(jnp.float32) * task_quality
+    # deme-bound reactions are consume_deme()'s business: zero their demand
+    # here so they never touch the global/spatial pools (their `amount`
+    # column is overwritten with the deme result in apply_reactions)
+    if params.num_deme_res:
+        is_deme = jnp.asarray(params.proc_res_deme, bool)
+        rw = jnp.where(is_deme[None, :], 0.0, rw)
     infinite = res_idx < 0
 
     # available level per (org, reaction)

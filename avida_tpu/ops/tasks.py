@@ -188,7 +188,7 @@ def apply_reactions(params, env_tables, io_mask, logic_id, cur_bonus,
 
     env_tables: dict of jnp arrays built from Environment.device_tables().
     Returns (new_bonus, new_task_count, new_reaction_count,
-             new_resources, new_res_grid, any_reward[N]).
+             new_resources, new_res_grid, new_deme_resources, any_reward[N]).
 
     Mirrors cEnvironment::TestOutput's reaction loop (cEnvironment.cc:1332-
     1404): each reaction fires if its task's logic-id set contains logic_id

@@ -105,10 +105,14 @@ def restore_population(params, orgs, key, neighbors=None):
                           params.num_spatial_res, params.num_demes,
                           smt=(params.hw_type in (1, 2)),
                           num_registers=params.num_registers,
-                          nb_cap=params.nb_cap)
+                          nb_cap=params.nb_cap,
+                          n_deme_res=params.num_deme_res)
     k_in, key = jax.random.split(key)
     st = st.replace(
         inputs=make_cell_inputs(k_in, n),
+        deme_resources=jnp.broadcast_to(
+            jnp.asarray(params.dres_initial, jnp.float32)[None, :],
+            (params.num_demes, params.num_deme_res)),
         resources=jnp.asarray(params.res_initial, jnp.float32),
         res_grid=jnp.broadcast_to(
             jnp.asarray(params.sres_initial, jnp.float32)[:, None],

@@ -82,6 +82,13 @@ def default_ancestor(instset) -> np.ndarray:
                  for n in _DEFAULT_ANCESTOR_NAMES]
     else:
         names = _DEFAULT_ANCESTOR_NAMES
+    missing = [n for n in names if n not in name_to_op]
+    if missing:
+        raise ValueError(
+            f"instruction set {instset.name!r} has no built-in default "
+            f"ancestor (lacks {missing[:4]}{'...' if len(missing) > 4 else ''}"
+            f"); inject an explicit genome (START_ORGANISM / World.inject "
+            f"with a genome argument)")
     return np.asarray([name_to_op[n] for n in names], np.int8)
 
 
@@ -673,9 +680,11 @@ class World:
     def run_updates(self, k: int):
         """Run k consecutive updates as one device program (ops/update.py
         update_scan) -- no per-update host dispatch.  Only valid when no
-        event is due inside the window and systematics is off (the
-        phylogeny needs per-update newborn attribution); World.run picks
-        the stretch length.  Advances self.update by k."""
+        event is due inside the window; with systematics enabled the
+        device-side newborn ring buffer records per-update birth
+        attribution and World.run caps stretches at 8 updates, draining
+        the buffer via _feed_systematics at each chunk boundary.
+        Advances self.update by k."""
         executed = self._scan_updates(k)
         self.update += k
         return executed
@@ -725,11 +734,13 @@ class World:
         count = int(np.asarray(st.nb_count))
         cap = st.nb_genome.shape[0]
         alive = np.asarray(st.alive)
-        if count > cap:
+        overflow = count > cap
+        if overflow:
             import sys
             print(f"[avida-tpu] warning: newborn buffer overflow "
-                  f"({count} > {cap}); phylogeny may miss overwritten "
-                  f"newborns this window", file=sys.stderr)
+                  f"({count} > {cap}); recovering surviving births from a "
+                  f"state scan (overwritten-then-dead newborns are lost "
+                  f"this window)", file=sys.stderr)
             count = cap
         if count:
             genomes = np.asarray(st.nb_genome[:count])
@@ -737,6 +748,34 @@ class World:
             cells = np.asarray(st.nb_cell[:count])
             parents = np.asarray(st.nb_parent[:count])
             updates = np.asarray(st.nb_update[:count])
+            if overflow:
+                # state-scan fallback for the dropped tail: any cell whose
+                # birth_update falls inside this drain window and is not
+                # among the buffered records still exists in state (it is
+                # the cell's LAST birth); recover genome/parent from the
+                # live arrays.  Only newborns that were overwritten by a
+                # later birth AND died are unrecoverable.
+                bu = np.asarray(st.birth_update)
+                win_start = getattr(self, "_last_drain_update", -1)
+                in_window = alive & (bu > win_start)
+                recorded = set(zip(cells.tolist(), updates.tolist()))
+                extra = np.asarray([c for c in np.nonzero(in_window)[0]
+                                    if (int(c), int(bu[c])) not in recorded],
+                                   np.int64)
+                if extra.size:
+                    pid = np.asarray(st.parent_id)
+                    genomes = np.concatenate(
+                        [genomes, np.asarray(st.genome[extra])])
+                    lens = np.concatenate(
+                        [lens, np.asarray(st.genome_len[extra])])
+                    cells = np.concatenate([cells, extra])
+                    parents = np.concatenate([parents, pid[extra]])
+                    updates = np.concatenate([updates, bu[extra]])
+                    order = np.argsort(updates, kind="stable")
+                    genomes, lens, cells, parents, updates = (
+                        genomes[order], lens[order], cells[order],
+                        parents[order], updates[order])
+                    count += extra.size
             # feed groups in update order (records are already appended in
             # update order; split on the update column)
             start = 0
@@ -756,6 +795,7 @@ class World:
                 np.zeros(0, np.int32), np.zeros(0, np.int32))
         if count or int(np.asarray(st.nb_count)):
             self.state = st.replace(nb_count=jnp.zeros((), jnp.int32))
+        self._last_drain_update = self.update
 
     def run(self, max_updates: int | None = None):
         if self.state is None:

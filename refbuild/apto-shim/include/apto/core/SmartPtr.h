@@ -5,58 +5,124 @@
 #include "Definitions.h"
 
 #include <memory>
+#include <type_traits>
 
 namespace Apto {
 
-// Upstream SmartPtr takes storage/ownership/conversion policy params; all
-// shim instantiations share std::shared_ptr semantics (matching the
-// default InternalRCObject policy, the only one avida-core uses).
+// Upstream SmartPtr takes storage/ownership policy params.  avida-core
+// uses two ownership flavors, selected by the policy tag:
+//   * InternalRCObject: intrusive -- the pointee inherits RefCountObject
+//     and carries its own count.  Critical property: constructing a
+//     SmartPtr from a raw pointer ATTACHES to the existing count, so
+//     `FacetPtr(new Facet)->AttachTo(w)` (which stores another SmartPtr
+//     built from `this` inside AttachTo) is safe.  A shared_ptr backing
+//     is NOT equivalent -- each raw-pointer construction would mint a
+//     fresh control block and double-free (the round-4 shim's segfault).
+//   * everything else (default, ThreadSafeRefCount): external counting,
+//     plain shared_ptr semantics; used only for types that are never
+//     re-wrapped from raw pointers.
+// Dispatch is on the tag (not member detection: SmartPtr is routinely
+// instantiated on incomplete types, where detection silently misfires).
 class InternalRCObject {};
 class ThreadSafeRefCount {};
+class ExternalRC {};  // shim default tag (upstream default = non-intrusive)
 
-template <class T, class OwnershipPolicy = InternalRCObject>
+// --- storage impls -------------------------------------------------------
+template <class T, bool Intrusive>
+struct PtrStore;
+
+template <class T>
+struct PtrStore<T, true> {  // intrusive: pointee owns the count
+  typedef typename std::remove_const<T>::type NC;
+  T* p;
+  PtrStore() : p(0) {}
+  explicit PtrStore(T* ptr) : p(ptr) { retain(); }
+  PtrStore(const PtrStore& rhs) : p(rhs.p) { retain(); }
+  template <class T2>
+  PtrStore(const PtrStore<T2, true>& rhs) : p(rhs.p) { retain(); }
+  ~PtrStore() { release(); }
+  PtrStore& operator=(const PtrStore& rhs) { reset(rhs.p); return *this; }
+  void reset(T* ptr) {
+    if (ptr) const_cast<NC*>(ptr)->AddReference();
+    release();
+    p = ptr;
+  }
+  void retain() { if (p) const_cast<NC*>(p)->AddReference(); }
+  void release() { if (p) const_cast<NC*>(p)->RemoveReference(); }
+  T* get() const { return p; }
+};
+
+template <class T>
+struct PtrStore<T, false> {  // external: shared_ptr semantics
+  std::shared_ptr<T> p;
+  PtrStore() {}
+  explicit PtrStore(T* ptr) : p(ptr) {}
+  PtrStore(const std::shared_ptr<T>& sp) : p(sp) {}
+  template <class T2>
+  PtrStore(const PtrStore<T2, false>& rhs) : p(rhs.p) {}
+  void reset(T* ptr) { p.reset(ptr); }
+  T* get() const { return p.get(); }
+};
+
+template <class T, class OwnershipPolicy = ExternalRC>
 class SmartPtr
 {
 private:
-  std::shared_ptr<T> m_ptr;
+  static const bool INTRUSIVE =
+      std::is_same<OwnershipPolicy, InternalRCObject>::value;
+  PtrStore<T, INTRUSIVE> m_store;
   template <class T2, class P2> friend class SmartPtr;
 
 public:
   SmartPtr() {}
-  explicit SmartPtr(T* ptr) : m_ptr(ptr) {}
-  SmartPtr(const std::shared_ptr<T>& p) : m_ptr(p) {}
+  explicit SmartPtr(T* ptr) : m_store(ptr) {}
+  SmartPtr(const std::shared_ptr<T>& p) : m_store(p) {}
+  SmartPtr(const SmartPtr& rhs) : m_store(rhs.m_store) {}
   template <class T2, class P2>
-  SmartPtr(const SmartPtr<T2, P2>& rhs) : m_ptr(rhs.m_ptr) {}
+  SmartPtr(const SmartPtr<T2, P2>& rhs) : m_store(rhs.m_store) {}
+
+  SmartPtr& operator=(const SmartPtr& rhs)
+  { m_store = rhs.m_store; return *this; }
+  template <class T2, class P2>
+  SmartPtr& operator=(const SmartPtr<T2, P2>& rhs)
+  { m_store = PtrStore<T, INTRUSIVE>(rhs.m_store); return *this; }
+
+  T& operator*() const { return *m_store.get(); }
+  T* operator->() const { return m_store.get(); }
+  T* GetPointer() const { return m_store.get(); }
+
+  operator bool() const { return m_store.get() != 0; }
+  bool operator!() const { return !m_store.get(); }
+  template <class T2, class P2>
+  bool operator==(const SmartPtr<T2, P2>& rhs) const
+  { return m_store.get() == rhs.m_store.get(); }
+  template <class T2, class P2>
+  bool operator!=(const SmartPtr<T2, P2>& rhs) const
+  { return m_store.get() != rhs.m_store.get(); }
+  bool operator==(const T* rhs) const { return m_store.get() == rhs; }
+  bool operator!=(const T* rhs) const { return m_store.get() != rhs; }
 
   template <class T2, class P2>
-  SmartPtr& operator=(const SmartPtr<T2, P2>& rhs) { m_ptr = rhs.m_ptr; return *this; }
+  void DynamicCastFrom(const SmartPtr<T2, P2>& rhs)
+  { dynCast(rhs, std::integral_constant<bool, INTRUSIVE>()); }
 
-  T& operator*() const { return *m_ptr; }
-  T* operator->() const { return m_ptr.get(); }
-  T* GetPointer() const { return m_ptr.get(); }
-
-  operator bool() const { return (bool)m_ptr; }
-  bool operator!() const { return !m_ptr; }
+private:
   template <class T2, class P2>
-  bool operator==(const SmartPtr<T2, P2>& rhs) const { return m_ptr == rhs.m_ptr; }
+  void dynCast(const SmartPtr<T2, P2>& rhs, std::true_type)
+  { m_store.reset(dynamic_cast<T*>(rhs.GetPointer())); }
   template <class T2, class P2>
-  bool operator!=(const SmartPtr<T2, P2>& rhs) const { return m_ptr != rhs.m_ptr; }
-  bool operator==(const T* rhs) const { return m_ptr.get() == rhs; }
-  bool operator!=(const T* rhs) const { return m_ptr.get() != rhs; }
-
-  template <class T2>
-  void DynamicCastFrom(const SmartPtr<T2>& rhs)
-  { m_ptr = std::dynamic_pointer_cast<T>(rhs.m_ptr); }
-
-  const std::shared_ptr<T>& Std() const { return m_ptr; }
+  void dynCast(const SmartPtr<T2, P2>& rhs, std::false_type)
+  { m_store.p = std::dynamic_pointer_cast<T>(rhs.m_store.p); }
 };
 
 template <class T, class P>
 inline T* GetInternalPtr(const SmartPtr<T, P>& p) { return p.GetPointer(); }
 
-// RefCountObject: intrusive ref-count base upstream; the shim keeps the
-// API (AddReference/RemoveReference) for classes that inherit it, but
-// SmartPtr above ignores it (shared_ptr external counting).
+// RefCountObject: intrusive ref-count base (apto/core/RefCount.h upstream).
+// Count starts at 0; every SmartPtr attach increments, detach decrements,
+// zero deletes.  The `ManagerPtr(new Manager)->AttachTo(w)` pattern works
+// because AttachTo stores a second SmartPtr built from `this` (count 2)
+// before the temporary releases (count 1).
 template <class ThreadingPolicy = SingleThreaded>
 class RefCountObject
 {

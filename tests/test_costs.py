@@ -158,3 +158,67 @@ def test_instruction_costs_route_off_the_pallas_kernel():
     s2.redundancy[0] = 5.0
     assert not eligible(_params(instset=s2))
     assert eligible(_params())
+
+
+def test_prob_fail_suppresses_effect_but_charges_time():
+    """prob_fail=1: the instruction is flagged executed, IP advances, and
+    time_used accrues, but the effect never happens (cHardwareCPU.cc:988)."""
+    s = default_instset()
+    s.prob_fail[s.opcode("inc")] = 1.0
+    params = _params(instset=s)
+    inc = s.opcode("inc")
+    st = _one_org(params, [inc] * 8)
+    mask = jnp.zeros(params.num_cells, bool).at[0].set(True)
+    key = jax.random.key(3)
+    for _ in range(4):
+        key, k = jax.random.split(key)
+        st = micro_step(params, st, k, mask)
+    assert int(st.regs[0].sum()) == 0          # no increments landed
+    assert int(st.time_used[0]) == 4           # cycles still paid
+    assert int(st.heads[0, 0]) == 4            # IP advanced 1/cycle
+    # executed flags set on every visited site (division viability intact)
+    assert int(((np.asarray(st.tape[0, :4]) >> 6) & 1).sum()) == 4
+
+    # prob_fail=0 control: the same program increments
+    s0 = default_instset()
+    params0 = _params(instset=s0)
+    st0 = _one_org(params0, [inc] * 8)
+    for _ in range(4):
+        key, k = jax.random.split(key)
+        st0 = micro_step(params0, st0, k, mask)
+    assert int(st0.regs[0].sum()) == 4
+
+
+def test_addl_time_cost_inflates_time_used_only():
+    """addl_time_cost adds to time_used (gestation) without consuming extra
+    scheduler cycles (cHardwareCPU.cc:985,1015)."""
+    s = default_instset()
+    s.addl_time_cost[s.opcode("inc")] = 2
+    params = _params(instset=s)
+    inc = s.opcode("inc")
+    st = _one_org(params, [inc] * 8)
+    mask = jnp.zeros(params.num_cells, bool).at[0].set(True)
+    key = jax.random.key(4)
+    for _ in range(3):
+        key, k = jax.random.split(key)
+        st = micro_step(params, st, k, mask)
+    assert int(st.regs[0].sum()) == 3          # all executed normally
+    assert int(st.time_used[0]) == 3 * (1 + 2)
+    assert int(st.cpu_cycles[0]) == 3
+
+
+def test_res_cost_refuses_at_load():
+    s = default_instset()
+    s.res_cost[s.opcode("inc")] = 1.0
+    with pytest.raises(NotImplementedError):
+        _params(instset=s)
+
+
+def test_prob_fail_routes_off_the_pallas_kernel():
+    from avida_tpu.ops.pallas_cycles import eligible
+    s = default_instset()
+    s.prob_fail[s.opcode("inc")] = 0.5
+    assert not eligible(_params(instset=s))
+    s2 = default_instset()
+    s2.addl_time_cost[s2.opcode("inc")] = 1
+    assert not eligible(_params(instset=s2))

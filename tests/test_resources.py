@@ -101,7 +101,7 @@ def test_reaction_reward_uses_consumed_amount():
     bonus0 = jnp.ones(n, jnp.float32)
     tc = jnp.zeros((n, 1), jnp.int32)
     rc = jnp.zeros((n, 1), jnp.int32)
-    bonus, tc, rc, resources, _, _ = tasks_ops.apply_reactions(
+    bonus, tc, rc, resources, _, _, _ = tasks_ops.apply_reactions(
         params, tables, io, logic_id, bonus0, tc, rc,
         jnp.asarray([1000.0]), jnp.zeros((0, n)))
     assert float(bonus[0]) == 32.0
