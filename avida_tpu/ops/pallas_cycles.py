@@ -11,13 +11,16 @@ block of B organisms with every byte of their state resident in VMEM:
   per-cycle work          = VMEM-resident VPU ops only
 
 Layout: organisms live on the LANE dimension (128-wide) --
-  tape_t : uint8[L, N]   opcode planes, position on sublanes (6-bit opcodes
-                         ONLY; the executed/copied site flags live in packed
-                         int32 bitplanes inside ivec, 1 bit per site)
-  off_t  : uint8[L, N]   extracted-offspring planes (see below)
+  tape_t : int32[L/4, N] opcode planes, 4 consecutive positions packed per
+                         word (byte j of word w = position 4w+j; 6-bit
+                         opcodes ONLY -- executed/copied site flags live in
+                         packed int32 bitplanes inside ivec, 1 bit/site).
+                         Every tape pass is SWAR over 4x fewer elements
+                         than the v2 byte layout (the round-5 rewrite).
+  off_t  : int32[L/4, N] extracted-offspring planes, same packing
   ivec   : int32[NI, N]  every int32 per-organism scalar, one row each
   fvec   : f32[NF, N]    float phenotype scalars
-so per-organism scalars are [1, B] lane vectors (2 vregs at B=256) and the
+so per-organism scalars are [1, B] lane vectors (4 vregs at B=512) and the
 tape reductions reduce over sublanes, producing lane vectors directly --
 no orientation changes anywhere in the cycle body.
 
@@ -135,10 +138,13 @@ FV_LAST_MERIT_BASE = 4
 NF = 8
 
 FLAG_MAL, FLAG_ALIVE, FLAG_DIVPEND, FLAG_STERILE = 1, 2, 4, 8
+# kernel-internal: lane divided during THIS launch (offspring extraction
+# runs once post-loop -- the divided parent stalls, so its child region in
+# the tape is frozen until then); never escapes to PopulationState
+FLAG_NEWDIV = 16
 
-DEFAULT_BLOCK = 256
+DEFAULT_BLOCK = 512
 CHUNK = 64           # sublane rows per register-resident traversal chunk
-EAGER_LABEL = 5      # label slots packed in the per-cycle traversal
 
 # Debug/profiling knob: comma-separated feature names whose kernel code is
 # compiled OUT (semantics break!) to measure their cost by ablation, e.g.
@@ -333,6 +339,7 @@ def _make_kernel(params, L, B, num_steps):
     growth cap, h-divide max offspring size) use the TRUE configured
     max_memory so padding never changes physics."""
     L0 = params.max_memory
+    LP = L // 4              # packed tape height: 4 opcode bytes per int32
     R = params.num_reactions
     NI, LW, IV_COPIED_BM, IV_DYN = _layout(params, L)
     num_insts = params.num_insts
@@ -371,15 +378,29 @@ def _make_kernel(params, L, B, num_steps):
 
         granted = ivec_ref[IV_GRANTED, :][None, :]
         # index planes (built in-kernel: closure constants are not allowed)
-        rows = jax.lax.broadcasted_iota(jnp.int32, (L, B), 0)
+        wrows = jax.lax.broadcasted_iota(jnp.int32, (LP, B), 0)
         reg_rows = jax.lax.broadcasted_iota(jnp.int32, (3, B), 0)
         head_rows = jax.lax.broadcasted_iota(jnp.int32, (4, B), 0)
         stk_rows = jax.lax.broadcasted_iota(jnp.int32, (20, B), 0)
         lw_rows = jax.lax.broadcasted_iota(jnp.int32, (LW, B), 0)
 
-        def apply_pending(tc, rows_c, pw_pos, pw_val, pz_s, pz_e):
-            tc = jnp.where(rows_c == pw_pos, pw_val, tc)
-            return jnp.where((rows_c >= pz_s) & (rows_c < pz_e), 0, tc)
+        def bytemask(m):
+            """Mask of the m lowest bytes of an int32 word, m in [0, 4]."""
+            r = jnp.where(m <= 0, 0, 0xFF)
+            r = jnp.where(m >= 2, 0xFFFF, r)
+            r = jnp.where(m >= 3, 0xFFFFFF, r)
+            return jnp.where(m >= 4, -1, r)
+
+        def apply_pending(tc, wrows_c, pw_pos, pw_val, pz_s, pz_e):
+            # deferred h-copy byte write (pw_pos = -1 when none: -1 >> 2
+            # = -1 matches no word row)
+            sh = (pw_pos & 3) * 8
+            tc = jnp.where(wrows_c == (pw_pos >> 2),
+                           (tc & ~(255 << sh)) | (pw_val << sh), tc)
+            # deferred h-alloc zeroing of byte range [pz_s, pz_e)
+            lo = jnp.clip(pz_s - wrows_c * 4, 0, 4)
+            hi = jnp.clip(pz_e - wrows_c * 4, 0, 4)
+            return tc & ~(bytemask(hi) & ~bytemask(lo))
 
         def cycle_body(s, _):
             mlen = jnp.maximum(ivec_ref[IV_MEM_LEN, :][None, :], 1)
@@ -405,36 +426,58 @@ def _make_kernel(params, L, B, num_steps):
             pz_s = ivec_ref[IV_PZ_START, :][None, :]
             pz_e = ivec_ref[IV_PZ_END, :][None, :]
 
-            # ---- THE merged traversal: apply last cycle's deferred tape
-            # writes, store, and extract every per-cycle read, CHUNKED over
-            # the position axis so each chunk's op chain stays
-            # register-resident ----
-            r1 = jnp.zeros((1, B), jnp.int32)
-            lab5 = jnp.zeros((1, B), jnp.int32)
-            for c in range(L // CHUNK):
-                tc = tape_ref[pl.ds(c * CHUNK, CHUNK), :].astype(jnp.int32)
-                rows_c = (jax.lax.broadcasted_iota(jnp.int32, (CHUNK, B), 0)
-                          + c * CHUNK)
-                tc = apply_pending(tc, rows_c, pw_pos, pw_val, pz_s, pz_e)
-                tape_ref[pl.ds(c * CHUNK, CHUNK), :] = tc.astype(jnp.uint8)
-                d = rows_c - ip
-                w1 = ((d == 0).astype(jnp.int32)
-                      + ((rows_c == rp).astype(jnp.int32) << 16))
-                r1 = r1 + jnp.sum(tc * w1, axis=0, keepdims=True)
-                # eager label window: positions (ip+1+k) mod mlen,
-                # k in [0, EAGER_LABEL); slot 0 doubles as the operand
-                # byte (ip+1 incl. the wrap to position 0)
-                rel = d - 1 + jnp.where(d < 1, mlen, 0)
-                sh = jnp.minimum(rel, EAGER_LABEL).astype(jnp.uint32) * 6
-                inw = (rows_c < mlen) & (rel < EAGER_LABEL)
-                lab5 = lab5 + jnp.sum(
-                    jnp.where(inw, tc << sh, 0), axis=0, keepdims=True)
+            # ---- THE merged traversal (packed words, one chunk pass at
+            # bench L): apply last cycle's deferred tape writes, store, and
+            # lift the per-cycle single-word reads into [1, B] lane vectors
+            # via masked sums.  The words collected: the IP word, the
+            # READ-head word, and the 4 words spanning the 10-byte label
+            # window base (ip+1); the wrap-around window tail lives in
+            # words 0-2, read directly after the store. ----
+            ipw = ip >> 2
+            rpw = rp >> 2
+            labw = (ip + 1) >> 2
+            w_ip = jnp.zeros((1, B), jnp.int32)
+            w_rp = jnp.zeros((1, B), jnp.int32)
+            w_lab = [jnp.zeros((1, B), jnp.int32) for _ in range(4)]
+            for c in range(0, LP, CHUNK):
+                cn = min(CHUNK, LP - c)
+                tc = tape_ref[pl.ds(c, cn), :]
+                wrows_c = wrows[:cn, :] + c if c else wrows[:cn, :]
+                tc = apply_pending(tc, wrows_c, pw_pos, pw_val, pz_s, pz_e)
+                tape_ref[pl.ds(c, cn), :] = tc
+                w_ip = w_ip + jnp.sum(
+                    jnp.where(wrows_c == ipw, tc, 0), axis=0, keepdims=True)
+                w_rp = w_rp + jnp.sum(
+                    jnp.where(wrows_c == rpw, tc, 0), axis=0, keepdims=True)
+                for j in range(4):
+                    w_lab[j] = w_lab[j] + jnp.sum(
+                        jnp.where(wrows_c == labw + j, tc, 0),
+                        axis=0, keepdims=True)
+            # wrap words for the label window (post-store = pending applied)
+            w_wrap = [tape_ref[w, :][None, :] for w in range(3)]
 
-            s_ip = r1 & 255
-            s_ip1 = lab5 & 63
-            s_rp = (r1 >> 16) & 63
+            s_ip = (w_ip >> ((ip & 3) * 8)) & 63
+            s_rp = (w_rp >> ((rp & 3) * 8)) & 63
 
-            cur_op = jnp.clip(s_ip & 63, 0, num_insts - 1)
+            # label-window bytes k = 0..9 at positions (ip+1+k) mod mlen;
+            # slot 0 doubles as the operand byte
+            lab_bytes = []
+            for k in range(MAX_LABEL_SIZE):
+                p = ip + 1 + k
+                wrapped = p >= mlen
+                pa = p - jnp.where(wrapped, mlen, 0)
+                ws = pa >> 2
+                w = jnp.where(ws == labw + 1, w_lab[1],
+                              jnp.where(ws == labw + 2, w_lab[2],
+                                        jnp.where(ws == labw + 3, w_lab[3],
+                                                  w_lab[0])))
+                wv = jnp.where(ws == 1, w_wrap[1],
+                               jnp.where(ws == 2, w_wrap[2], w_wrap[0]))
+                w = jnp.where(wrapped, wv, w)
+                lab_bytes.append((w >> ((pa & 3) * 8)) & 63)
+            s_ip1 = lab_bytes[0]
+
+            cur_op = jnp.clip(s_ip, 0, num_insts - 1)
             ebm = ivec_ref[pl.ds(IV_EXEC_BM, LW), :]          # [LW, B]
             cbm = ivec_ref[pl.ds(IV_COPIED_BM, LW), :]        # [LW, B]
             ip_exec_already = _read_bit(ebm, lw_rows, ip)
@@ -461,12 +504,9 @@ def _make_kernel(params, L, B, num_steps):
             consumed = has_mod.astype(jnp.int32)
             next_pos = adjust1(ip + 1, mlen)
 
-            # ---- label decode: eager 5 slots; the full 10-slot window is
-            # a gated second pass that only fires when some lane executes a
-            # label op whose first 5 window slots are ALL nops ----
+            # ---- label decode: all 10 window slots come straight from the
+            # packed-word byte assembly above (no second tape pass) ----
             has_label = mod_kind == MOD_LABEL
-            lab_ops = [jnp.clip((lab5 >> (6 * k)) & 63, 0, num_insts - 1)
-                       for k in range(EAGER_LABEL)]
 
             def slot_nop(v):
                 if nops_prefix:
@@ -476,41 +516,9 @@ def _make_kernel(params, L, B, num_steps):
             run = jnp.ones_like(cur_op)
             label_len = jnp.zeros_like(cur_op)
             lab_vals = []
-            for k in range(EAGER_LABEL):
-                isn, nv = slot_nop(lab_ops[k])
+            for k in range(MAX_LABEL_SIZE):
+                isn, nv = slot_nop(jnp.clip(lab_bytes[k], 0, num_insts - 1))
                 in_range = (k + 1) <= (mlen - 1)
-                run = run * (isn & in_range).astype(jnp.int32)
-                label_len = label_len + run
-                lab_vals.append(nv)
-
-            need_ext = has_label & (label_len >= EAGER_LABEL)
-
-            def ext_pass(_):
-                hi = jnp.zeros((1, B), jnp.int32)
-                for c in range(L // CHUNK):
-                    tc = tape_ref[pl.ds(c * CHUNK, CHUNK), :].astype(jnp.int32)
-                    rows_c = (jax.lax.broadcasted_iota(
-                        jnp.int32, (CHUNK, B), 0) + c * CHUNK)
-                    d = rows_c - ip
-                    rel = d - 1 + jnp.where(d < 1, mlen, 0)
-                    rel2 = rel - EAGER_LABEL
-                    sh = jnp.clip(rel2, 0,
-                                  MAX_LABEL_SIZE - EAGER_LABEL
-                                  ).astype(jnp.uint32) * 6
-                    inw = ((rows_c < mlen) & (rel2 >= 0)
-                           & (rel2 < MAX_LABEL_SIZE - EAGER_LABEL))
-                    hi = hi + jnp.sum(jnp.where(inw, tc << sh, 0),
-                                      axis=0, keepdims=True)
-                return hi
-
-            lab_hi = jax.lax.cond(
-                jnp.any(need_ext) if "labelext" not in _ABLATE else False,
-                ext_pass,
-                lambda _: jnp.zeros((1, B), jnp.int32), None)
-            for k in range(MAX_LABEL_SIZE - EAGER_LABEL):
-                v = jnp.clip((lab_hi >> (6 * k)) & 63, 0, num_insts - 1)
-                isn, nv = slot_nop(v)
-                in_range = (EAGER_LABEL + k + 1) <= (mlen - 1)
                 run = run * (isn & in_range).astype(jnp.int32)
                 label_len = label_len + run
                 lab_vals.append(nv)
@@ -569,86 +577,76 @@ def _make_kernel(params, L, B, num_steps):
             active_stack = jnp.where(is_op(SEM_SWAP_STK), 1 - a_stk, a_stk)
 
             # ---- h-search (gated on any lane searching) ----
-            # Fast matcher for labels of length <= EAGER_LABEL (covers all
-            # real genomes; nop complement values are 0..2, so a 5-slot
-            # label packs into 10 bits base-4 with 3 as the "non-nop"
-            # sentinel).  Chunked over the position axis so every
-            # intermediate stays register-resident -- the v1 whole-plane
-            # matcher was ~35% of total kernel time at bench scale.
+            # SWAR window matcher over the packed tape: every byte maps to
+            # a 2-bit complement code (nop-A/B/C = 0/1/2, non-nop = 3), 4
+            # codes per word pack into 8 bits, and the 20-bit window
+            # starting at byte b of word w is bits [2b, 2b+20) of the
+            # 4-word concatenation -- one pass handles every label length
+            # up to MAX_LABEL_SIZE.
             srch = is_op(SEM_H_SEARCH)
 
-            def search_fast(_):
+            def search_match(_):
                 # packed complement label, 2 bits per slot
                 c2 = jnp.zeros((1, B), jnp.int32)
-                for k in range(EAGER_LABEL):
+                for k in range(MAX_LABEL_SIZE):
                     c2 = c2 | (jnp.clip(lbl_c[k], 0, 3) << (2 * k))
                 m2 = (jnp.int32(1) << (2 * jnp.minimum(
-                    label_len, EAGER_LABEL)).astype(jnp.uint32)) - 1
+                    label_len, MAX_LABEL_SIZE)).astype(jnp.uint32)) - 1
                 c2 = c2 & m2
-                ok_lane = (label_len > 0) & (label_len <= EAGER_LABEL)
+                ok_lane = label_len > 0
                 best = jnp.full((1, B), L, jnp.int32)
-                W = EAGER_LABEL - 1
-                for c in range(L // CHUNK):
-                    hi = min(CHUNK + W, L - c * CHUNK)
-                    tc = tape_ref[pl.ds(c * CHUNK, hi), :].astype(jnp.int32)
-                    if hi < CHUNK + W:
+                W = 3            # extra lookahead words for the 20-bit window
+                for c in range(0, LP, CHUNK):
+                    hi = min(CHUNK + W, LP - c)
+                    cn = min(CHUNK, LP - c)
+                    tc = tape_ref[pl.ds(c, hi), :]
+                    if hi < cn + W:
                         tc = jnp.concatenate(
-                            [tc, jnp.full((CHUNK + W - hi, B), 3, jnp.int32)],
-                            axis=0)
+                            [tc, jnp.full((cn + W - hi, B),
+                                          0x3F3F3F3F, jnp.int32)], axis=0)
+                    # per-byte 2-bit complement codes (SWAR; the per-byte
+                    # ==0 test is bit7 of x | (0x80 - x), borrow-free for
+                    # 6-bit opcode bytes)
+                    M80 = jnp.int32(-2139062144)        # 0x80808080
+
+                    def byte_eqz(x):
+                        return ((x | (M80 - x)) >> 7) & 0x01010101
+
                     if nops_prefix:
-                        nv2 = jnp.where(tc < 3, tc, 3)
+                        # code = min(byte, 3): byte >= 3 <=> byte>>2 != 0
+                        # or byte == 3
+                        b2 = (tc >> 2) & 0x3F3F3F3F
+                        ge3f = ((byte_eqz(b2) ^ 0x01010101)
+                                | byte_eqz(tc ^ 0x03030303))
+                        cc = (tc | (ge3f * 0xFF)) & 0x03030303
                     else:
-                        nv2 = jnp.full_like(tc, 3)
+                        cc = jnp.full_like(tc, 0x03030303)
                         for k in range(num_insts):
                             if nop_tab[k]:
-                                nv2 = jnp.where(
-                                    tc == k, jnp.int32(int(nmod_tab[k])), nv2)
-                    w2 = jnp.zeros((CHUNK, B), jnp.int32)
-                    for k in range(EAGER_LABEL):
-                        w2 = w2 | (nv2[k:k + CHUNK, :] << (2 * k))
-                    rows_c = (jax.lax.broadcasted_iota(
-                        jnp.int32, (CHUNK, B), 0) + c * CHUNK)
-                    hit = ((w2 & m2) == c2) & ok_lane \
-                        & ((rows_c + label_len) <= mlen)
+                                ek = byte_eqz(tc ^ (int(k) * 0x01010101))
+                                cc = ((cc & ~(ek * 0xFF))
+                                      | (ek * int(nmod_tab[k])))
+                    # pack 4 x 2-bit codes -> 8 bits per word
+                    cc8 = (cc | (cc >> 6) | (cc >> 12) | (cc >> 18)) & 0xFF
+                    cat = (cc8[:cn, :] | (cc8[1:cn + 1, :] << 8)
+                           | (cc8[2:cn + 2, :] << 16)
+                           | (cc8[3:cn + 3, :] << 24))
+                    rows4 = (wrows[:cn, :] + c) * 4
+                    posw = jnp.full((cn, B), L, jnp.int32)
+                    for b in range(3, -1, -1):
+                        hb = (((cat >> (2 * b)) & m2) == c2) & ok_lane \
+                            & ((rows4 + b + label_len) <= mlen)
+                        posw = jnp.where(hb, rows4 + b, posw)
                     best = jnp.minimum(
-                        best, jnp.min(jnp.where(hit, rows_c, L), axis=0,
-                                      keepdims=True))
+                        best, jnp.min(posw, axis=0, keepdims=True))
                 return best
-
-            def search_slow(_):
-                # general matcher (labels longer than EAGER_LABEL): the
-                # whole-plane version; fires only for 6+-nop labels
-                clipped = jnp.clip(tape_ref[...].astype(jnp.int32),
-                                   0, num_insts - 1)
-                nopval_p = jnp.full_like(clipped, -1)
-                for k in range(num_insts):
-                    if nop_tab[k]:
-                        hit = clipped == k
-                        nopval_p = jnp.where(hit, jnp.int32(int(nmod_tab[k])),
-                                             nopval_p)
-                match = jnp.ones((L, B), jnp.bool_)
-                for k in range(MAX_LABEL_SIZE):
-                    if k == 0:
-                        shifted = nopval_p
-                    else:
-                        shifted = jnp.concatenate(
-                            [nopval_p[k:, :],
-                             jnp.full((k, B), -2, jnp.int32)], axis=0)
-                    mk = shifted == lbl_c[k]
-                    match = match & (mk | (k >= label_len))
-                match = match & ((rows + label_len) <= mlen) & (label_len > 0)
-                q = jnp.min(jnp.where(match, rows, L), axis=0, keepdims=True)
-                return q
 
             if "search" in _ABLATE:
                 q_found = jnp.full((1, B), L, jnp.int32)
             else:
                 q_found = jax.lax.cond(
-                    jnp.any(srch & (label_len <= EAGER_LABEL)), search_fast,
+                    jnp.any(srch & (label_len > 0)), search_match,
                     lambda _: jnp.full((1, B), L, jnp.int32), None)
-                q_found = jax.lax.cond(
-                    jnp.any(srch & (label_len > EAGER_LABEL)), search_slow,
-                    lambda _: q_found, None)
             found = q_found < L
             ip_after_label = adjust1(ip + label_len, mlen)
             search_head = jnp.where(found, q_found + label_len - 1,
@@ -724,20 +722,20 @@ def _make_kernel(params, L, B, num_steps):
                                      ).astype(jnp.int32))
 
             # divide-viability zone counts: masked popcounts over the site
-            # bitplanes, run only on cycles where some lane tries h-divide
-            def div_counts(_):
+            # bitplanes.  Unconditional: at B=256 some lane tries h-divide
+            # on ~half of all cycles, and the [LW, B] popcounts are cheaper
+            # than the cond barrier they used to hide behind.
+            if "divcounts" not in _ABLATE:
                 below_p = _word_range_mask(lw_rows, jnp.zeros_like(ip),
                                            parent_size)
                 child_z = _word_range_mask(lw_rows, parent_size, child_end)
-                e = jnp.sum(_popcount32(ebm & below_p), axis=0, keepdims=True)
-                cc = jnp.sum(_popcount32(cbm & child_z), axis=0, keepdims=True)
-                return e, cc
-
-            exec_count0, copied_count = jax.lax.cond(
-                jnp.any(div_try) if "divcounts" not in _ABLATE else False,
-                div_counts,
-                lambda _: (jnp.zeros((1, B), jnp.int32),
-                           jnp.zeros((1, B), jnp.int32)), None)
+                exec_count0 = jnp.sum(_popcount32(ebm & below_p), axis=0,
+                                      keepdims=True)
+                copied_count = jnp.sum(_popcount32(cbm & child_z), axis=0,
+                                       keepdims=True)
+            else:
+                exec_count0 = jnp.zeros((1, B), jnp.int32)
+                copied_count = jnp.zeros((1, B), jnp.int32)
             exec_count = exec_count0 + jnp.where(
                 div_try & ~ip_exec_already & (ip < parent_size), 1, 0)
             sterile_f = (flags & FLAG_STERILE) != 0
@@ -754,26 +752,10 @@ def _make_kernel(params, L, B, num_steps):
             off_len = jnp.where(div_m, child_size,
                                 ivec_ref[IV_OFF_LEN, :][None, :])
 
-            # ---- offspring extraction into the off plane (gated): a
-            # per-lane barrel roll of the opcode tape by the read-head
-            # offset, masked to the child region ----
-            def extract(_):
-                acc = tape_ref[...]
-                r = rp
-                k = 1
-                while k < L:
-                    rolled = jnp.concatenate([acc[k:, :], acc[:k, :]], axis=0)
-                    bit = (r & k) != 0
-                    acc = jnp.where(bit, rolled, acc)
-                    k <<= 1
-                keep = div_m & (rows < off_len)
-                return jnp.where(keep, acc,
-                                 jnp.where(div_m, jnp.uint8(0), off_ref[...]))
-
-            if "extract" not in _ABLATE:
-                off_new = jax.lax.cond(jnp.any(div_m), extract,
-                                       lambda _: off_ref[...], None)
-                off_ref[...] = off_new
+            # (offspring extraction happens ONCE post-loop: a divided lane
+            # stalls for the rest of the launch, so its child region
+            # [off_start, off_start + off_len) is frozen in the tape; the
+            # FLAG_NEWDIV bit marks lanes to extract)
 
             # ---- IO + tasks (per-organism, infinite resources) ----
             io_m = is_op(SEM_IO)
@@ -1045,10 +1027,12 @@ def _make_kernel(params, L, B, num_steps):
             ivec_ref[IV_OFF_LEN, :] = off_len[0]
             ivec_ref[IV_OFF_COPIED, :] = off_copied[0]
             ivec_ref[IV_INSTS_EXEC, :] = insts_exec[0]
+            newdiv = ((flags & FLAG_NEWDIV) != 0) | div_m
             flags_new = (jnp.where(new_mal, FLAG_MAL, 0)
                          | jnp.where(alive, FLAG_ALIVE, 0)
                          | jnp.where(divide_pending, FLAG_DIVPEND, 0)
-                         | jnp.where(sterile_f, FLAG_STERILE, 0))
+                         | jnp.where(sterile_f, FLAG_STERILE, 0)
+                         | jnp.where(newdiv, FLAG_NEWDIV, 0))
             ivec_ref[IV_FLAGS, :] = flags_new[0]
             ivec_ref[pl.ds(IV_REGS, 3), :] = regs_new
             ivec_ref[pl.ds(IV_HEADS, 4), :] = heads_new
@@ -1096,7 +1080,9 @@ def _make_kernel(params, L, B, num_steps):
         def body(carry):
             s, _ = carry
             cycle_body(s, None)
-            return (s + 1, 0)
+            cycle_body(s + 1, None)   # overshoot past block_max is a
+            #                           fully-masked no-op cycle
+            return (s + 2, 0)
 
         jax.lax.while_loop(cond, body, (jnp.int32(0), 0))
 
@@ -1106,15 +1092,43 @@ def _make_kernel(params, L, B, num_steps):
         pw_val = ivec_ref[IV_PW_VAL, :][None, :]
         pz_s = ivec_ref[IV_PZ_START, :][None, :]
         pz_e = ivec_ref[IV_PZ_END, :][None, :]
-        for c in range(L // CHUNK):
-            tc = tape_ref[pl.ds(c * CHUNK, CHUNK), :].astype(jnp.int32)
-            rows_c = (jax.lax.broadcasted_iota(jnp.int32, (CHUNK, B), 0)
-                      + c * CHUNK)
-            tc = apply_pending(tc, rows_c, pw_pos, pw_val, pz_s, pz_e)
-            tape_ref[pl.ds(c * CHUNK, CHUNK), :] = tc.astype(jnp.uint8)
+        for c in range(0, LP, CHUNK):
+            cn = min(CHUNK, LP - c)
+            tc = tape_ref[pl.ds(c, cn), :]
+            wrows_c = wrows[:cn, :] + c if c else wrows[:cn, :]
+            tc = apply_pending(tc, wrows_c, pw_pos, pw_val, pz_s, pz_e)
+            tape_ref[pl.ds(c, cn), :] = tc
         ivec_ref[IV_PW_POS, :] = jnp.full((B,), -1, jnp.int32)
         ivec_ref[IV_PZ_START, :] = jnp.zeros((B,), jnp.int32)
         ivec_ref[IV_PZ_END, :] = jnp.zeros((B,), jnp.int32)
+
+        # ---- one-shot offspring extraction for every lane that divided
+        # during this launch: a per-lane barrel roll of the opcode tape by
+        # the saved off_start, masked to the child's off_len bytes ----
+        def extract_all(_):
+            newdiv = (ivec_ref[IV_FLAGS, :][None, :] & FLAG_NEWDIV) != 0
+            osr = ivec_ref[IV_OFF_START, :][None, :]
+            oln = ivec_ref[IV_OFF_LEN, :][None, :]
+            acc = tape_ref[...]
+            rw = osr >> 2
+            k = 1
+            while k < LP:
+                rolled = jnp.concatenate([acc[k:, :], acc[:k, :]], axis=0)
+                acc = jnp.where((rw & k) != 0, rolled, acc)
+                k <<= 1
+            rb = osr & 3
+            nxt = jnp.concatenate([acc[1:, :], acc[:1, :]], axis=0)
+            shl = jnp.minimum((4 - rb) * 8, 31)   # only read when rb > 0
+            comb = ((acc >> (rb * 8)) & bytemask(4 - rb)) | (nxt << shl)
+            acc = jnp.where(rb == 0, acc, comb)
+            km = bytemask(jnp.clip(oln - wrows * 4, 0, 4))
+            return jnp.where(newdiv, acc & km, off_ref[...])
+
+        if "extract" not in _ABLATE:
+            any_newdiv = jnp.any(
+                (ivec_ref[IV_FLAGS, :][None, :] & FLAG_NEWDIV) != 0)
+            off_ref[...] = jax.lax.cond(any_newdiv, extract_all,
+                                        lambda _: off_ref[...], None)
 
     return kernel, NI
 
@@ -1126,6 +1140,21 @@ def _dims(params, n, L0):
     # the kernel must cover the whole tape
     L = ((L0 + CHUNK - 1) // CHUNK) * CHUNK
     return B, n_pad, L
+
+
+def _pack_words(tape, L):
+    """uint8[N, L] -> int32[N, L//4] with byte j of word w = position
+    4w+j (little-endian bitcast; opcode bytes are <= 63 so every word is
+    non-negative and in-kernel arithmetic right shifts are safe)."""
+    n = tape.shape[0]
+    return jax.lax.bitcast_convert_type(
+        tape.reshape(n, L // 4, 4), jnp.int32).reshape(n, L // 4)
+
+
+def _unpack_words(words, L):
+    """int32[N, L//4] -> uint8[N, L] (inverse of _pack_words)."""
+    n = words.shape[0]
+    return jax.lax.bitcast_convert_type(words, jnp.uint8).reshape(n, L)
 
 
 def _flag_to_words(tape, bit, L):
@@ -1168,13 +1197,15 @@ def pack_state(params, st, granted):
     def padn(x):
         return jnp.pad(x, ((0, n_pad - n),) + ((0, 0),) * (x.ndim - 1))
 
-    # ---- tape: opcode plane + site-flag bitplanes ----
+    # ---- tape: 4-opcodes-per-int32 word plane (byte j of word w =
+    # position 4w+j; little-endian bitcast, same convention as
+    # _flag_to_words) + site-flag bitplanes ----
     tape_p = jnp.pad(st.tape, ((0, 0), (0, L - L0)))
-    opc_t = padn(tape_p & jnp.uint8(63)).T                     # [L, n_pad]
+    opc_t = padn(_pack_words(tape_p & jnp.uint8(63), L)).T     # [LP, n_pad]
     exec_w = _flag_to_words(tape_p, 6, L)                      # [n, LW]
     cop_w = _flag_to_words(tape_p, 7, L)
     off_p = jnp.pad(st.off_tape, ((0, 0), (0, L - L0)))
-    off_t = padn(off_p).T                                      # [L, n_pad]
+    off_t = padn(_pack_words(off_p, L)).T                      # [LP, n_pad]
 
     iv = [None] * NI
 
@@ -1254,7 +1285,8 @@ def pack_state(params, st, granted):
 def run_packed(params, packed, key, num_steps):
     """One kernel launch over the packed state quad (traced)."""
     tape_t, off_t, ivec, fvec = packed
-    L, n_pad = tape_t.shape
+    LP, n_pad = tape_t.shape
+    L = LP * 4
     NI, LW, _, _ = _layout(params, L)
     B = min(DEFAULT_BLOCK, n_pad)
 
@@ -1268,20 +1300,20 @@ def run_packed(params, packed, key, num_steps):
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((L, B), lambda i: (0, i)),
-            pl.BlockSpec((L, B), lambda i: (0, i)),
+            pl.BlockSpec((LP, B), lambda i: (0, i)),
+            pl.BlockSpec((LP, B), lambda i: (0, i)),
             pl.BlockSpec((NI, B), lambda i: (0, i)),
             pl.BlockSpec((NF, B), lambda i: (0, i)),
         ],
         out_specs=[
-            pl.BlockSpec((L, B), lambda i: (0, i)),
-            pl.BlockSpec((L, B), lambda i: (0, i)),
+            pl.BlockSpec((LP, B), lambda i: (0, i)),
+            pl.BlockSpec((LP, B), lambda i: (0, i)),
             pl.BlockSpec((NI, B), lambda i: (0, i)),
             pl.BlockSpec((NF, B), lambda i: (0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((L, n_pad), jnp.uint8),
-            jax.ShapeDtypeStruct((L, n_pad), jnp.uint8),
+            jax.ShapeDtypeStruct((LP, n_pad), jnp.int32),
+            jax.ShapeDtypeStruct((LP, n_pad), jnp.int32),
             jax.ShapeDtypeStruct((NI, n_pad), jnp.int32),
             jax.ShapeDtypeStruct((NF, n_pad), jnp.float32),
         ],
@@ -1297,7 +1329,7 @@ def unpack_state(params, st, packed):
     tape_o, off_o, ivec_o, fvec_o = packed
     n, L0 = st.tape.shape
     R = params.num_reactions
-    L = tape_o.shape[0]
+    L = tape_o.shape[0] * 4
     NI, LW, IV_COPIED_BM, IV_DYN = _layout(params, L)
 
     def row(i):
@@ -1306,8 +1338,8 @@ def unpack_state(params, st, packed):
     def frow(i):
         return fvec_o[i, :n]
 
-    # rebuild the flag-bit tape from the opcode plane + bitplanes
-    opc = tape_o.T[:n]                                         # [n, L]
+    # rebuild the flag-bit tape from the packed word plane + bitplanes
+    opc = _unpack_words(tape_o.T[:n], L)                       # [n, L]
     exec_w = jnp.stack([row(IV_EXEC_BM + w) for w in range(LW)], axis=1)
     cop_w = jnp.stack([row(IV_COPIED_BM + w) for w in range(LW)], axis=1)
     tape = (opc | _words_to_flag(exec_w, 6, L)
@@ -1316,7 +1348,7 @@ def unpack_state(params, st, packed):
     flags = row(IV_FLAGS)
     return st.replace(
         tape=tape,
-        off_tape=off_o.T[:n, :L0],
+        off_tape=_unpack_words(off_o.T[:n], L)[:, :L0],
         mem_len=row(IV_MEM_LEN),
         regs=jnp.stack([row(IV_REGS + k) for k in range(3)], axis=1),
         heads=jnp.stack([row(IV_HEADS + k) for k in range(4)], axis=1),
@@ -1361,11 +1393,13 @@ def run_cycles(params, st, key, granted, num_steps):
     `granted` (int32[N]) through the VMEM-resident kernel.  Returns the new
     PopulationState.  Caller must check `eligible(params)` first.
 
-    (A budget-sorted block permutation was tried here and reverted: each
-    block runs to ITS OWN max budget, so sorting organisms by budget cuts
-    masked idle lanes ~35% -- but permuting the packed state costs ~10 ms
-    of gather/transpose per update on this part, swamping the win.  The
-    throughput knob for heavy-tailed budgets is TPU_MAX_STEPS_PER_UPDATE.)"""
+    (Budget-sorted lane permutations were tried twice -- per-lane in round
+    4 (~10 ms of gathers) and 8-lane-tile-granular in round 5 (~15 ms
+    fused; the microbenchmark that suggested 0.2 ms was invalidated by
+    identical-input result caching) -- and reverted both times: ANY
+    traced lane-axis gather of the packed state swamps the tail saving.
+    The throughput knob for heavy-tailed budgets remains
+    TPU_MAX_STEPS_PER_UPDATE.)"""
     packed = pack_state(params, st, granted)
     packed = run_packed(params, packed, key, num_steps)
     return unpack_state(params, st, packed)

@@ -756,8 +756,11 @@ class World:
                 # live arrays.  Only newborns that were overwritten by a
                 # later birth AND died are unrecoverable.
                 bu = np.asarray(st.birth_update)
-                win_start = getattr(self, "_last_drain_update", -1)
-                in_window = alive & (bu > win_start)
+                # window = updates since the last drain (inclusive: the
+                # previous drain set _last_drain_update to one past ITS
+                # window); bu >= 0 excludes seed cells (bu == -1)
+                win_start = getattr(self, "_last_drain_update", 0)
+                in_window = alive & (bu >= max(win_start, 0))
                 recorded = set(zip(cells.tolist(), updates.tolist()))
                 extra = np.asarray([c for c in np.nonzero(in_window)[0]
                                     if (int(c), int(bu[c])) not in recorded],

@@ -74,10 +74,12 @@ public:
   inline void Resize(int new_size)
   {
     if (new_size < 0) new_size = 0;
-    if (new_size > m_size) {
-      grow(new_size);
-      for (int i = m_size; i < new_size; i++) m_data[i] = T();
-    }
+    // new slots keep their new[]-default-constructed state from grow();
+    // assigning T() here would run T::operator= against a default-
+    // constructed temporary, which classes like cPopulationCell (null
+    // m_mut_rates dereferenced in operator=) do not support -- upstream
+    // apto also leaves new slots default-constructed
+    if (new_size > m_size) grow(new_size);
     m_size = new_size;
   }
   inline void Resize(int new_size, const T& empty_value)

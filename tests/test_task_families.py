@@ -79,7 +79,7 @@ def test_math_reaction_rewards_bonus():
     out = jnp.asarray([12, 11, 12, 12], jnp.int32)
     io = jnp.asarray([True, True, False, True])
     logic_id = tasks_ops.compute_logic_id(ib, ibn, out)
-    bonus, tc, rc, _, _, any_r = tasks_ops.apply_reactions(
+    bonus, tc, rc, _, _, _, any_r = tasks_ops.apply_reactions(
         params, tables, io, logic_id, jnp.ones(n, jnp.float32),
         jnp.zeros((n, 1), jnp.int32), jnp.zeros((n, 1), jnp.int32),
         jnp.zeros(0), jnp.zeros((0, n)),
