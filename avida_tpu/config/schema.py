@@ -174,6 +174,10 @@ class AvidaConfig:
     DEMES_MAX_AGE: int = 500
     DEMES_MAX_BIRTHS: int = 100
     DEMES_MIGRATION_RATE: float = 0.0
+    DEMES_MIGRATION_METHOD: int = 0  # 0=any, 1=8-neighbor deme grid,
+    #                                  2=list-adjacent, 4=MIGRATION_FILE matrix
+    DEMES_NUM_X: int = 0             # deme-grid width for method 1
+    MIGRATION_FILE: str = "-"        # DxD weight matrix for method 4
 
     # --- Energy model (off by default) ---
     ENERGY_ENABLED: int = 0

@@ -98,6 +98,9 @@ class WorldParams(struct.PyTreeNode):
     # systematics: device-side newborn ring buffer (chunked-run phylogeny
     # ingestion; 0 = off)
     nb_cap: int = struct.field(pytree_node=False, default=0)
+    # intra-organism threads (cAvidaConfig.h:558-564)
+    max_cpu_threads: int = struct.field(pytree_node=False, default=1)
+    thread_slicing_method: int = struct.field(pytree_node=False, default=0)
     # death
     death_method: int = struct.field(pytree_node=False, default=2)
     age_limit: int = struct.field(pytree_node=False, default=20)
@@ -108,6 +111,10 @@ class WorldParams(struct.PyTreeNode):
     demes_max_age: int = struct.field(pytree_node=False, default=500)
     demes_max_births: int = struct.field(pytree_node=False, default=100)
     demes_migration_rate: float = struct.field(pytree_node=False, default=0.0)
+    demes_migration_method: int = struct.field(pytree_node=False, default=0)
+    demes_num_x: int = struct.field(pytree_node=False, default=0)
+    # method-4 per-source-deme cumulative weights, tuple[D] of tuple[D]
+    migration_cdf: tuple = struct.field(pytree_node=False, default=())
     # birth
     birth_method: int = struct.field(pytree_node=False, default=0)
     population_cap: int = struct.field(pytree_node=False, default=0)
@@ -172,6 +179,31 @@ class WorldParams(struct.PyTreeNode):
         return self.world_x * self.world_y
 
 
+def _migration_cdf(cfg):
+    """Method-4 migration: per-source-deme cumulative weight rows from the
+    MIGRATION_FILE matrix (cMigrationMatrix::GetProbabilisticDemeID).  The
+    parsed matrix is attached to cfg by World (which owns the config
+    directory); a bare cfg with method 4 and no matrix refuses."""
+    if int(cfg.DEMES_MIGRATION_METHOD) != 4:
+        return ()
+    mat = getattr(cfg, "_migration_matrix", None)
+    if mat is None:
+        raise ValueError(
+            "DEMES_MIGRATION_METHOD 4 requires MIGRATION_FILE (an NxN "
+            "weight matrix; cMigrationMatrix::Load)")
+    rows = []
+    for r in mat:
+        tot = float(sum(r))
+        if tot <= 0:
+            raise ValueError("MIGRATION_FILE row with no positive weight")
+        acc, row = 0.0, []
+        for v in r:
+            acc += float(v) / tot
+            row.append(acc)
+        rows.append(tuple(row))
+    return tuple(rows)
+
+
 def make_world_params(cfg, instset, environment) -> WorldParams:
     """Build WorldParams from parsed config objects (host side)."""
     tables = instset_tables(instset)
@@ -185,6 +217,10 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         raise NotImplementedError(
             "instset res_cost (resource-bin execution costs, cInstSet.h:69) "
             "is not implemented; zero the res_cost column")
+    if cfg.MAX_CPU_THREADS > 1 and instset.hw_type != 0:
+        raise NotImplementedError(
+            "MAX_CPU_THREADS > 1 is implemented for heads hardware only "
+            "(TransSMT has its own host/parasite thread model)")
     if instset.hw_type in (1, 2) and (instset.cost.any()
                                       or instset.ft_cost.any()
                                       or instset.prob_fail.any()
@@ -198,6 +234,16 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
             raise ValueError(
                 f"GRADIENT_RESOURCE {r.name!r} peak ({r.peakx},{r.peaky}) "
                 f"lies outside the {cfg.WORLD_X}x{cfg.WORLD_Y} world")
+    if int(cfg.DEMES_MIGRATION_METHOD) == 3:
+        raise NotImplementedError(
+            "DEMES_MIGRATION_METHOD 3 (deme points) needs the deme points "
+            "system, which is not modeled; use methods 0/1/2/4")
+    if int(cfg.DEMES_MIGRATION_METHOD) == 1 and cfg.NUM_DEMES > 1 \
+            and (cfg.DEMES_NUM_X <= 0
+                 or cfg.NUM_DEMES % max(cfg.DEMES_NUM_X, 1)):
+        raise ValueError(
+            "DEMES_MIGRATION_METHOD 1 requires DEMES_NUM_X dividing "
+            "NUM_DEMES (cPopulation.cc:5530)")
     if cfg.POPULATION_CAP and cfg.POP_CAP_ELDEST:
         raise ValueError(
             "POPULATION_CAP and POP_CAP_ELDEST are mutually exclusive "
@@ -253,6 +299,9 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         demes_max_age=cfg.DEMES_MAX_AGE,
         demes_max_births=cfg.DEMES_MAX_BIRTHS,
         demes_migration_rate=cfg.DEMES_MIGRATION_RATE,
+        demes_migration_method=int(cfg.DEMES_MIGRATION_METHOD),
+        demes_num_x=int(cfg.DEMES_NUM_X),
+        migration_cdf=_migration_cdf(cfg),
         death_method=cfg.DEATH_METHOD,
         age_limit=cfg.AGE_LIMIT,
         birth_method=cfg.BIRTH_METHOD,
@@ -272,6 +321,8 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         inst_energy_cost=tuple(float(x) for x in instset.energy_cost)
         if instset.energy_cost.any() else (),
         dispersal_rate=cfg.DISPERSAL_RATE,
+        max_cpu_threads=max(int(cfg.MAX_CPU_THREADS), 1),
+        thread_slicing_method=int(cfg.THREAD_SLICING_METHOD),
         nb_cap=2 * cfg.WORLD_X * cfg.WORLD_Y
         if cfg.get("TPU_SYSTEMATICS", 1) else 0,
         generation_inc_method=cfg.GENERATION_INC_METHOD,
@@ -358,6 +409,30 @@ class PopulationState(struct.PyTreeNode):
     active_stack: jax.Array   # int32[N]
     read_label: jax.Array     # int8[N, 10]  nops most recently copied
     read_label_len: jax.Array  # int32[N]
+
+    # --- intra-organism threads (cHardwareCPU.h m_threads; sized by
+    # MAX_CPU_THREADS = T; Te = T-1 extra slots are ZERO-SIZE at the
+    # default T=1, so single-threaded configs pay nothing).  The primary
+    # fields above store slot 0's thread state; t_* arrays store slots
+    # 1..T-1.  Thread-local per cHardwareCPU::cLocalThread: registers,
+    # heads, local stack (stack 0), active-stack selector, read label.
+    # Stack 1 (global) and everything else is organism-shared. ---
+    # Slot 0 (the primary fields above) is ALWAYS the state of an alive
+    # thread -- killing it copies another live thread into the primary
+    # fields, mirroring the reference's array compaction (KillThread
+    # cc:1604 copies the last thread into the killed position).  Extra
+    # slots are sparse: t_alive marks occupancy, slots never move.
+    t_alive: jax.Array         # bool[N, Te]  extra-slot occupancy
+    main_tid: jax.Array        # int32[N]     slot 0's reference thread id
+    t_ids: jax.Array           # int32[N, Te] extra slots' thread ids
+    cur_thread: jax.Array      # int32[N]     active slot (0 = primary)
+    t_regs: jax.Array          # int32[N, Te, NR]
+    t_heads: jax.Array         # int32[N, Te, 4]
+    t_stack: jax.Array         # int32[N, Te, 10]  local stack (stack 0)
+    t_sp: jax.Array            # int32[N, Te]
+    t_active_stack: jax.Array  # int32[N, Te]
+    t_rlabel: jax.Array        # int8[N, Te, 10]
+    t_rlabel_len: jax.Array    # int32[N, Te]
     mal_active: jax.Array     # bool[N]      allocate active (REQUIRE_ALLOCATE)
 
     # --- organism / world binding ---
@@ -374,6 +449,9 @@ class PopulationState(struct.PyTreeNode):
     merit: jax.Array          # f32[N]       scheduling weight
     cur_bonus: jax.Array      # f32[N]
     cur_task_count: jax.Array     # int32[N, R]
+    task_exe_total: jax.Array     # int32[N, R]  lifetime task executions at
+    #                               this CELL (never reset -- tasks_exe.dat
+    #                               derives per-update counts from deltas)
     cur_reaction_count: jax.Array  # int32[N, R]
     last_task_count: jax.Array    # int32[N, R]
     time_used: jax.Array      # int32[N]
@@ -510,16 +588,26 @@ class PopulationState(struct.PyTreeNode):
 def zeros_population(n: int, L: int, R: int, n_global_res: int = 0,
                      n_spatial_res: int = 0, n_demes: int = 1,
                      smt: bool = False, num_registers: int = 3,
-                     nb_cap: int = 0, n_deme_res: int = 0) -> PopulationState:
+                     nb_cap: int = 0, n_deme_res: int = 0,
+                     max_threads: int = 1) -> PopulationState:
     i32 = partial(jnp.zeros, dtype=jnp.int32)
     f32 = partial(jnp.zeros, dtype=jnp.float32)
     T = 2 if smt else 0          # SMT thread axis (host, parasite)
     Ls = L if smt else 0         # SMT memory-space width
+    Tc = max(max_threads, 1)     # cHardwareCPU thread slots (1 = no threads)
+    Te = Tc - 1
     return PopulationState(
         tape=jnp.zeros((n, L), jnp.uint8), mem_len=i32(n),
         regs=i32((n, num_registers)), heads=i32((n, 4)),
         stacks=i32((n, 2, 10)), sp=i32((n, 2)), active_stack=i32(n),
         read_label=jnp.zeros((n, 10), jnp.int8), read_label_len=i32(n),
+        t_alive=jnp.zeros((n, Te), bool),
+        main_tid=i32(n), t_ids=i32((n, Te)),
+        cur_thread=i32(n),
+        t_regs=i32((n, Te, num_registers)), t_heads=i32((n, Te, 4)),
+        t_stack=i32((n, Te, 10)), t_sp=i32((n, Te)),
+        t_active_stack=i32((n, Te)),
+        t_rlabel=jnp.zeros((n, Te, 10), jnp.int8), t_rlabel_len=i32((n, Te)),
         mal_active=jnp.zeros(n, bool),
         alive=jnp.zeros(n, bool),
         genome=jnp.zeros((n, L), jnp.int8), genome_len=i32(n),
@@ -527,7 +615,7 @@ def zeros_population(n: int, L: int, R: int, n_global_res: int = 0,
         input_buf=i32((n, 3)), input_buf_n=i32(n), output_buf=i32(n),
         merit=f32(n), cur_bonus=f32(n),
         cur_task_count=i32((n, R)), cur_reaction_count=i32((n, R)),
-        last_task_count=i32((n, R)),
+        last_task_count=i32((n, R)), task_exe_total=i32((n, R)),
         time_used=i32(n), cpu_cycles=i32(n),
         gestation_start=i32(n), gestation_time=i32(n),
         fitness=f32(n), last_bonus=f32(n), last_merit_base=f32(n),
@@ -598,7 +686,8 @@ def seed_organism(params: WorldParams, st: PopulationState,
     blank = zeros_population(1, L, R, params.num_global_res,
                              params.num_spatial_res, 1,
                              smt=(params.hw_type in (1, 2)),
-                             num_registers=params.num_registers)
+                             num_registers=params.num_registers,
+                             max_threads=params.max_cpu_threads)
     c = cell
     updates = {}
     for name in st.__dataclass_fields__:
@@ -645,7 +734,8 @@ def init_population(params: WorldParams, seed_genome: np.ndarray,
                           smt=(params.hw_type in (1, 2)),
                           num_registers=params.num_registers,
                           nb_cap=params.nb_cap,
-                          n_deme_res=params.num_deme_res)
+                          n_deme_res=params.num_deme_res,
+                          max_threads=params.max_cpu_threads)
     k_inputs, key = jax.random.split(key)
     st = st.replace(inputs=make_cell_inputs(k_inputs, n),
                     deme_resources=jnp.broadcast_to(

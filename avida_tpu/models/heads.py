@@ -49,9 +49,10 @@ MOD_NONE, MOD_REG, MOD_HEAD, MOD_LABEL = 0, 1, 2, 3
     SEM_H_COPY, SEM_H_ALLOC, SEM_H_DIVIDE,
     SEM_IO, SEM_H_SEARCH,
     SEM_H_DIVIDE_SEX,
-) = range(27)
+    SEM_FORK_TH, SEM_KILL_TH, SEM_ID_TH,
+) = range(30)
 
-NUM_SEMANTIC_OPS = 27
+NUM_SEMANTIC_OPS = 30
 
 
 @dataclass(frozen=True)
@@ -114,6 +115,19 @@ INSTRUCTIONS = {
                    "output ?BX?, check tasks, input next (cc:4188)"),
     "h-search": InstSpec("h-search", SEM_H_SEARCH, MOD_LABEL, 0,
                          "FLOW <- after complement label; BX=dist, CX=size (cc:7245)"),
+    # intra-organism threads (cHardwareCPU.cc:346-351, ForkThread cc:1505,
+    # KillThread cc:1592; active only when MAX_CPU_THREADS > 1)
+    "fork-th": InstSpec(
+        "fork-th", SEM_FORK_TH, MOD_NONE, 0,
+        "advance IP, then copy the current thread into a free slot "
+        "(Inst_ForkThread cc:6732: child resumes at fork+1, parent at "
+        "fork+2); fails silently at the thread cap"),
+    "kill-th": InstSpec(
+        "kill-th", SEM_KILL_TH, MOD_NONE, 0,
+        "kill the current thread unless it is the last one (cc:1592)"),
+    "id-th": InstSpec(
+        "id-th", SEM_ID_TH, MOD_REG, REG_BX,
+        "?BX? <- current thread id (Inst_ThreadID cc:6773)"),
 }
 
 # Aliases found in reference instset files / organisms.

@@ -522,20 +522,49 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
         cpd = params.num_cells // params.num_demes
         target = jnp.where(target // cpd == rows // cpd, target, rows)
     if params.num_demes > 1 and params.demes_migration_rate > 0:
-        # DEMES_MIGRATION_RATE: offspring born into a random cell of a
-        # random other deme (cPopulation deme migration / cMigrationMatrix
-        # uniform case)
-        k_mig, k_mcell = jax.random.split(jax.random.fold_in(k_place, 1))
+        # DEMES_MIGRATION_RATE: migrating offspring land in another deme
+        # picked by DEMES_MIGRATION_METHOD (cPopulation.cc:5508-5600):
+        #   0 uniform over the other demes, 1 random 8-neighbor on the
+        #   DEMES_NUM_X deme grid, 2 list-adjacent (+/-1), 4 weight-matrix
+        #   (MIGRATION_FILE; cMigrationMatrix::GetProbabilisticDemeID);
+        # then a uniform random cell of the target deme.
+        k_mig, k_mcell, k_mdeme = jax.random.split(
+            jax.random.fold_in(k_place, 1), 3)
         migrate = (jax.random.uniform(k_mig, (n,))
                    < params.demes_migration_rate) & pending
         cpd = params.num_cells // params.num_demes
-        # uniform over the n - cpd cells OUTSIDE the home deme: draw in
-        # [0, n-cpd) and shift draws at/after the home band up by one band
-        mig_cell = jax.random.randint(k_mcell, (n,), 0, n - cpd,
-                                      dtype=jnp.int32)
-        home_start = (rows // cpd) * cpd
-        mig_cell = jnp.where(mig_cell >= home_start, mig_cell + cpd,
-                             mig_cell)
+        D = params.num_demes
+        home = rows // cpd
+        mm = params.demes_migration_method
+        if mm == 0:
+            d_r = jax.random.randint(k_mdeme, (n,), 0, D - 1,
+                                     dtype=jnp.int32)
+            mig_deme = jnp.where(d_r >= home, d_r + 1, d_r)
+        elif mm == 1:
+            xs = params.demes_num_x
+            ys = D // xs
+            d8 = jax.random.randint(k_mdeme, (n,), 0, 8, dtype=jnp.int32)
+            dy = jnp.asarray([-1, -1, -1, 0, 0, 1, 1, 1], jnp.int32)[d8]
+            dx = jnp.asarray([-1, 0, 1, -1, 1, -1, 0, 1], jnp.int32)[d8]
+            mx = (home % xs + dx + xs) % xs
+            my = (home // xs + dy + ys) % ys
+            mig_deme = mx + xs * my
+        elif mm == 2:
+            pm = jax.random.randint(k_mdeme, (n,), 0, 2,
+                                    dtype=jnp.int32) * 2 - 1
+            mig_deme = (home + pm + D) % D
+        elif mm == 4:
+            u_d = jax.random.uniform(k_mdeme, (n,))
+            cdf = jnp.asarray(params.migration_cdf, jnp.float32)  # [D, D]
+            row_cdf = cdf[home]                                   # [n, D]
+            mig_deme = (u_d[:, None] >= row_cdf).sum(
+                axis=1).astype(jnp.int32)
+            mig_deme = jnp.clip(mig_deme, 0, D - 1)
+        else:
+            raise NotImplementedError(
+                f"DEMES_MIGRATION_METHOD {mm}")
+        mig_cell = mig_deme * cpd + jax.random.randint(
+            k_mcell, (n,), 0, cpd, dtype=jnp.int32)
         target = jnp.where(migrate, mig_cell, target)
 
     # ---- conflict resolution: lowest parent index claims the cell ----
@@ -640,6 +669,10 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
         # cost engine starts clean (no inherited debt or paid ft bits)
         "cost_wait": 0, "ft_paid_lo": 0, "ft_paid_hi": 0,
         "energy_spent": 0.0,
+        # offspring start single-threaded (slot 0 only)
+        "t_alive": False, "main_tid": 0, "t_ids": 0, "cur_thread": 0,
+        "t_regs": 0, "t_heads": 0, "t_stack": 0, "t_sp": 0,
+        "t_active_stack": 0, "t_rlabel": jnp.int8(0), "t_rlabel_len": 0,
         # TransSMT state (size-0 axes on heads hardware; writes are no-ops)
         "smt_aux": jnp.uint8(0), "smt_aux_len": 0,
         "pmem": jnp.uint8(0), "pmem_len": 0, "parasite_active": False,

@@ -26,8 +26,8 @@ import jax.numpy as jnp
 from avida_tpu.core.state import make_cell_inputs
 
 # ReplicateDemes triggers (cPopulation::ReplicateDemes switch order)
-TRIGGER_ALL, TRIGGER_FULL, TRIGGER_CORNERS, TRIGGER_AGE, TRIGGER_BIRTHS = \
-    range(5)
+(TRIGGER_ALL, TRIGGER_FULL, TRIGGER_CORNERS, TRIGGER_AGE, TRIGGER_BIRTHS,
+ TRIGGER_PREDICATE) = range(6)
 
 
 def cells_per_deme(params) -> int:
@@ -215,7 +215,7 @@ def _mutate_germline(params, germ_mem, germ_len, key):
     return jnp.where(hit, r, germ_mem)
 
 
-def replicate_demes(params, st, key, rep_trigger):
+def replicate_demes(params, st, key, rep_trigger, predicates=()):
     """Replicate triggered demes into random target demes
     (cPopulation::ReplicateDemes -> ReplicateDeme -> ReplaceDeme).
 
@@ -243,6 +243,27 @@ def replicate_demes(params, st, key, rep_trigger):
         trig = st.deme_age >= params.demes_max_age
     elif rep_trigger == TRIGGER_BIRTHS:
         trig = st.deme_birth_count >= params.demes_max_births
+    elif rep_trigger == TRIGGER_PREDICATE:
+        # DEME_TRIGGER_PREDICATE (cPopulation.cc:3008) over attached
+        # cDemeResourceThresholdPredicate conditions (cDemePredicate.h:57:
+        # deme resource level vs threshold).  Evaluated at event time
+        # against the current level (the reference's sticky
+        # previously-satisfied latch collapses to this under per-event
+        # evaluation).
+        if not predicates:
+            raise ValueError(
+                "ReplicateDemes sat-deme-predicate needs at least one "
+                "Pred_DemeResourceThresholdPredicate event first")
+        trig = jnp.zeros(D, bool)
+        for res_idx, op, value in predicates:
+            lvl = st.deme_resources[:, res_idx]
+            if op == ">=":
+                trig = trig | (lvl >= value)
+            elif op == "<=":
+                trig = trig | (lvl <= value)
+            else:
+                raise ValueError(f"predicate operator {op!r} (>=, <=)")
+        trig = trig & (cnt > 0)
     else:
         raise NotImplementedError(f"ReplicateDemes trigger {rep_trigger}")
 

@@ -44,6 +44,7 @@ from avida_tpu.models.heads import (
     SEM_H_SEARCH, SEM_IF_LABEL, SEM_IF_LESS, SEM_IF_N_EQU, SEM_INC, SEM_IO,
     SEM_JMP_HEAD, SEM_MOV_HEAD, SEM_NAND, SEM_POP, SEM_PUSH, SEM_SET_FLOW,
     SEM_SHIFT_L, SEM_SHIFT_R, SEM_SUB, SEM_SWAP, SEM_SWAP_STK,
+    SEM_FORK_TH, SEM_KILL_TH, SEM_ID_TH,
     HEAD_IP, HEAD_READ, HEAD_WRITE, HEAD_FLOW, MAX_LABEL_SIZE,
 )
 from avida_tpu.ops import tasks as tasks_ops
@@ -109,11 +110,21 @@ def barrel_shift_left(plane, shift, L):
     return out
 
 
-def micro_step(params, st, key, exec_mask):
+def micro_step(params, st, key, exec_mask, return_signals=False,
+               charge_time=True):
     """Execute one CPU cycle for every organism where exec_mask is set.
 
     Equivalent to one pass of the reference hot loop (Avida2Driver.cc:111-116)
     over every scheduled organism simultaneously.  Returns the new state.
+
+    For MAX_CPU_THREADS > 1 this is the per-thread core: the threaded
+    wrapper (micro_step_threads) feeds it the ACTIVE thread's view of the
+    per-thread fields (regs/heads/local stack/read label; st.main_tid
+    holds the active thread's id for id-th) and asks for `return_signals`
+    -- (new_state, {fork, kill, div, child_ip}) -- to run the slot
+    bookkeeping itself.  `charge_time=False` skips the per-cycle
+    time_used/cpu_cycles/insts_executed charge (THREAD_SLICING_METHOD 1
+    charges once per slice, not per thread; cHardwareCPU.cc:930).
     """
     n, L = st.tape.shape
     cols = jnp.arange(L)
@@ -466,6 +477,9 @@ def micro_step(params, st, key, exec_mask):
         lambda _: (st.cur_bonus, st.cur_task_count, st.cur_reaction_count,
                    st.resources, st.res_grid, st.deme_resources),
         None)
+    # lifetime per-cell task executions (tasks_exe.dat source; the delta
+    # from cur_task_count is exactly this cycle's performances)
+    task_exe_total = st.task_exe_total + (new_tc - st.cur_task_count)
     input_ptr = jnp.where(io_m, st.input_ptr + 1, st.input_ptr)
     input_buf = jnp.where(io_m[:, None],
                           jnp.stack([value_in, st.input_buf[:, 0],
@@ -486,7 +500,8 @@ def micro_step(params, st, key, exec_mask):
                  (SEM_INC, val + 1), (SEM_DEC, val - 1),
                  (SEM_ADD, a1m + a2m), (SEM_SUB, a1m - a2m),
                  (SEM_NAND, ~(a1m & a2m)), (SEM_POP, pop_val),
-                 (SEM_IO, value_in), (SEM_SWAP, val2)):
+                 (SEM_IO, value_in), (SEM_SWAP, val2),
+                 (SEM_ID_TH, st.main_tid)):
         res = jnp.where(is_op(s), v, res)
         wrote = wrote | is_op(s)
 
@@ -546,7 +561,9 @@ def micro_step(params, st, key, exec_mask):
     # a successful divide resets the CPU (DIVIDE_METHOD 1 -> IP=0).
     mov_ip = mov_m & (hsel0 == HEAD_IP)
     jmp_ip = jmp_m & (hsel0 == HEAD_IP)
-    ip_seq = _adjust(ip + consumed + skip.astype(jnp.int32) + 1, mlen)
+    fork_try = is_op(SEM_FORK_TH)
+    ip_seq = _adjust(ip + consumed + skip.astype(jnp.int32) + 1
+                     + fork_try.astype(jnp.int32), mlen)
     # jmp-head on IP: jump from the post-modifier position, then advance
     jmp_tgt = _adjust(_adjust(ip + consumed + cx, mlen) + 1, mlen)
     ip_new = jnp.where(jmp_ip, jmp_tgt, ip_seq)
@@ -620,14 +637,15 @@ def micro_step(params, st, key, exec_mask):
     num_divides = jnp.where(div_m, st.num_divides + 1, st.num_divides)
 
     # ---- time accounting + death (SingleProcess tail, cc:1047-1051) ----
-    time_used = st.time_used + exec_mask.astype(jnp.int32)
+    charge = exec_mask if charge_time else jnp.zeros_like(exec_mask)
+    time_used = st.time_used + charge.astype(jnp.int32)
     if params.inst_addl_time_cost:
         # cHardwareCPU.cc:985,1015: IncTimeUsed(addl_time_cost) on top of
         # the regular cycle -- charged even when prob_fail suppressed the
         # effect (the fetch precedes the failure draw)
         atc_t = jnp.asarray(params.inst_addl_time_cost, jnp.int32)
         time_used = time_used + jnp.where(eff_exec, atc_t[cur_op], 0)
-    cpu_cycles = st.cpu_cycles + exec_mask.astype(jnp.int32)
+    cpu_cycles = st.cpu_cycles + charge.astype(jnp.int32)
     if params.divide_method != 0:
         # DIVIDE_METHOD 1/2 (SPLIT/BIRTH): the parent is "a second child" --
         # its clock fully resets at divide (cPhenotype::DivideReset
@@ -641,7 +659,7 @@ def micro_step(params, st, key, exec_mask):
         gestation_start = jnp.where(div_m, time_used, st.gestation_start)
     died = exec_mask & (st.max_executed > 0) & (time_used >= st.max_executed)
     alive = st.alive & ~died
-    insts_executed = st.insts_executed + exec_mask.astype(jnp.int32)
+    insts_executed = st.insts_executed + charge.astype(jnp.int32)
 
     new_st = st.replace(
         tape=tape, mem_len=mem_len,
@@ -652,6 +670,7 @@ def micro_step(params, st, key, exec_mask):
         output_buf=output_buf,
         merit=merit, cur_bonus=cur_bonus,
         cur_task_count=cur_task_count, cur_reaction_count=cur_reaction_count,
+        task_exe_total=task_exe_total,
         last_task_count=last_task_count,
         time_used=time_used, cpu_cycles=cpu_cycles,
         gestation_start=gestation_start, gestation_time=gestation_time,
@@ -671,6 +690,13 @@ def micro_step(params, st, key, exec_mask):
     )
     if params.hw_type == 3:
         new_st = _apply_moves(new_st, move_won, move_tgt)
+    if return_signals:
+        return new_st, {
+            "fork": fork_try, "kill": is_op(SEM_KILL_TH), "div": div_m,
+            # the forked thread resumes at fork+1 (parent advanced to
+            # fork+2 by ip_seq's extra step)
+            "child_ip": _adjust(ip + 1, mlen),
+        }
     return new_st
 
 
@@ -1009,3 +1035,202 @@ def _calc_size_merit(params, genome_len, copied_size, executed_size):
         least = jnp.minimum(jnp.minimum(genome_len, copied_size), executed_size)
         return jnp.sqrt(least.astype(jnp.float32))
     raise NotImplementedError(f"BASE_MERIT_METHOD {m}")
+
+
+def micro_step_threads(params, st, key, exec_mask):
+    """One scheduler cycle under MAX_CPU_THREADS > 1 (cHardwareCPU
+    SingleProcess thread loop, cc:930-1060): per THREAD_SLICING_METHOD
+    (cAvidaConfig.h:561), execute 1 (method 0) or num_threads (method 1)
+    thread sub-steps; each sub-step advances cur_thread to the next live
+    slot, runs the shared core on that thread's view of the per-thread
+    state, then scatters the results back and applies fork-th / kill-th /
+    divide slot bookkeeping.
+
+    Documented deviations from the reference's dense thread array: slots
+    do not move on kill (except the slot-0 compaction that preserves the
+    "primary fields = a live thread" invariant), so round-robin order
+    after mid-stack kills can differ; after any kill, scheduling resumes
+    from slot 0."""
+    reps = params.max_cpu_threads if params.thread_slicing_method == 1 else 1
+    for r in range(reps):
+        st = _thread_substep(params, st, jax.random.fold_in(key, r),
+                             exec_mask, charge_time=(r == 0), rep=r)
+    return st
+
+
+def _thread_substep(params, st, key, exec_mask, charge_time, rep):
+    T = params.max_cpu_threads
+    Te = T - 1
+    cols = jnp.arange(Te)
+    n_thr = 1 + st.t_alive.sum(axis=1)
+    # method 1 executes each live thread once per slice: sub-step r only
+    # runs lanes that still have an r+1-th thread
+    sub_mask = exec_mask & (n_thr > rep) if rep else exec_mask
+
+    def slot_alive(cand):
+        if Te == 0:
+            return cand == 0
+        extra = ((cols[None, :] == (cand - 1)[:, None]) & st.t_alive).any(
+            axis=1)
+        return (cand == 0) | extra
+
+    # advance cur_thread to the next live slot (m_cur_thread++ wrap,
+    # cc:946-948; dead slots are skipped)
+    cur0 = st.cur_thread
+    cur = cur0
+    found = jnp.zeros_like(exec_mask)
+    for k in range(1, T + 1):
+        cand = (cur0 + k) % T
+        al = slot_alive(cand)
+        cur = jnp.where(~found & al, cand, cur)
+        found = found | al
+    cur = jnp.where(sub_mask, cur, cur0)
+
+    onehot = ((cols[None, :] == (cur - 1)[:, None])
+              & (cur[:, None] > 0)) if Te else jnp.zeros((cur.shape[0], 0),
+                                                         bool)
+    is_extra = cur > 0
+
+    def pick(main, extra):
+        """Active-thread view of a per-thread field (slot 0 = main)."""
+        if Te == 0:
+            return main
+        exp = onehot.reshape(onehot.shape + (1,) * (extra.ndim - 2))
+        v = jnp.sum(jnp.where(exp, extra, 0), axis=1)
+        m = is_extra.reshape((-1,) + (1,) * (main.ndim - 1))
+        return jnp.where(m, v.astype(main.dtype), main)
+
+    local_stack = pick(st.stacks[:, 0], st.t_stack)
+    view = st.replace(
+        regs=pick(st.regs, st.t_regs),
+        heads=pick(st.heads, st.t_heads),
+        stacks=jnp.stack([local_stack, st.stacks[:, 1]], axis=1),
+        sp=jnp.stack([pick(st.sp[:, 0], st.t_sp), st.sp[:, 1]], axis=1),
+        active_stack=pick(st.active_stack, st.t_active_stack),
+        read_label=pick(st.read_label, st.t_rlabel),
+        read_label_len=pick(st.read_label_len, st.t_rlabel_len),
+        main_tid=pick(st.main_tid, st.t_ids),
+        cur_thread=cur)
+
+    nv, sig = micro_step(params, view, key, sub_mask,
+                         return_signals=True, charge_time=charge_time)
+
+    # ---- scatter the view's per-thread results back into slot `cur` ----
+    # a divide from an extra-slot thread resets the ORGANISM: the reset
+    # view (IP 0, cleared regs/stacks/labels; Divide_Main -> Reset) lands
+    # in slot 0, not in the soon-to-be-killed extra slot
+    wrote_main = sub_mask & (~is_extra | sig["div"])
+    oh_w = (onehot & (sub_mask & is_extra & ~sig["div"])[:, None]
+            if Te else onehot)
+
+    def put_main(old_main, new_val):
+        m = wrote_main.reshape((-1,) + (1,) * (old_main.ndim - 1))
+        return jnp.where(m, new_val.astype(old_main.dtype), old_main)
+
+    def put_extra(old_extra, new_val):
+        if Te == 0:
+            return old_extra
+        exp = oh_w.reshape(oh_w.shape + (1,) * (old_extra.ndim - 2))
+        return jnp.where(exp, jnp.expand_dims(new_val, 1).astype(
+            old_extra.dtype), old_extra)
+
+    st2 = nv.replace(
+        regs=put_main(st.regs, nv.regs),
+        heads=put_main(st.heads, nv.heads),
+        stacks=jnp.stack([put_main(st.stacks[:, 0], nv.stacks[:, 0]),
+                          nv.stacks[:, 1]], axis=1),
+        sp=jnp.stack([put_main(st.sp[:, 0], nv.sp[:, 0]),
+                      nv.sp[:, 1]], axis=1),
+        active_stack=put_main(st.active_stack, nv.active_stack),
+        read_label=put_main(st.read_label, nv.read_label),
+        read_label_len=put_main(st.read_label_len, nv.read_label_len),
+        main_tid=st.main_tid, cur_thread=cur,
+        t_regs=put_extra(st.t_regs, nv.regs),
+        t_heads=put_extra(st.t_heads, nv.heads),
+        t_stack=put_extra(st.t_stack, nv.stacks[:, 0]),
+        t_sp=put_extra(st.t_sp, nv.sp[:, 0]),
+        t_active_stack=put_extra(st.t_active_stack, nv.active_stack),
+        t_rlabel=put_extra(st.t_rlabel, nv.read_label),
+        t_rlabel_len=put_extra(st.t_rlabel_len, nv.read_label_len),
+        t_alive=st.t_alive, t_ids=st.t_ids)
+
+    if Te == 0:
+        return st2
+
+    # ---- fork-th: copy the post-instruction active thread into the
+    # lowest free slot with the lowest unused thread id (ForkThread
+    # cc:1505-1524); silently fails at the cap ----
+    free = ~st2.t_alive
+    ffs = free & (jnp.cumsum(free.astype(jnp.int32), axis=1) == 1)
+    can_fork = sig["fork"] & free.any(axis=1)
+    put = ffs & can_fork[:, None]
+    # lowest unused reference id among 0..T-1
+    new_id = jnp.zeros_like(cur)
+    taken_running = jnp.zeros_like(exec_mask)
+    for v in range(T):
+        used_v = (st2.main_tid == v) | (
+            (st2.t_ids == v) & st2.t_alive).any(axis=1)
+        pickv = ~taken_running & ~used_v
+        new_id = jnp.where(pickv, v, new_id)
+        taken_running = taken_running | ~used_v
+    child_heads = nv.heads.at[:, HEAD_IP].set(sig["child_ip"])
+
+    def fork_into(old_extra, new_val):
+        exp = put.reshape(put.shape + (1,) * (old_extra.ndim - 2))
+        return jnp.where(exp, jnp.expand_dims(new_val, 1).astype(
+            old_extra.dtype), old_extra)
+
+    st2 = st2.replace(
+        t_alive=st2.t_alive | put,
+        t_ids=jnp.where(put, new_id[:, None], st2.t_ids),
+        t_regs=fork_into(st2.t_regs, nv.regs),
+        t_heads=fork_into(st2.t_heads, child_heads),
+        t_stack=fork_into(st2.t_stack, nv.stacks[:, 0]),
+        t_sp=fork_into(st2.t_sp, nv.sp[:, 0]),
+        t_active_stack=fork_into(st2.t_active_stack, nv.active_stack),
+        t_rlabel=fork_into(st2.t_rlabel, nv.read_label),
+        t_rlabel_len=fork_into(st2.t_rlabel_len, nv.read_label_len),
+    )
+
+    # ---- kill-th: fails with one thread (cc:1595); killing slot 0 moves
+    # the LAST live extra thread into the primary fields (the reference's
+    # compaction), killing an extra slot just frees it ----
+    can_kill = sig["kill"] & (1 + st2.t_alive.sum(axis=1) > 1)
+    kill_extra = can_kill & is_extra
+    kill0 = can_kill & ~is_extra
+    la = st2.t_alive & (jnp.cumsum(
+        st2.t_alive[:, ::-1].astype(jnp.int32), axis=1)[:, ::-1] == 1)
+
+    def last_val(extra):
+        exp = la.reshape(la.shape + (1,) * (extra.ndim - 2))
+        return jnp.sum(jnp.where(exp, extra, 0), axis=1)
+
+    def move0(main, extra):
+        m = kill0.reshape((-1,) + (1,) * (main.ndim - 1))
+        return jnp.where(m, last_val(extra).astype(main.dtype), main)
+
+    dead = jnp.where(kill_extra[:, None], onehot,
+                     jnp.where(kill0[:, None], la,
+                               jnp.zeros_like(st2.t_alive)))
+    st2 = st2.replace(
+        regs=move0(st2.regs, st2.t_regs),
+        heads=move0(st2.heads, st2.t_heads),
+        stacks=jnp.stack([move0(st2.stacks[:, 0], st2.t_stack),
+                          st2.stacks[:, 1]], axis=1),
+        sp=jnp.stack([move0(st2.sp[:, 0], st2.t_sp), st2.sp[:, 1]], axis=1),
+        active_stack=move0(st2.active_stack, st2.t_active_stack),
+        read_label=move0(st2.read_label, st2.t_rlabel),
+        read_label_len=move0(st2.read_label_len, st2.t_rlabel_len),
+        main_tid=jnp.where(kill0, last_val(st2.t_ids), st2.main_tid),
+        t_alive=st2.t_alive & ~dead,
+        cur_thread=jnp.where(can_kill, 0, st2.cur_thread),
+    )
+
+    # ---- divide: the parent resets to a single thread (Divide_Main ->
+    # Reset; extra slots die, id chart resets) ----
+    div = sig["div"]
+    return st2.replace(
+        t_alive=jnp.where(div[:, None], False, st2.t_alive),
+        cur_thread=jnp.where(div, 0, st2.cur_thread),
+        main_tid=jnp.where(div, 0, st2.main_tid),
+    )

@@ -341,6 +341,8 @@ def micro_step_smt(params, st, key, exec_mask):
     cur_task_count = jnp.where(io_host[:, None], new_tc, st.cur_task_count)
     cur_reaction_count = jnp.where(io_host[:, None], new_rc,
                                    st.cur_reaction_count)
+    task_exe_total = st.task_exe_total + jnp.where(
+        io_host[:, None], new_tc - st.cur_task_count, 0)
 
     # ---- conditionals (skip next on false) ----
     skip = ((is_op(SEM_IF_EQU) & (v1 != v2))
@@ -562,6 +564,7 @@ def micro_step_smt(params, st, key, exec_mask):
         gestation_start=gestation_start,
         last_task_count=last_task_count, cur_task_count=cur_task_count,
         cur_reaction_count=cur_reaction_count, cur_bonus=cur_bonus2,
+        task_exe_total=task_exe_total,
         last_bonus=last_bonus,
         input_ptr=input_ptr, input_buf=input_buf, input_buf_n=input_buf_n,
         time_used=time_used, cpu_cycles=st.cpu_cycles +

@@ -85,6 +85,7 @@ from jax.experimental.pallas import tpu as pltpu
 from avida_tpu.models.heads import (
     MOD_HEAD, MOD_LABEL, MOD_NONE, MOD_REG,
     SEM_ADD, SEM_DEC, SEM_GET_HEAD, SEM_H_ALLOC, SEM_H_COPY, SEM_H_DIVIDE,
+    SEM_H_DIVIDE_SEX,
     SEM_H_SEARCH, SEM_IF_LABEL, SEM_IF_LESS, SEM_IF_N_EQU, SEM_INC, SEM_IO,
     SEM_JMP_HEAD, SEM_MOV_HEAD, SEM_NAND, SEM_POP, SEM_PUSH, SEM_SET_FLOW,
     SEM_SHIFT_L, SEM_SHIFT_R, SEM_SUB, SEM_SWAP, SEM_SWAP_STK,
@@ -127,7 +128,11 @@ IV_PW_POS = 68           # deferred h-copy write: position (-1 = none)
 IV_PW_VAL = 69           # deferred h-copy write: opcode
 IV_PZ_START = 70         # deferred zero range [start, end) (alloc zone)
 IV_PZ_END = 71
-IV_EXEC_BM = 72          # LW rows: executed-site bitplane (LW = L/32)
+IV_COST_WAIT = 72        # cost-engine cycles owed (SingleProcess_PayPreCosts)
+IV_FT_LO = 73            # one-time ft_cost paid bitmask, opcodes 0-31
+IV_FT_HI = 74            # opcodes 32-63
+IV_OFF_SEX = 75          # offspring awaits a mate (divide-sex)
+IV_EXEC_BM = 76          # LW rows: executed-site bitplane (LW = L/32)
 # COPIED_BM at IV_EXEC_BM + LW; task/reaction rows at IV_EXEC_BM + 2*LW
 
 FV_MERIT = 0
@@ -158,26 +163,28 @@ def eligible(params) -> bool:
     """True when the per-organism fast path is semantically exact: no
     reaction binds a resource (every process is infinite-resource), so one
     update's cycles never couple organisms through shared pools, and the
-    instruction set contains no semantics the kernel doesn't implement
-    (divide-sex needs the off_sex flag the packed layout doesn't carry)."""
-    from avida_tpu.models.heads import SEM_H_DIVIDE_SEX
-    if any(int(s) == SEM_H_DIVIDE_SEX for s in params.sem):
-        return False
-    if params.inst_cost or params.inst_ft_cost:
-        return False     # cost engine not implemented in-kernel
-    if params.inst_prob_fail or params.inst_addl_time_cost:
-        return False     # probabilistic failure / extra time not in-kernel
+    instruction set contains no semantics the kernel doesn't implement.
+
+    Round 5 widened the kernel to cover instruction costs (cost/ft_cost/
+    prob_fail/addl_time_cost engines), redundancy-weighted mutation draws,
+    and divide-sex (the kernel records the off_sex flag; pairing and
+    recombination stay in the birth flush).  Remaining exclusions: the
+    energy model, reaction by-products, math-family tasks, and
+    resource-bound reactions."""
+    if params.max_cpu_threads > 1:
+        return False     # intra-organism threads run on the XLA path
+    from avida_tpu.models.heads import (SEM_FORK_TH, SEM_ID_TH,
+                                        SEM_KILL_TH)
+    if any(int(s) in (SEM_FORK_TH, SEM_KILL_TH, SEM_ID_TH)
+           for s in params.sem):
+        return False     # fork-th's extra IP advance and id-th's register
+        #                  write exist only in the XLA interpreter
     if params.energy_enabled:
         return False     # energy store/merit not implemented in-kernel
     if any(pi >= 0 for pi in getattr(params, "proc_product_idx", ())):
         return False     # by-products couple organisms through pools
     if any(getattr(params, "task_math_name", ())):
         return False     # in-kernel reactions evaluate logic ids only
-    n_i = params.num_insts
-    if params.mut_cdf and any(
-            abs(params.mut_cdf[k] - (k + 1) / n_i) > 1e-12
-            for k in range(n_i)):
-        return False     # kernel PRNG draws are redundancy-uniform
     return all(r < 0 for r in params.proc_res_idx)
 
 
@@ -187,7 +194,7 @@ def _layout(params, L):
     iv_copied = IV_EXEC_BM + LW
     iv_dyn = IV_EXEC_BM + 2 * LW
     R = params.num_reactions
-    ni = iv_dyn + 3 * R          # cur_task, cur_reaction, last_task
+    ni = iv_dyn + 4 * R          # cur_task, cur_reaction, last_task, exe_total
     ni = (ni + 7) & ~7           # sublane-pad
     return ni, LW, iv_copied, iv_dyn
 
@@ -199,6 +206,15 @@ def _sel_table(op, table):
     for k, v in enumerate(table):
         if v:
             out = jnp.where(op == k, jnp.int32(int(v)), out)
+    return out
+
+
+def _fsel_table(op, table):
+    """Float variant of _sel_table."""
+    out = jnp.zeros(op.shape, jnp.float32)
+    for k, v in enumerate(table):
+        if v:
+            out = jnp.where(op == k, jnp.float32(float(v)), out)
     return out
 
 
@@ -332,7 +348,7 @@ def _task_performed(lid, logic_mask_row):
     return (jnp.right_shift(word_v, (lid & 31).astype(jnp.uint32)) & 1) == 1
 
 
-def _make_kernel(params, L, B, num_steps):
+def _make_kernel(params, L, B, num_steps, interpret=False):
     """Build the kernel body (params/L/B/num_steps are trace-time consts).
 
     L is the CHUNK-padded tape height; semantic memory limits (h-alloc
@@ -373,7 +389,8 @@ def _make_kernel(params, L, B, num_steps):
         off_ref[...] = off_in[...]
         ivec_ref[...] = ivec_in[...]
         fvec_ref[...] = fvec_in[...]
-        if params.copy_mut_prob > 0:
+        if (params.copy_mut_prob > 0 or params.inst_prob_fail) \
+                and not interpret:
             pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
 
         granted = ivec_ref[IV_GRANTED, :][None, :]
@@ -403,6 +420,28 @@ def _make_kernel(params, L, B, num_steps):
             return tc & ~(bytemask(hi) & ~bytemask(lo))
 
         def cycle_body(s, _):
+            def u24(tag):
+                """24 random bits per lane as int32 [1, B].  On TPU:
+                the stateful hardware PRNG (uint32 -> f32 casts are
+                unsupported in Mosaic; the top 24 bits fit an int32
+                exactly).  In interpret mode (CPU tests): a counter-based
+                splitmix-style hash of (seed, block, cycle, lane, tag) --
+                pltpu.prng_* has no CPU lowering."""
+                if not interpret:
+                    b = pltpu.bitcast(pltpu.prng_random_bits((1, B)),
+                                      jnp.uint32)
+                    return (b[0, :][None, :] >> 8).astype(jnp.int32)
+                # (no pl.program_id here: the hlo interpreter lacks a
+                # CPU lowering for it; blocks share the stream pattern,
+                # which is fine for the test-only interpret mode)
+                x = (seed_ref[0]
+                     + s * jnp.int32(-1640531527) + tag * 40503
+                     + jax.lax.broadcasted_iota(jnp.int32, (1, B), 1))
+                x = (x ^ ((x >> 16) & 0xFFFF)) * jnp.int32(0x45d9f3b)
+                x = (x ^ ((x >> 16) & 0xFFFF)) * jnp.int32(0x45d9f3b)
+                x = x ^ ((x >> 16) & 0xFFFF)
+                return x & 0xFFFFFF
+
             mlen = jnp.maximum(ivec_ref[IV_MEM_LEN, :][None, :], 1)
             flags = ivec_ref[IV_FLAGS, :][None, :]
             alive = (flags & FLAG_ALIVE) != 0
@@ -442,7 +481,7 @@ def _make_kernel(params, L, B, num_steps):
             for c in range(0, LP, CHUNK):
                 cn = min(CHUNK, LP - c)
                 tc = tape_ref[pl.ds(c, cn), :]
-                wrows_c = wrows[:cn, :] + c if c else wrows[:cn, :]
+                wrows_c = jax.lax.broadcasted_iota(jnp.int32, (cn, B), 0) + c
                 tc = apply_pending(tc, wrows_c, pw_pos, pw_val, pz_s, pz_e)
                 tape_ref[pl.ds(c, cn), :] = tc
                 w_ip = w_ip + jnp.sum(
@@ -482,8 +521,60 @@ def _make_kernel(params, L, B, num_steps):
             cbm = ivec_ref[pl.ds(IV_COPIED_BM, LW), :]        # [LW, B]
             ip_exec_already = _read_bit(ebm, lw_rows, ip)
             meta = _multibit_lookup(cur_op, meta_tab, 9)
-            sem = jnp.where(exec_mask, meta & 31, -1)
-            mod_kind = jnp.where(exec_mask, (meta >> 5) & 3, MOD_NONE)
+
+            # ---- instruction cost engine (SingleProcess_PayPreCosts,
+            # cHardwareBase.cc:1241; same semantics as the XLA
+            # interpreter): cost c consumes c cycles executing on the
+            # last, ft_cost adds a one-time per-opcode surcharge ----
+            has_costs = bool(params.inst_cost) or bool(params.inst_ft_cost)
+            if has_costs:
+                cost_op = _sel_table(
+                    cur_op, params.inst_cost or (0,) * num_insts)
+                ftc_op = _sel_table(
+                    cur_op, params.inst_ft_cost or (0,) * num_insts)
+                ft_lo = ivec_ref[IV_FT_LO, :][None, :]
+                ft_hi = ivec_ref[IV_FT_HI, :][None, :]
+                ft_bit = jnp.where(
+                    cur_op < 32,
+                    (ft_lo >> jnp.clip(cur_op, 0, 31)) & 1,
+                    (ft_hi >> jnp.clip(cur_op - 32, 0, 31)) & 1)
+                total_cost = jnp.maximum(cost_op, 1) + \
+                    jnp.where(ft_bit == 0, ftc_op, 0)
+                cw = ivec_ref[IV_COST_WAIT, :][None, :]
+                eff_exec = exec_mask & (
+                    (cw == 1) | ((cw == 0) & (total_cost <= 1)))
+                cost_wait = jnp.where(
+                    exec_mask,
+                    jnp.where(cw > 0, cw - 1,
+                              jnp.where(total_cost > 1, total_cost - 1, 0)),
+                    cw)
+                pay_ft = eff_exec & (ft_bit == 0)
+                bit_lo = 1 << jnp.clip(cur_op, 0, 31)
+                bit_hi = 1 << jnp.clip(cur_op - 32, 0, 31)
+                ivec_ref[IV_FT_LO, :] = jnp.where(
+                    pay_ft & (cur_op < 32), ft_lo | bit_lo, ft_lo)[0]
+                ivec_ref[IV_FT_HI, :] = jnp.where(
+                    pay_ft & (cur_op >= 32), ft_hi | bit_hi, ft_hi)[0]
+                ivec_ref[IV_COST_WAIT, :] = cost_wait[0]
+            else:
+                eff_exec = exec_mask
+
+            # ---- probabilistic execution failure (cHardwareCPU.cc:988:
+            # costs paid, flagged executed, IP advances; effect and nop-
+            # modifier consumption suppressed) ----
+            if params.inst_prob_fail:
+                u_fail = u24(2).astype(jnp.float32) * (1.0 / (1 << 24))
+                pf_op = _fsel_table(cur_op, params.inst_prob_fail)
+                inst_failed = eff_exec & (u_fail < pf_op)
+            else:
+                inst_failed = jnp.zeros((1, B), jnp.bool_)
+
+            sem = jnp.where(eff_exec & ~inst_failed, meta & 31, -1)
+            # mod_kind keys off exec_mask (not eff_exec), matching the XLA
+            # interpreter exactly: the modifier nop is flagged during
+            # cost-pay cycles too
+            mod_kind = jnp.where(exec_mask & ~inst_failed,
+                                 (meta >> 5) & 3, MOD_NONE)
             default_operand = (meta >> 7) & 3
 
             def is_op(x):
@@ -541,14 +632,24 @@ def _make_kernel(params, L, B, num_steps):
 
             # ---- PRNG (skipped entirely for mutation-free configs, which
             # also lets interpret-mode tests run without TPU PRNG support) ----
+            uniform_mut = not params.mut_cdf or all(
+                abs(params.mut_cdf[k] - (k + 1) / num_insts) < 1e-12
+                for k in range(num_insts))
+
             if params.copy_mut_prob > 0:
-                bits = pltpu.bitcast(pltpu.prng_random_bits((2, B)), jnp.uint32)
-                # uint32 -> f32 casts are unsupported in Mosaic; the top 24
-                # bits fit an int32 exactly
-                u_copy = ((bits[0, :][None, :] >> 8).astype(jnp.int32)
-                          .astype(jnp.float32) * (1.0 / (1 << 24)))
-                rand_inst = ((bits[1, :][None, :] >> 1).astype(jnp.int32)
-                             % num_insts)
+                u_copy = u24(0).astype(jnp.float32) * (1.0 / (1 << 24))
+                r_bits = u24(1)
+                if uniform_mut:
+                    rand_inst = r_bits % num_insts
+                else:
+                    # redundancy-weighted inverse-CDF draw
+                    # (cInstSet::GetRandomInst; ops/interpreter.random_inst)
+                    u_inst = r_bits.astype(jnp.float32) * (1.0 / (1 << 24))
+                    rand_inst = jnp.zeros((1, B), jnp.int32)
+                    for k in range(num_insts - 1):
+                        rand_inst = rand_inst + (
+                            u_inst >= float(params.mut_cdf[k])
+                        ).astype(jnp.int32)
             else:
                 u_copy = jnp.ones((1, B), jnp.float32)
                 rand_inst = jnp.zeros((1, B), jnp.int32)
@@ -631,7 +732,7 @@ def _make_kernel(params, L, B, num_steps):
                     cat = (cc8[:cn, :] | (cc8[1:cn + 1, :] << 8)
                            | (cc8[2:cn + 2, :] << 16)
                            | (cc8[3:cn + 3, :] << 24))
-                    rows4 = (wrows[:cn, :] + c) * 4
+                    rows4 = (jax.lax.broadcasted_iota(jnp.int32, (cn, B), 0) + c) * 4
                     posw = jnp.full((cn, B), L, jnp.int32)
                     for b in range(3, -1, -1):
                         hb = (((cat >> (2 * b)) & m2) == c2) & ok_lane \
@@ -712,7 +813,8 @@ def _make_kernel(params, L, B, num_steps):
                 ri_clear, 0, jnp.where(can_append, rl_len + 1, rl_len))
 
             # ---- h-divide ----
-            div_try = is_op(SEM_H_DIVIDE)
+            div_sex_try = is_op(SEM_H_DIVIDE_SEX)
+            div_try = is_op(SEM_H_DIVIDE) | div_sex_try
             gsize = ivec_ref[IV_GENOME_LEN, :][None, :]
             fsize = gsize.astype(jnp.float32)
             min_sz = jnp.maximum(params.min_genome_len,
@@ -751,6 +853,9 @@ def _make_kernel(params, L, B, num_steps):
             off_start = jnp.where(div_m, rp, ivec_ref[IV_OFF_START, :][None, :])
             off_len = jnp.where(div_m, child_size,
                                 ivec_ref[IV_OFF_LEN, :][None, :])
+            ivec_ref[IV_OFF_SEX, :] = jnp.where(
+                div_m, div_sex_try.astype(jnp.int32),
+                ivec_ref[IV_OFF_SEX, :][None, :])[0]
 
             # (offspring extraction happens ONCE post-loop: a divided lane
             # stalls for the rest of the launch, so its child region
@@ -906,11 +1011,20 @@ def _make_kernel(params, L, B, num_steps):
             ip_new = jnp.where(jmp_ip, jmp_tgt, ip_seq)
             ip_new = jnp.where(mov_ip, flow0, ip_new)
             ip_new = jnp.where(div_m, 0, ip_new)
-            ip_new = jnp.where(exec_mask, ip_new, heads[HEAD_IP, :][None, :])
+            ip_new = jnp.where(eff_exec, ip_new, heads[HEAD_IP, :][None, :])
             heads_new = jnp.where(head_rows == HEAD_IP, ip_new, heads_new)
 
             # divide: CPU reset
             mem_len = jnp.where(div_m, rp, mem_len)
+            if has_costs:
+                # parent cost-engine state resets at divide (interpreter
+                # ops/interpreter.py:572-574)
+                ivec_ref[IV_COST_WAIT, :] = jnp.where(
+                    div_m, 0, ivec_ref[IV_COST_WAIT, :][None, :])[0]
+                ivec_ref[IV_FT_LO, :] = jnp.where(
+                    div_m, 0, ivec_ref[IV_FT_LO, :][None, :])[0]
+                ivec_ref[IV_FT_HI, :] = jnp.where(
+                    div_m, 0, ivec_ref[IV_FT_HI, :][None, :])[0]
             heads_new = jnp.where(div_m, 0, heads_new)
             stacks = jnp.where(div_m, 0, stacks)
             sp_out0 = jnp.where(div_m, 0, sp_out0)
@@ -923,7 +1037,7 @@ def _make_kernel(params, L, B, num_steps):
             # exec flag at ip; at the first operand nop when one is consumed
             lab0_exec = has_label & (label_len > 0)
             nop_exec = has_mod | lab0_exec
-            ebm = _set_bit(ebm, lw_rows, ip, exec_mask)
+            ebm = _set_bit(ebm, lw_rows, ip, eff_exec)
             ebm = _set_bit(ebm, lw_rows, next_pos, nop_exec)
             cbm = _set_bit(cbm, lw_rows, wp, copy_m)
             # h-alloc clears site flags across the fresh zone
@@ -991,6 +1105,12 @@ def _make_kernel(params, L, B, num_steps):
 
             # ---- time + death ----
             time_used = time_used0 + exec_mask.astype(jnp.int32)
+            if params.inst_addl_time_cost:
+                # extra time_used charge, even on prob_fail suppression
+                # (cHardwareCPU.cc:985,1015)
+                time_used = time_used + jnp.where(
+                    eff_exec, _sel_table(cur_op, params.inst_addl_time_cost),
+                    0)
             cpu_cycles = ivec_ref[IV_CPU_CYCLES, :][None, :] + \
                 exec_mask.astype(jnp.int32)
             if params.divide_method != 0:
@@ -1064,6 +1184,10 @@ def _make_kernel(params, L, B, num_steps):
                     ivec_ref[IV_DYN + r, :] = tc_new[0]
                     ivec_ref[IV_DYN + R + r, :] = rc_new[0]
                     ivec_ref[IV_DYN + 2 * R + r, :] = ltc_new[0]
+                    # lifetime per-cell executions (never reset)
+                    ivec_ref[IV_DYN + 3 * R + r, :] = (
+                        ivec_ref[IV_DYN + 3 * R + r, :][None, :]
+                        + performed_l[r])[0]
             fvec_ref[FV_MERIT, :] = merit[0]
             fvec_ref[FV_CUR_BONUS, :] = cur_bonus[0]
             fvec_ref[FV_FITNESS, :] = fitness[0]
@@ -1095,7 +1219,7 @@ def _make_kernel(params, L, B, num_steps):
         for c in range(0, LP, CHUNK):
             cn = min(CHUNK, LP - c)
             tc = tape_ref[pl.ds(c, cn), :]
-            wrows_c = wrows[:cn, :] + c if c else wrows[:cn, :]
+            wrows_c = jax.lax.broadcasted_iota(jnp.int32, (cn, B), 0) + c
             tc = apply_pending(tc, wrows_c, pw_pos, pw_val, pz_s, pz_e)
             tape_ref[pl.ds(c, cn), :] = tc
         ivec_ref[IV_PW_POS, :] = jnp.full((B,), -1, jnp.int32)
@@ -1252,6 +1376,10 @@ def pack_state(params, st, granted):
     for s_ in range(2):
         for d in range(10):
             setrow(IV_STACKS + s_ * 10 + d, st.stacks[:, s_, d])
+    setrow(IV_COST_WAIT, st.cost_wait)
+    setrow(IV_FT_LO, st.ft_paid_lo)
+    setrow(IV_FT_HI, st.ft_paid_hi)
+    setrow(IV_OFF_SEX, st.off_sex)
     iv[IV_PW_POS] = jnp.full(n_pad, -1, jnp.int32)
     iv[IV_PW_VAL] = jnp.zeros(n_pad, jnp.int32)
     iv[IV_PZ_START] = jnp.zeros(n_pad, jnp.int32)
@@ -1263,6 +1391,7 @@ def pack_state(params, st, granted):
         setrow(IV_DYN + r, st.cur_task_count[:, r])
         setrow(IV_DYN + R + r, st.cur_reaction_count[:, r])
         setrow(IV_DYN + 2 * R + r, st.last_task_count[:, r])
+        setrow(IV_DYN + 3 * R + r, st.task_exe_total[:, r])
     for i in range(NI):
         if iv[i] is None:
             iv[i] = jnp.zeros(n_pad, jnp.int32)
@@ -1292,8 +1421,8 @@ def run_packed(params, packed, key, num_steps):
 
     seed = jax.random.randint(key, (1,), 0, 2**31 - 1, dtype=jnp.int32)
 
-    kernel, _ = _make_kernel(params, L, B, num_steps)
     interpret = jax.devices()[0].platform != "tpu"
+    kernel, _ = _make_kernel(params, L, B, num_steps, interpret)
     grid = (n_pad // B,)
     out = pl.pallas_call(
         kernel,
@@ -1373,6 +1502,8 @@ def unpack_state(params, st, packed):
                                      axis=1),
         last_task_count=jnp.stack([row(IV_DYN + 2 * R + r) for r in range(R)],
                                   axis=1),
+        task_exe_total=jnp.stack([row(IV_DYN + 3 * R + r) for r in range(R)],
+                                 axis=1),
         time_used=row(IV_TIME_USED), cpu_cycles=row(IV_CPU_CYCLES),
         gestation_start=row(IV_GEST_START), gestation_time=row(IV_GEST_TIME),
         fitness=frow(FV_FITNESS), last_bonus=frow(FV_LAST_BONUS),
@@ -1383,7 +1514,10 @@ def unpack_state(params, st, packed):
         divide_pending=(flags & FLAG_DIVPEND) != 0,
         off_start=row(IV_OFF_START), off_len=row(IV_OFF_LEN),
         off_copied_size=row(IV_OFF_COPIED),
+        off_sex=row(IV_OFF_SEX) != 0,
         insts_executed=row(IV_INSTS_EXEC),
+        cost_wait=row(IV_COST_WAIT),
+        ft_paid_lo=row(IV_FT_LO), ft_paid_hi=row(IV_FT_HI),
     )
 
 

@@ -49,8 +49,8 @@ def use_pallas_path(params) -> bool:
             raise ValueError(
                 "TPU_USE_PALLAS=1 but this configuration disqualifies the "
                 "Pallas cycle kernel (ops/pallas_cycles.eligible): a "
-                "resource-bound reaction, divide-sex, instruction costs, "
-                "or non-uniform redundancy; use TPU_USE_PALLAS=0 or 2")
+                "resource-bound reaction, by-products, math tasks, or the "
+                "energy model; use TPU_USE_PALLAS=0 or 2")
         return True
     return (pallas_cycles.eligible(params)
             and jax.device_count() == 1
@@ -110,6 +110,9 @@ def update_step(params, st, key, neighbors, update_no):
         if params.hw_type in (1, 2):
             from avida_tpu.ops.interpreter_smt import micro_step_smt
             step_fn = micro_step_smt
+        elif params.max_cpu_threads > 1:
+            from avida_tpu.ops.interpreter import micro_step_threads
+            step_fn = micro_step_threads
         else:
             step_fn = micro_step
 
@@ -249,6 +252,10 @@ def summarize(params, st, update_no=jnp.int32(-1)):
         if jax.config.jax_enable_x64 else st.insts_executed.sum(),
         "task_counts": task_counts,
         "task_doing": task_doing,
+        # lifetime execution totals (all cells, dead included -- the
+        # counter is per-cell monotone; tasks_exe.dat diffs consecutive
+        # updates on the host)
+        "task_exe_totals": st.task_exe_total.sum(axis=0),
         "num_divides": st.num_divides.sum(),
     }
 

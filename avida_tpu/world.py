@@ -147,6 +147,26 @@ class World:
                 parse_event_line("u 0:100:end PrintTimeData"),
             ]
 
+        # DEMES_MIGRATION_METHOD 4: parse the MIGRATION_FILE weight matrix
+        # (cMigrationMatrix::Load: one whitespace-separated row per source
+        # deme) and attach it for make_world_params' CDF build
+        if int(cfg.DEMES_MIGRATION_METHOD) == 4 \
+                and cfg.MIGRATION_FILE not in ("-", ""):
+            mig_path = (os.path.join(config_dir, cfg.MIGRATION_FILE)
+                        if config_dir else cfg.MIGRATION_FILE)
+            rows = []
+            with open(mig_path) as f:
+                for line in f:
+                    line = line.split("#", 1)[0].strip()
+                    if line:
+                        rows.append([float(x) for x in line.split()])
+            if len(rows) != cfg.NUM_DEMES or any(
+                    len(r) != cfg.NUM_DEMES for r in rows):
+                raise ValueError(
+                    f"MIGRATION_FILE {cfg.MIGRATION_FILE!r} must be a "
+                    f"{cfg.NUM_DEMES}x{cfg.NUM_DEMES} matrix")
+            cfg._migration_matrix = rows
+
         self.params = make_world_params(cfg, self.instset, self.environment)
         self.neighbors = jnp.asarray(birth_ops.neighbor_table(
             cfg.WORLD_X, cfg.WORLD_Y, cfg.WORLD_GEOMETRY,
@@ -184,6 +204,13 @@ class World:
         from avida_tpu.systematics import GenotypeArbiter
         self.systematics = (GenotypeArbiter(self.params.num_cells)
                             if cfg.get("TPU_SYSTEMATICS", 1) else None)
+
+        # data provider/recorder registry (ref avida/data/Manager.h);
+        # PrintData and the histogram actions resolve through it
+        from avida_tpu.utils.data_registry import (DataManager,
+                                                   register_standard_providers)
+        self.data = DataManager(self)
+        register_standard_providers(self.data)
 
         # offspring reversion/sterilization via the batched Test CPU
         # (cHardwareBase::Divide_TestFitnessMeasures cc:866); fitness
@@ -415,6 +442,104 @@ class World:
         f.write_row([self.update, float(self._avida_time),
                      float(s["ave_generation"]), insts])
 
+    def _action_PrintData(self, args):
+        """Generic registry-driven writer (cActionPrintData,
+        actions/PrintActions.cc:389: `PrintData <fname> <id,id,...>`):
+        any registered data IDs become a .dat file -- no World edits."""
+        if len(args) < 2:
+            return
+        fname, fmt = args[0], args[1]
+        key = f"printdata:{fname}"
+        if key not in self._files:
+            from avida_tpu.utils.data_registry import DatRecorder
+            ids = [s.strip() for s in fmt.split(",") if s.strip()]
+            specs = [(i, self.data.describe(i) if i != "core.update"
+                      else "Update") for i in ids]
+            self._files[key] = DatRecorder(
+                self.data_dir, fname, "Avida data", specs)
+        self._files[key].record(self.update, self.data)
+
+    def _action_PrintInstructionAbundanceHistogram(self, args):
+        """instruction_histogram.dat: per-opcode counts across live
+        genomes (cActionPrintInstructionAbundanceHistogram)."""
+        from avida_tpu.utils.data_registry import instruction_abundance
+        f = self._file(
+            "inst_hist", lambda d: output_mod.DatFile(
+                os.path.join(d, args[0] if args
+                             else "instruction_histogram.dat"),
+                "Avida instruction abundance histogram",
+                ["Update"] + list(self.instset.inst_names)))
+        f.write_row([self.update] + [int(x)
+                                     for x in instruction_abundance(self)])
+
+    def _action_PrintDepthHistogram(self, args):
+        """depth_histogram.dat rows: update, depth, genotype count."""
+        from avida_tpu.utils.data_registry import depth_histogram
+        f = self._file(
+            "depth_hist", lambda d: output_mod.DatFile(
+                os.path.join(d, args[0] if args else "depth_histogram.dat"),
+                "Avida depth histogram",
+                ["Update", "Depth", "Number of genotypes"]))
+        for depth, count in depth_histogram(self).items():
+            f.write_row([self.update, depth, count])
+
+    def _action_PrintGenotypeAbundanceHistogram(self, args):
+        """genotype_abundance_histogram.dat rows: update, abundance,
+        genotype count."""
+        from avida_tpu.utils.data_registry import abundance_histogram
+        f = self._file(
+            "abund_hist", lambda d: output_mod.DatFile(
+                os.path.join(d, args[0] if args
+                             else "genotype_abundance_histogram.dat"),
+                "Avida genotype abundance histogram",
+                ["Update", "Abundance", "Number of genotypes"]))
+        for ab, count in abundance_histogram(self).items():
+            f.write_row([self.update, ab, count])
+
+    def _action_PrintTasksExeData(self, args):
+        """tasks_exe.dat (cActionPrintTasksExeData): number of times each
+        task was executed this update -- host diff of the device-side
+        lifetime execution totals."""
+        s = self._summary()
+        f = self._file(
+            "tasks_exe", lambda d: output_mod.DatFile(
+                os.path.join(d, "tasks_exe.dat"),
+                "Avida tasks execution data",
+                ["Update"] + [t.capitalize()
+                              for t in self.environment.task_names()],
+                preamble=["First column gives the current update, all "
+                          "further columns give the number",
+                          "of times the particular task has been executed "
+                          "this update."]))
+        totals = np.asarray(s["task_exe_totals"], np.int64)
+        prev = getattr(self, "_task_exe_prev", np.zeros_like(totals))
+        self._task_exe_prev = totals
+        f.write_row([self.update] + [int(x) for x in (totals - prev)])
+
+    def _action_PrintTasksQualData(self, args):
+        """tasks_quality.dat (cActionPrintTasksQualData): average and max
+        task quality.  Logic-9 task quality is binary in this build
+        (documented simplification: the reference's partial-credit tasks
+        are not implemented), so avg == max == 1 when any organism's last
+        gestation performed the task."""
+        s = self._summary()
+        f = self._file(
+            "tasks_qual", lambda d: output_mod.DatFile(
+                os.path.join(d, "tasks_quality.dat"),
+                "Avida tasks quality data",
+                ["Update"] + [f"{t.capitalize()} {m}"
+                              for t in self.environment.task_names()
+                              for m in ("Average", "Max")],
+                preamble=["First column gives the current update, rest "
+                          "give average and max task quality"]))
+        row = [self.update]
+        for c in [int(x) for x in s["task_counts"]]:
+            # binary quality: every performer scores 1.0, so both the
+            # average over performers and the max are 1 when anyone
+            # performed (0 otherwise)
+            row += [1 if c else 0, 1 if c else 0]
+        f.write_row(row)
+
     def _action_PrintResourceData(self, args):
         names = ([r.name for r in self.environment.global_resources()]
                  + [r.name for r in self.environment.spatial_resources()])
@@ -483,7 +608,8 @@ class World:
         self.state = deme_ops.compete_demes(self.params, self.state, k, ctype)
 
     _REP_TRIGGERS = {"all": 0, "full_deme": 1, "full": 1, "corners": 2,
-                     "deme-age": 3, "age": 3, "births": 4}
+                     "deme-age": 3, "age": 3, "births": 4,
+                     "sat-deme-predicate": 5}
 
     def _action_ReplicateDemes(self, args):
         """ReplicateDemes [trigger] (ref cPopulation::ReplicateDemes)."""
@@ -494,7 +620,23 @@ class World:
         if trig is None:
             raise ValueError(f"unknown ReplicateDemes trigger {args[0]!r}")
         self.key, k = jax.random.split(self.key)
-        self.state = deme_ops.replicate_demes(self.params, self.state, k, trig)
+        self.state = deme_ops.replicate_demes(
+            self.params, self.state, k, trig,
+            predicates=tuple(getattr(self, "_deme_predicates", ())))
+
+    def _action_Pred_DemeResourceThresholdPredicate(self, args):
+        """Attach a deme resource-threshold predicate
+        (cActionPred_DemeResourceThresholdPredicate,
+        PopulationActions.cc:4421): `<resource> <op> <value>`; consumed by
+        ReplicateDemes sat-deme-predicate."""
+        name, op, value = args[0], args[1], float(args[2])
+        dres = [r.name for r in self.environment.deme_resources()]
+        if name not in dres:
+            raise ValueError(
+                f"deme resource {name!r} not defined (have {dres})")
+        if not hasattr(self, "_deme_predicates"):
+            self._deme_predicates = []
+        self._deme_predicates.append((dres.index(name), op, value))
 
     def _action_KillProb(self, args):
         """KillProb [prob]: each living organism dies with probability p

@@ -17,8 +17,9 @@ run_seed() {
   mkdir -p "$d" && cd "$d"
   cp "$CFG"/avida.cfg "$CFG"/environment.cfg "$CFG"/events.cfg \
      "$CFG"/instset-heads.cfg "$CFG"/default-heads.org . 2>/dev/null
-  # exit at MAXU instead of 100k updates
-  sed -i "s/^u 100000 exit/u $MAXU exit/" events.cfg
+  # exit at MAXU instead of 100k updates (the stock line reads "u 100000
+  # Exit" -- match case-insensitively so the cap actually applies)
+  sed -i "s/^u 100000 [Ee]xit/u $MAXU Exit/" events.cfg
   "$BIN" -s "$s" -set WORLD_X 60 -set WORLD_Y 60 > avida.log 2>&1
   # first tasks.dat row (update, ..., equ is column 10: not nand and orn or
   # andn nor xor equ) with nonzero EQU count

@@ -1,0 +1,134 @@
+"""Reference-vs-TPU updates-to-EQU distribution comparison.
+
+Inputs:
+  - refbuild/ref_equ_results.txt  (reference CPU build, one "seed update"
+    line per seed; -1 = EQU not discovered within the update budget)
+  - an EQU_r*.json from scripts/equ_harness.py (TPU build; per-seed
+    first_task_update.equ, null = censored)
+
+Both sides are right-censored at their update budget, so the primary test
+is a Mann-Whitney U on the censored values with censored runs ranked
+last (tied at +budget), plus a Fisher exact test on discovery counts.
+SciPy is not in the image; the U statistic, its normal approximation, and
+the hypergeometric tail are computed directly (they are exact enough at
+n = 20 + 20).
+
+Usage: python scripts/compare_equ.py refbuild/ref_equ_results.txt EQU_r05.json
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+
+
+def mann_whitney(a, b):
+    """Two-sided Mann-Whitney U via normal approximation with tie
+    correction (exact enough for n1, n2 >= 8)."""
+    n1, n2 = len(a), len(b)
+    allv = sorted((v, 0) for v in a) + sorted((v, 1) for v in b)
+    allv.sort(key=lambda t: t[0])
+    # midranks
+    ranks = {}
+    i = 0
+    vals = [v for v, _ in allv]
+    while i < len(vals):
+        j = i
+        while j < len(vals) and vals[j] == vals[i]:
+            j += 1
+        for k in range(i, j):
+            ranks[k] = (i + j + 1) / 2.0
+        i = j
+    r1 = sum(ranks[k] for k, (_, g) in enumerate(allv) if g == 0)
+    u1 = r1 - n1 * (n1 + 1) / 2.0
+    mu = n1 * n2 / 2.0
+    # tie correction
+    tie_term = 0.0
+    i = 0
+    while i < len(vals):
+        j = i
+        while j < len(vals) and vals[j] == vals[i]:
+            j += 1
+        t = j - i
+        tie_term += t ** 3 - t
+        i = j
+    n = n1 + n2
+    var = n1 * n2 / 12.0 * ((n + 1) - tie_term / (n * (n - 1)))
+    if var <= 0:
+        return u1, 1.0
+    z = (u1 - mu) / math.sqrt(var)
+    p = math.erfc(abs(z) / math.sqrt(2))
+    return u1, p
+
+
+def fisher_exact(a_hit, a_n, b_hit, b_n):
+    """Two-sided Fisher exact on discovery counts."""
+    def comb(n, k):
+        return math.comb(n, k)
+
+    total = a_n + b_n
+    hits = a_hit + b_hit
+    denom = comb(total, hits)
+
+    def prob(k):
+        if k < max(0, hits - b_n) or k > min(a_n, hits):
+            return 0.0
+        return comb(a_n, k) * comb(b_n, hits - k) / denom
+
+    p_obs = prob(a_hit)
+    return sum(p for k in range(0, min(a_n, hits) + 1)
+               if (p := prob(k)) <= p_obs + 1e-12)
+
+
+def main():
+    ref_path, tpu_path = sys.argv[1], sys.argv[2]
+    ref = {}
+    for line in open(ref_path):
+        parts = line.split()
+        if len(parts) == 2:
+            ref[int(parts[0])] = int(parts[1])
+    tpu_runs = json.load(open(tpu_path))
+    if isinstance(tpu_runs, dict):
+        tpu_runs = tpu_runs.get("runs", tpu_runs.get("results", []))
+
+    budget_r = max((v for v in ref.values() if v > 0), default=20000)
+    budget_r = max(budget_r, 20000)
+    ref_vals = [v if v > 0 else budget_r + 1 for v in ref.values()]
+    ref_hits = sum(1 for v in ref.values() if v > 0)
+
+    tpu_vals, tpu_hits = [], 0
+    budget_t = 20000
+    for r in tpu_runs:
+        equ = r["first_task_update"]["equ"]
+        budget_t = max(budget_t, r.get("updates_run", 0))
+        if equ is None:
+            tpu_vals.append(budget_t + 1)
+        else:
+            tpu_vals.append(equ)
+            tpu_hits += 1
+
+    u, p_u = mann_whitney(ref_vals, tpu_vals)
+    p_f = fisher_exact(ref_hits, len(ref_vals), tpu_hits, len(tpu_vals))
+
+    def med(vs):
+        s = sorted(vs)
+        return s[len(s) // 2]
+
+    out = {
+        "reference": {"n": len(ref_vals), "equ_discovered": ref_hits,
+                      "median_censored": med(ref_vals)},
+        "tpu": {"n": len(tpu_vals), "equ_discovered": tpu_hits,
+                "median_censored": med(tpu_vals)},
+        "mann_whitney_u": round(u, 1),
+        "mann_whitney_p_two_sided": round(p_u, 4),
+        "fisher_exact_p_discovery": round(p_f, 4),
+        "conclusion": ("distributions statistically indistinguishable at "
+                       "alpha=0.05" if p_u > 0.05 and p_f > 0.05 else
+                       "distributions differ at alpha=0.05"),
+    }
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -19,9 +19,12 @@ Usage:
   python scripts/equ_harness.py [--world 60] [--seeds 5] [--max-updates 20000]
       [--check-every 25] [--uncapped] [--out EQU.json]
 
-`--uncapped` raises the per-update micro-step cap (TPU_MAX_STEPS_PER_UPDATE)
-from the default 2x AVE_TIME_SLICE to 100x, removing the budget carry-over
-deviation -- run both and diff the distributions to quantify its effect.
+The DEFAULT configuration is uncapped reference-faithful scheduling
+(TPU_MAX_STEPS_PER_UPDATE = 0, the round-4 default change).  `--cap N`
+opts into the capped burst-scheduling deviation to quantify its effect on
+discovery timing; the legacy `--uncapped` flag is accepted and is a no-op
+(it WAS the opt-in when capped scheduling was the default).  Each result
+records `cap_in_effect`, the actual scheduling mode of the run.
 """
 
 from __future__ import annotations
@@ -42,7 +45,7 @@ TASK_NAMES = ["not", "nand", "and", "orn", "or", "andn", "nor", "xor", "equ"]
 
 
 def run_seed(seed: int, world: int, max_updates: int, check_every: int,
-             uncapped: bool, use_pallas: int | None = None,
+             cap: int = 0, use_pallas: int | None = None,
              copy_mut: float | None = None) -> dict:
     from avida_tpu.config import AvidaConfig
     from avida_tpu.ops.update import summarize
@@ -54,8 +57,7 @@ def run_seed(seed: int, world: int, max_updates: int, check_every: int,
     cfg.RANDOM_SEED = seed
     if copy_mut is not None:
         cfg.COPY_MUT_PROB = copy_mut    # CI variant: compressed timescale
-    if uncapped:
-        cfg.TPU_MAX_STEPS_PER_UPDATE = 100 * cfg.AVE_TIME_SLICE
+    cfg.TPU_MAX_STEPS_PER_UPDATE = cap
     if use_pallas is not None:
         cfg.TPU_USE_PALLAS = use_pallas
     cfg.set("TPU_SYSTEMATICS", 0)      # host phylogeny off the hot path
@@ -87,7 +89,10 @@ def run_seed(seed: int, world: int, max_updates: int, check_every: int,
         "final_organisms": n_alive,
         "wall_s": round(dt, 1),
         "inst_per_sec": round(insts / dt, 1),
-        "uncapped": uncapped,
+        # provenance: the ACTUAL scheduling mode (0 = uncapped
+        # reference-faithful bursts, the default)
+        "cap_in_effect": cap,
+        "uncapped": cap == 0,
     }
 
 
@@ -98,7 +103,10 @@ def main():
     ap.add_argument("--seed-base", type=int, default=1000)
     ap.add_argument("--max-updates", type=int, default=20000)
     ap.add_argument("--check-every", type=int, default=25)
-    ap.add_argument("--uncapped", action="store_true")
+    ap.add_argument("--uncapped", action="store_true",
+                    help="legacy no-op: uncapped is the default")
+    ap.add_argument("--cap", type=int, default=0,
+                    help="TPU_MAX_STEPS_PER_UPDATE opt-in (0 = uncapped)")
     ap.add_argument("--use-pallas", type=int, default=None)
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
@@ -106,7 +114,7 @@ def main():
     results = []
     for s in range(args.seeds):
         r = run_seed(args.seed_base + s, args.world, args.max_updates,
-                     args.check_every, args.uncapped, args.use_pallas)
+                     args.check_every, args.cap, args.use_pallas)
         print(json.dumps(r))
         results.append(r)
 

@@ -149,15 +149,20 @@ def test_slip_mutation_duplicates_and_deletes_regions():
     assert ls.max() <= 64
 
 
-def test_instruction_costs_route_off_the_pallas_kernel():
+def test_instruction_costs_stay_on_the_pallas_kernel():
+    """Round 5 widened kernel eligibility: costs and redundancy weights
+    are handled in-kernel now (tests/test_pallas.py has the equivalence
+    proof); only the energy model and resource-coupled reactions rout
+    off."""
     from avida_tpu.ops.pallas_cycles import eligible
     s = default_instset()
     s.cost[s.opcode("inc")] = 3
-    assert not eligible(_params(instset=s))
+    assert eligible(_params(instset=s))
     s2 = default_instset()
     s2.redundancy[0] = 5.0
-    assert not eligible(_params(instset=s2))
+    assert eligible(_params(instset=s2))
     assert eligible(_params())
+    assert not eligible(_params(ENERGY_ENABLED=1))
 
 
 def test_prob_fail_suppresses_effect_but_charges_time():
@@ -214,11 +219,11 @@ def test_res_cost_refuses_at_load():
         _params(instset=s)
 
 
-def test_prob_fail_routes_off_the_pallas_kernel():
+def test_prob_fail_stays_on_the_pallas_kernel():
     from avida_tpu.ops.pallas_cycles import eligible
     s = default_instset()
     s.prob_fail[s.opcode("inc")] = 0.5
-    assert not eligible(_params(instset=s))
+    assert eligible(_params(instset=s))
     s2 = default_instset()
     s2.addl_time_cost[s2.opcode("inc")] = 1
-    assert not eligible(_params(instset=s2))
+    assert eligible(_params(instset=s2))

@@ -26,7 +26,7 @@ def test_task_ladder_progresses():
     # timescale so the ladder moves within a CPU-friendly update budget;
     # stock-rate physics is exercised by the full-scale script on TPU
     r = run_seed(seed=1009, world=24, max_updates=1500, check_every=150,
-                 uncapped=False, copy_mut=0.02)
+                 cap=0, copy_mut=0.02)
     first = r["first_task_update"]
     assert first["not"] is not None or first["nand"] is not None, (
         f"no first-tier logic task discovered in 1500 updates: {first}")

@@ -76,3 +76,124 @@ def test_kernel_bit_equivalence_through_gestation():
     assert saw_divide, "test never exercised h-divide; lengthen the run"
     assert int(np.asarray(wx.state.alive).sum()) > 1, \
         "no offspring was ever born; birth flush unexercised"
+
+
+def _mk_world_is(use_pallas: int, instset_name: str = "",
+                 instset_mut=None) -> World:
+    """_mk_world with an instruction-set override (name routed through
+    cfg.INST_SET) or an in-place instset mutator."""
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 8
+    cfg.WORLD_Y = 8
+    cfg.TPU_MAX_MEMORY = 200
+    cfg.RANDOM_SEED = 11
+    cfg.COPY_MUT_PROB = 0.0
+    cfg.DIVIDE_INS_PROB = 0.0
+    cfg.DIVIDE_DEL_PROB = 0.0
+    cfg.SLICING_METHOD = 0
+    cfg.AVE_TIME_SLICE = 100
+    cfg.TPU_MAX_STEPS_PER_UPDATE = 100
+    cfg.TPU_USE_PALLAS = use_pallas
+    if instset_name:
+        cfg.INST_SET = instset_name
+    cfg.set("TPU_SYSTEMATICS", 0)
+    w = World(cfg=cfg)
+    if instset_mut is not None:
+        from avida_tpu.core.state import make_world_params
+        instset_mut(w.instset)
+        w.params = make_world_params(w.cfg, w.instset, w.environment)
+    w.inject()
+    return w
+
+
+def _assert_equivalent(wk, wx, n_updates=8, need_divide=True):
+    saw_divide = False
+    for u in range(n_updates):
+        wk.run_update()
+        wx.run_update()
+        wk.update += 1
+        wx.update += 1
+        sk, sx = wk.state, wx.state
+        if bool(np.asarray(sx.num_divides).sum() > 0):
+            saw_divide = True
+        for name in sk.__dataclass_fields__:
+            a = np.asarray(getattr(sk, name))
+            b = np.asarray(getattr(sx, name))
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"field {name} diverged at update {u}")
+    if need_divide:
+        assert saw_divide, "run too short to exercise h-divide"
+
+
+def test_kernel_equivalence_with_instruction_costs():
+    """Round-5 eligibility widening: the in-kernel cost engine (cost +
+    ft_cost) must match the XLA interpreter bit-for-bit through a full
+    gestation (ref SingleProcess_PayPreCosts, cHardwareBase.cc:1241)."""
+    def add_costs(s):
+        s.cost[s.opcode("inc")] = 3
+        s.cost[s.opcode("h-copy")] = 2
+        s.ft_cost[s.opcode("h-alloc")] = 5
+    wk = _mk_world_is(1, instset_mut=add_costs)
+    wx = _mk_world_is(2, instset_mut=add_costs)
+    _assert_equivalent(wk, wx, n_updates=10)
+
+
+def test_kernel_equivalence_divide_sex():
+    """Divide-sex now runs in-kernel (off_sex recorded at the divide
+    cycle; pairing/recombination stay in the shared birth flush)."""
+    wk = _mk_world_is(1, instset_name="heads-sex")
+    wx = _mk_world_is(2, instset_name="heads-sex")
+    _assert_equivalent(wk, wx, n_updates=10, need_divide=False)
+    assert bool(np.asarray(wx.state.divide_pending).any()) or \
+        bool(np.asarray(wx.state.off_sex).any()) or \
+        int(np.asarray(wx.state.num_divides).sum()) > 0
+
+
+def test_kernel_prob_fail_suppresses_in_kernel():
+    """prob_fail=1 on inc: the kernel must suppress the effect while
+    still charging time (PRNG streams differ between engines, so this is
+    a semantic check, not bit-equivalence)."""
+    def fail_inc(s):
+        s.prob_fail[s.opcode("inc")] = 1.0
+    wk = _mk_world_is(1, instset_mut=fail_inc)
+    wk.run_update()
+    wk.update += 1
+    st = wk.state
+    alive0 = np.asarray(st.alive)
+    assert alive0.any()
+    # cycles still consumed (time charged on failures too)
+    assert int(np.asarray(st.time_used)[alive0].max()) == 100
+    # the ancestor's copy loop does not depend on inc: replication
+    # proceeds through the suppressed instruction over a few more updates
+    for _ in range(4):
+        wk.run_update()
+        wk.update += 1
+    assert int(np.asarray(wk.state.alive).sum()) >= 2
+
+
+def test_widened_eligibility():
+    from avida_tpu.ops.pallas_cycles import eligible
+    from avida_tpu.config.instset import default_instset, heads_sex_instset
+    from avida_tpu.config.environment import default_logic9_environment
+
+    def params_for(instset=None, **cfg_kw):
+        from avida_tpu.core.state import make_world_params
+        cfg = AvidaConfig()
+        cfg.WORLD_X = 4
+        cfg.WORLD_Y = 4
+        for k, v in cfg_kw.items():
+            cfg.set(k, v)
+        return make_world_params(cfg, instset or default_instset(),
+                                 default_logic9_environment())
+
+    s = default_instset()
+    s.cost[s.opcode("inc")] = 3
+    assert eligible(params_for(instset=s))          # costs now in-kernel
+    s2 = default_instset()
+    s2.redundancy[0] = 5.0
+    assert eligible(params_for(instset=s2))         # weighted mutations
+    s3 = default_instset()
+    s3.prob_fail[s3.opcode("inc")] = 0.5
+    assert eligible(params_for(instset=s3))         # prob_fail
+    assert eligible(params_for(instset=heads_sex_instset()))  # divide-sex
+    assert not eligible(params_for(ENERGY_ENABLED=1))  # energy still out

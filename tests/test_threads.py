@@ -1,0 +1,192 @@
+"""Intra-organism threads: fork-th / kill-th / id-th +
+THREAD_SLICING_METHOD (ref cHardwareCPU.cc:346-351, ForkThread cc:1505,
+KillThread cc:1592, SingleProcess thread loop cc:930-948,
+cAvidaConfig.h:558-564)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from avida_tpu.config import AvidaConfig
+from avida_tpu.config.instset import default_instset
+from avida_tpu.config.environment import default_logic9_environment
+from avida_tpu.core.state import make_world_params, zeros_population
+from avida_tpu.ops.interpreter import micro_step, micro_step_threads
+
+
+def _thread_instset():
+    s = default_instset()
+    for name in ("fork-th", "kill-th", "id-th"):
+        s.inst_names.append(name)
+        s.redundancy = np.append(s.redundancy, 1.0)
+        s.cost = np.append(s.cost, 0).astype(np.int32)
+        s.ft_cost = np.append(s.ft_cost, 0).astype(np.int32)
+        s.energy_cost = np.append(s.energy_cost, 0.0)
+        s.prob_fail = np.append(s.prob_fail, 0.0)
+        s.addl_time_cost = np.append(s.addl_time_cost, 0).astype(np.int32)
+        s.res_cost = np.append(s.res_cost, 0.0)
+    return s
+
+
+def _params(max_threads=2, slicing=0):
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 2
+    cfg.WORLD_Y = 2
+    cfg.TPU_MAX_MEMORY = 64
+    cfg.MAX_CPU_THREADS = max_threads
+    cfg.THREAD_SLICING_METHOD = slicing
+    cfg.COPY_MUT_PROB = 0.0
+    return make_world_params(cfg, _thread_instset(),
+                             default_logic9_environment())
+
+
+def _one_org(params, program):
+    n, L, R = params.num_cells, params.max_memory, params.num_reactions
+    st = zeros_population(n, L, R, max_threads=params.max_cpu_threads)
+    tape = np.zeros((n, L), np.uint8)
+    tape[0, : len(program)] = program
+    return st.replace(
+        tape=jnp.asarray(tape),
+        mem_len=st.mem_len.at[0].set(len(program)),
+        genome_len=st.genome_len.at[0].set(len(program)),
+        alive=st.alive.at[0].set(True))
+
+
+def _step_fn(params):
+    if params.max_cpu_threads > 1:
+        return micro_step_threads
+    return micro_step
+
+
+def _run(params, st, cycles, seed=0):
+    mask = jnp.zeros(params.num_cells, bool).at[0].set(True)
+    fn = _step_fn(params)
+    step = jax.jit(lambda s, k: fn(params, s, k, mask))
+    key = jax.random.key(seed)
+    for c in range(cycles):
+        key, k = jax.random.split(key)
+        st = step(st, k)
+    return st
+
+
+def test_fork_spawns_thread_and_both_run():
+    """fork-th at position 0: the child resumes at 1, the parent at 2
+    (Inst_ForkThread's manual Advance + the end-of-cycle advance); under
+    THREAD_SLICING_METHOD 0 round-robin, both threads execute their own
+    instruction stream."""
+    p = _params(max_threads=2, slicing=0)
+    s = _thread_instset()
+    fork, inc, dec = s.opcode("fork-th"), s.opcode("inc"), s.opcode("dec")
+    nopA = s.opcode("nop-A")
+    # 0:fork, 1:inc (child starts here), 2:dec (parent resumes here)
+    st = _one_org(p, [fork, inc, dec, nopA, nopA, nopA, nopA, nopA])
+    st = _run(p, st, 1)
+    assert bool(st.t_alive[0, 0])                  # thread spawned
+    assert int(st.t_heads[0, 0, 0]) == 1           # child IP at fork+1
+    assert int(st.heads[0, 0]) == 2                # parent IP at fork+2
+    assert int(st.t_ids[0, 0]) == 1                # lowest free id
+
+    # two more cycles of round-robin: child runs inc (BX+1), parent dec
+    st = _run(p, st, 2, seed=9)
+    # child (slot 1) executed tape[1]=inc (followed by dec, not a nop:
+    # default ?BX?): its BX == +1
+    assert int(st.t_regs[0, 0, 1]) == 1, np.asarray(st.t_regs[0])
+    # parent (slot 0) executed tape[2]=dec with the trailing nop-A
+    # modifier: its AX == -1
+    assert int(st.regs[0, 0]) == -1, np.asarray(st.regs[0])
+
+
+def test_fork_fails_at_cap_but_ip_still_skips():
+    """At MAX_CPU_THREADS=1 fork-th fails (no slot) yet the IP still
+    advances by 2 (the manual Advance precedes the failure check)."""
+    p = _params(max_threads=1)
+    s = _thread_instset()
+    fork, inc = s.opcode("fork-th"), s.opcode("inc")
+    st = _one_org(p, [fork, inc, inc, inc])
+    st = _run(p, st, 1)
+    assert int(st.heads[0, 0]) == 2
+    assert int(st.regs[0].sum()) == 0
+
+
+def test_kill_thread_and_id_th():
+    """kill-th from the forked thread frees its slot; id-th reports
+    distinct ids per thread."""
+    p = _params(max_threads=2, slicing=0)
+    s = _thread_instset()
+    fork, kill, idth = (s.opcode("fork-th"), s.opcode("kill-th"),
+                        s.opcode("id-th"))
+    nopA = s.opcode("nop-A")
+    # 0:fork -> child at 1 (kill-th: child dies), parent at 2 (id-th)
+    st = _one_org(p, [fork, kill, idth, nopA, nopA, nopA, nopA, nopA])
+    st = _run(p, st, 1)          # fork
+    assert bool(st.t_alive[0, 0])
+    st = _run(p, st, 1, seed=5)  # round-robin -> child executes kill-th
+    assert not bool(st.t_alive[0, 0])
+    st = _run(p, st, 1, seed=6)  # parent executes id-th -> BX = 0
+    assert int(st.regs[0, 1]) == 0
+    # kill-th with a single thread fails silently
+    st2 = _one_org(p, [kill, idth, nopA, nopA, nopA, nopA, nopA, nopA])
+    st2 = _run(p, st2, 1)
+    assert int(st2.heads[0, 0]) == 1
+
+
+def test_slicing_method_1_runs_all_threads_per_cycle():
+    """THREAD_SLICING_METHOD 1: every live thread executes each scheduler
+    cycle, but time_used advances once per cycle (cc:930-948)."""
+    p = _params(max_threads=2, slicing=1)
+    s = _thread_instset()
+    fork, inc, dec = s.opcode("fork-th"), s.opcode("inc"), s.opcode("dec")
+    nopA = s.opcode("nop-A")
+    st = _one_org(p, [fork, inc, dec, nopA, nopA, nopA, nopA, nopA])
+    st = _run(p, st, 1)          # cycle 1: only thread 0 exists: fork
+    st = _run(p, st, 1, seed=3)  # cycle 2: BOTH threads run one inst
+    assert int(st.t_regs[0, 0, 1]) == 1    # child ran inc (?BX?)
+    assert int(st.regs[0, 0]) == -1        # parent ran dec ?AX? (nop-A mod)
+    assert int(st.time_used[0]) == 2       # one charge per cycle
+
+
+def test_divide_resets_threads():
+    """A successful divide collapses the parent to a single thread."""
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 2
+    cfg.WORLD_Y = 2
+    cfg.TPU_MAX_MEMORY = 320       # room for the ancestor + h-alloc
+    cfg.MAX_CPU_THREADS = 2
+    cfg.COPY_MUT_PROB = 0.0
+    p = make_world_params(cfg, _thread_instset(),
+                          default_logic9_environment())
+    from avida_tpu.core.state import init_population
+    from avida_tpu.world import default_ancestor
+    s = _thread_instset()
+    anc = default_ancestor(s)
+    st = init_population(p, anc, jax.random.key(0), inject_cell=0)
+    # force a fake multi-thread state, then run to the ancestor's divide
+    st = st.replace(t_alive=st.t_alive.at[0, 0].set(True))
+    mask = jnp.zeros(p.num_cells, bool).at[0].set(True)
+    step = jax.jit(lambda s, k: micro_step_threads(
+        p, s, k, mask & ~s.divide_pending))
+    key = jax.random.key(1)
+    for c in range(900):
+        key, k = jax.random.split(key)
+        st = step(st, k)
+        if c % 50 == 49 and bool(st.divide_pending[0]):
+            break
+    assert bool(st.divide_pending[0]), "ancestor never divided"
+    assert not bool(st.t_alive[0, 0])
+    assert int(st.cur_thread[0]) == 0
+
+
+def test_thread_configs_route_off_the_kernel():
+    """Thread configs AND thread-instruction sets (even at T=1: fork-th
+    still skips an extra IP step) run on the XLA path only."""
+    from avida_tpu.ops.pallas_cycles import eligible
+    assert not eligible(_params(max_threads=2))
+    assert not eligible(_params(max_threads=1))   # instset has fork-th
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 2
+    cfg.WORLD_Y = 2
+    plain = make_world_params(cfg, default_instset(),
+                              default_logic9_environment())
+    assert eligible(plain)
