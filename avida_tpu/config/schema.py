@@ -174,6 +174,14 @@ class AvidaConfig:
     DEMES_MAX_AGE: int = 500
     DEMES_MAX_BIRTHS: int = 100
     DEMES_MIGRATION_RATE: float = 0.0
+    # --- Mating types / birth chamber (cAvidaConfig.h:427-440) ---
+    MATING_TYPES: int = 0            # 0=off, 1=male/female pairing
+    LEKKING: int = 0                 # males always wait in the chamber
+    # (MODULE_NUM / CONT_REC_REGS / CORESPOND_REC_REGS live in the
+    # Recombination block above)
+    # --- Predator-prey (cAvidaConfig.h:814-819) ---
+    PRED_PREY_SWITCH: int = -1       # -1 = no predation
+    PRED_EFFICIENCY: float = 1.0
     DEMES_MIGRATION_METHOD: int = 0  # 0=any, 1=8-neighbor deme grid,
     #                                  2=list-adjacent, 4=MIGRATION_FILE matrix
     DEMES_NUM_X: int = 0             # deme-grid width for method 1

@@ -112,6 +112,11 @@ class WorldParams(struct.PyTreeNode):
     demes_max_births: int = struct.field(pytree_node=False, default=100)
     demes_migration_rate: float = struct.field(pytree_node=False, default=0.0)
     demes_migration_method: int = struct.field(pytree_node=False, default=0)
+    mating_types: bool = struct.field(pytree_node=False, default=False)
+    lekking: bool = struct.field(pytree_node=False, default=False)
+    module_num: int = struct.field(pytree_node=False, default=0)
+    pred_prey_switch: int = struct.field(pytree_node=False, default=-1)
+    pred_efficiency: float = struct.field(pytree_node=False, default=1.0)
     demes_num_x: int = struct.field(pytree_node=False, default=0)
     # method-4 per-source-deme cumulative weights, tuple[D] of tuple[D]
     migration_cdf: tuple = struct.field(pytree_node=False, default=())
@@ -234,6 +239,12 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
             raise ValueError(
                 f"GRADIENT_RESOURCE {r.name!r} peak ({r.peakx},{r.peaky}) "
                 f"lies outside the {cfg.WORLD_X}x{cfg.WORLD_Y} world")
+    if cfg.MODULE_NUM > 0 and not cfg.CONT_REC_REGS:
+        raise NotImplementedError(
+            "non-continuous modular recombination (CONT_REC_REGS 0: "
+            "cBirthChamber::DoModularNonContRecombination / "
+            "DoModularShuffleRecombination) is not implemented; only the "
+            "continuous mode (CONT_REC_REGS 1) is")
     if int(cfg.DEMES_MIGRATION_METHOD) == 3:
         raise NotImplementedError(
             "DEMES_MIGRATION_METHOD 3 (deme points) needs the deme points "
@@ -300,6 +311,11 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         demes_max_births=cfg.DEMES_MAX_BIRTHS,
         demes_migration_rate=cfg.DEMES_MIGRATION_RATE,
         demes_migration_method=int(cfg.DEMES_MIGRATION_METHOD),
+        mating_types=bool(cfg.MATING_TYPES),
+        lekking=bool(cfg.LEKKING),
+        module_num=int(cfg.MODULE_NUM),
+        pred_prey_switch=int(cfg.PRED_PREY_SWITCH),
+        pred_efficiency=float(cfg.PRED_EFFICIENCY),
         demes_num_x=int(cfg.DEMES_NUM_X),
         migration_cdf=_migration_cdf(cfg),
         death_method=cfg.DEATH_METHOD,
@@ -491,10 +507,15 @@ class PopulationState(struct.PyTreeNode):
     # --- birth chamber waiting store (ref cBirthChamber mate storage,
     # cBirthGlobalHandler): ONE waiting sexual offspring; greedy in-update
     # pairing guarantees at most one leftover per flush ---
+    # phenotype mating type (MATING_TYPES runs; cPhenotype.h:411:
+    # juvenile=-1 at birth, female=0, male=1)
+    mating_type: jax.Array    # int32[N]
     bc_mem: jax.Array         # int8[L]    waiting offspring genome
     bc_len: jax.Array         # int32[]    its length
     bc_merit: jax.Array       # f32[]      submitting parent's merit
     bc_valid: jax.Array       # bool[]     entry occupied
+    bc_type: jax.Array        # int32[]    stored offspring's parent mating
+    #                           type (-1 when mating types are off)
 
     # --- demes (ref cDeme: per-group counters + germline; cells map to
     # demes as contiguous bands, deme = cell // (N // D)) ---
@@ -633,8 +654,10 @@ def zeros_population(n: int, L: int, R: int, n_global_res: int = 0,
         off_start=i32(n), off_len=i32(n),
         off_tape=jnp.zeros((n, L), jnp.uint8),
         off_copied_size=i32(n), off_sex=jnp.zeros(n, bool),
+        mating_type=jnp.full(n, -1, jnp.int32),
         bc_mem=jnp.zeros(L, jnp.int8), bc_len=jnp.zeros((), jnp.int32),
         bc_merit=jnp.zeros((), jnp.float32), bc_valid=jnp.zeros((), bool),
+        bc_type=jnp.full((), -1, jnp.int32),
         deme_birth_count=i32(n_demes), deme_age=i32(n_demes),
         germ_mem=jnp.zeros((n_demes, L), jnp.int8), germ_len=i32(n_demes),
         smt_aux=jnp.zeros((n, T, Ls), jnp.uint8), smt_aux_len=i32((n, T)),

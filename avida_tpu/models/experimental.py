@@ -51,7 +51,9 @@ SEM_NOP_BASE = 100       # sentinel range for nops D..H (never dispatched)
     SEM_LOOK_AHEAD,      # ray-scan the faced direction (GoLook cc:3895)
     SEM_SET_FORAGE,      # forage target <- ?BX? (Inst_SetForageTarget)
     SEM_LABEL,           # consume a label, no other effect (Inst_Label)
-) = range(_HEADS_OPS, _HEADS_OPS + 9)
+    SEM_ATTACK_PREY,     # kill the faced prey, absorb merit/bonus
+    #                      (Inst_AttackPrey cc:5407, ExecuteAttack cc:7001)
+) = range(_HEADS_OPS, _HEADS_OPS + 10)
 
 _R = list(range(NUM_REGISTERS))
 
@@ -94,6 +96,12 @@ INSTRUCTIONS = {
     "look-ahead": InstSpec("look-ahead", SEM_LOOK_AHEAD, MOD_REG, 1),
     "set-forage-target": InstSpec("set-forage-target", SEM_SET_FORAGE,
                                   MOD_REG, 1),
+    "attack-prey": InstSpec(
+        "attack-prey", SEM_ATTACK_PREY, MOD_REG, 1,
+        "kill the faced prey (forage target > -2): attacker merit/bonus "
+        "+= PRED_EFFICIENCY x prey's, attacker becomes a predator "
+        "(forage target -2), success echoed to ?BX? "
+        "(Inst_AttackPrey cc:5407; PRED_PREY_SWITCH >= 0 required)"),
 }
 
 _NOP_NAMES = ["nop-A", "nop-B", "nop-C", "nop-D", "nop-E", "nop-F",

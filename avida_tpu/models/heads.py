@@ -50,9 +50,11 @@ MOD_NONE, MOD_REG, MOD_HEAD, MOD_LABEL = 0, 1, 2, 3
     SEM_IO, SEM_H_SEARCH,
     SEM_H_DIVIDE_SEX,
     SEM_FORK_TH, SEM_KILL_TH, SEM_ID_TH,
-) = range(30)
+    SEM_SET_MATE_MALE, SEM_SET_MATE_FEMALE, SEM_SET_MATE_JUV,
+    SEM_IF_MATE_MALE, SEM_IF_MATE_FEMALE,
+) = range(35)
 
-NUM_SEMANTIC_OPS = 30
+NUM_SEMANTIC_OPS = 35
 
 
 @dataclass(frozen=True)
@@ -128,6 +130,24 @@ INSTRUCTIONS = {
     "id-th": InstSpec(
         "id-th", SEM_ID_TH, MOD_REG, REG_BX,
         "?BX? <- current thread id (Inst_ThreadID cc:6773)"),
+    # mating types (cHardwareCPU.cc:425-430; phenotype mating_type starts
+    # MATING_TYPE_JUVENILE=-1, female=0, male=1, core/Definitions.h:188)
+    "set-mating-type-male": InstSpec(
+        "set-mating-type-male", SEM_SET_MATE_MALE, MOD_NONE, 0,
+        "become male unless already female (Inst_SetMatingTypeMale "
+        "cc:10896)"),
+    "set-mating-type-female": InstSpec(
+        "set-mating-type-female", SEM_SET_MATE_FEMALE, MOD_NONE, 0,
+        "become female unless already male (cc:10915)"),
+    "set-mating-type-juvenile": InstSpec(
+        "set-mating-type-juvenile", SEM_SET_MATE_JUV, MOD_NONE, 0,
+        "revert to juvenile (cc:10934)"),
+    "if-mating-type-male": InstSpec(
+        "if-mating-type-male", SEM_IF_MATE_MALE, MOD_NONE, 0,
+        "exec next iff male (Inst_IfMatingTypeMale)"),
+    "if-mating-type-female": InstSpec(
+        "if-mating-type-female", SEM_IF_MATE_FEMALE, MOD_NONE, 0,
+        "exec next iff female"),
 }
 
 # Aliases found in reference instset files / organisms.

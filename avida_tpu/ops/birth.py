@@ -71,17 +71,54 @@ def recombine_sexual(params, st, key, off_mem, off_len, pending):
     rows = jnp.arange(n)
     sexp = pending & st.off_sex
     has_store = st.bc_valid
+    dropped = jnp.zeros(n, bool)
 
-    # rank sexual rows by cell index, shifted by 1 when the store entry is
-    # occupied (the store is rank 0); rank r mates rank r^1
-    rank = jnp.cumsum(sexp) - 1 + has_store.astype(jnp.int32)
-    total = sexp.sum() + has_store.astype(jnp.int32)
-    mate_rank = rank ^ 1
-    paired = sexp & (mate_rank < total)
-    store_paired = sexp & paired & (mate_rank == 0) & has_store  # <=1 row
-    rank_to_row = jnp.zeros(n, jnp.int32).at[
-        jnp.where(sexp, rank, n)].set(rows.astype(jnp.int32), mode="drop")
-    mate_row = rank_to_row[jnp.clip(mate_rank, 0, n - 1)]
+    if params.mating_types:
+        # MATING_TYPES pairing (cBirthMatingTypeGlobalHandler::
+        # SelectOffspring): juvenile parents lose their offspring, male-
+        # and female-parent offspring pair by per-type rank (male rank r
+        # mates female rank r); the single-slot store carries its parent's
+        # type and occupies rank 0 of its own list.  LEKKING collapses in
+        # lockstep: males waiting then females selecting is the same
+        # symmetric pairing.  Excess waiters beyond the one store slot are
+        # dropped (bounded-store deviation, as in the asex path).
+        ptype = st.mating_type
+        juv_drop = sexp & (ptype == -1)
+        sexp = sexp & ~juv_drop
+        is_m = sexp & (ptype == 1)
+        is_f = sexp & (ptype == 0)
+        store_m = has_store & (st.bc_type == 1)
+        store_f = has_store & (st.bc_type == 0)
+        rank_m = jnp.cumsum(is_m) - 1 + store_m.astype(jnp.int32)
+        rank_f = jnp.cumsum(is_f) - 1 + store_f.astype(jnp.int32)
+        rank = jnp.where(is_m, rank_m, rank_f)
+        tot_m = is_m.sum() + store_m.astype(jnp.int32)
+        tot_f = is_f.sum() + store_f.astype(jnp.int32)
+        pairs = jnp.minimum(tot_m, tot_f)
+        paired = sexp & (rank < pairs)
+        row_of_m = jnp.zeros(n, jnp.int32).at[
+            jnp.where(is_m, rank_m, n)].set(rows.astype(jnp.int32),
+                                            mode="drop")
+        row_of_f = jnp.zeros(n, jnp.int32).at[
+            jnp.where(is_f, rank_f, n)].set(rows.astype(jnp.int32),
+                                            mode="drop")
+        rc = jnp.clip(rank, 0, n - 1)
+        mate_row = jnp.where(is_m, row_of_f[rc], row_of_m[rc])
+        store_paired = paired & (rank == 0) & jnp.where(is_m, store_f,
+                                                        store_m)
+        dropped = juv_drop
+    else:
+        # rank sexual rows by cell index, shifted by 1 when the store
+        # entry is occupied (the store is rank 0); rank r mates rank r^1
+        rank = jnp.cumsum(sexp) - 1 + has_store.astype(jnp.int32)
+        total = sexp.sum() + has_store.astype(jnp.int32)
+        mate_rank = rank ^ 1
+        paired = sexp & (mate_rank < total)
+        store_paired = sexp & paired & (mate_rank == 0) & has_store
+        rank_to_row = jnp.zeros(n, jnp.int32).at[
+            jnp.where(sexp, rank, n)].set(rows.astype(jnp.int32),
+                                          mode="drop")
+        mate_row = rank_to_row[jnp.clip(mate_rank, 0, n - 1)]
 
     # mate genome/length/merit come from the store for the store-paired row
     mate_mem = jnp.where(store_paired[:, None], st.bc_mem[None, :].astype(jnp.int8),
@@ -101,6 +138,13 @@ def recombine_sexual(params, st, key, off_mem, off_len, pending):
     u_rec = jax.random.uniform(k_rec, (n,))[pair_lo]
     f0 = jax.random.uniform(k_s, (n,))[pair_lo]
     f1 = jax.random.uniform(k_e, (n,))[pair_lo]
+    if params.module_num > 0:
+        # continuous modular recombination: crossover points snap to
+        # module boundaries (DoModularContRecombination,
+        # cBirthChamber.cc:316-330: start/end modules drawn uniformly)
+        M = float(params.module_num)
+        f0 = jnp.floor(f0 * M) / M
+        f1 = jnp.floor(f1 * M) / M
     start_frac = jnp.minimum(f0, f1)
     end_frac = jnp.maximum(f0, f1)
     cut_frac = end_frac - start_frac
@@ -153,9 +197,19 @@ def recombine_sexual(params, st, key, off_mem, off_len, pending):
     off_mem = jnp.where(do_rec[:, None], child, off_mem)
     off_len = jnp.where(do_rec, new_len, off_len)
 
-    # the odd one out (rank == total-1 with total odd) moves into the store
-    # and its parent resumes
-    leftover = sexp & ~paired                              # <=1 row
+    # the odd one out moves into the store and its parent resumes; with
+    # mating types there can be several unpaired waiters -- the lowest-
+    # index one takes the slot, the rest are dropped (bounded store)
+    unpaired = sexp & ~paired
+    leftover = unpaired & (jnp.cumsum(unpaired) == 1)      # <=1 row
+    dropped = dropped | (unpaired & ~leftover)
+    # the occupant keeps its slot: a leftover only moves in when the slot
+    # is empty or was consumed by a pairing this flush (in the asex path a
+    # leftover implies exactly that, so this is a no-op there); otherwise
+    # the newcomer is dropped too
+    slot_free = ~has_store | store_paired.any()
+    dropped = dropped | (leftover & ~slot_free)
+    leftover = leftover & slot_free
     any_left = leftover.any()
     left_sel = leftover[:, None]
     new_bc_mem = jnp.where(any_left,
@@ -173,8 +227,14 @@ def recombine_sexual(params, st, key, off_mem, off_len, pending):
     new_bc_valid = jnp.where(any_left, True,
                              has_store & ~store_paired.any())
 
-    placeable = pending & ~leftover
-    store = (new_bc_mem, new_bc_len, new_bc_merit, new_bc_valid)
+    new_bc_type = jnp.where(
+        any_left,
+        jnp.sum(jnp.where(leftover, st.mating_type, 0)).astype(jnp.int32)
+        if params.mating_types else jnp.int32(-1),
+        st.bc_type)
+    placeable = pending & ~leftover & ~dropped
+    store = (new_bc_mem, new_bc_len, new_bc_merit, new_bc_valid,
+             new_bc_type)
     return (off_mem, off_len, child_merit, placeable,
             dual, dual_mem, dual_len, dual_merit, store)
 
@@ -673,6 +733,7 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
         "t_alive": False, "main_tid": 0, "t_ids": 0, "cur_thread": 0,
         "t_regs": 0, "t_heads": 0, "t_stack": 0, "t_sp": 0,
         "t_active_stack": 0, "t_rlabel": jnp.int8(0), "t_rlabel_len": 0,
+        "mating_type": -1,     # offspring are juvenile (cPhenotype.cc:433)
         # TransSMT state (size-0 axes on heads hardware; writes are no-ops)
         "smt_aux": jnp.uint8(0), "smt_aux_len": 0,
         "pmem": jnp.uint8(0), "pmem_len": 0, "parasite_active": False,
@@ -821,7 +882,7 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
 
     st = st.replace(**new_fields)
     if sexual:
-        bc_mem, bc_len, bc_merit, bc_valid = store
+        bc_mem, bc_len, bc_merit, bc_valid, bc_type = store
         # transactional store: if the dual row existed but its store child
         # could not be placed (placement conflict), the original waiting
         # entry is NOT consumed -- unless a new leftover already took the
@@ -832,7 +893,7 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
         bc_merit = jnp.where(restore, st.bc_merit, bc_merit)
         bc_valid = bc_valid | restore
         st = st.replace(bc_mem=bc_mem, bc_len=bc_len, bc_merit=bc_merit,
-                        bc_valid=bc_valid)
+                        bc_valid=bc_valid, bc_type=bc_type)
     # winners' (and dead parents') pending flags clear; a leftover sexual
     # offspring moved into the birth-chamber store, so its parent resumes
     # too; living losers retry next update; a parent cell overwritten by a

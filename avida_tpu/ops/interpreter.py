@@ -45,6 +45,8 @@ from avida_tpu.models.heads import (
     SEM_JMP_HEAD, SEM_MOV_HEAD, SEM_NAND, SEM_POP, SEM_PUSH, SEM_SET_FLOW,
     SEM_SHIFT_L, SEM_SHIFT_R, SEM_SUB, SEM_SWAP, SEM_SWAP_STK,
     SEM_FORK_TH, SEM_KILL_TH, SEM_ID_TH,
+    SEM_SET_MATE_MALE, SEM_SET_MATE_FEMALE, SEM_SET_MATE_JUV,
+    SEM_IF_MATE_MALE, SEM_IF_MATE_FEMALE,
     HEAD_IP, HEAD_READ, HEAD_WRITE, HEAD_FLOW, MAX_LABEL_SIZE,
 )
 from avida_tpu.ops import tasks as tasks_ops
@@ -368,6 +370,8 @@ def micro_step(params, st, key, exec_mask, return_signals=False,
     skip = jnp.where(is_op(SEM_IF_N_EQU), val == val2, skip)
     skip = jnp.where(is_op(SEM_IF_LESS), val >= val2, skip)
     skip = jnp.where(is_op(SEM_IF_LABEL), ~rl_match, skip)
+    skip = jnp.where(is_op(SEM_IF_MATE_MALE), st.mating_type != 1, skip)
+    skip = jnp.where(is_op(SEM_IF_MATE_FEMALE), st.mating_type != 0, skip)
     if params.hw_type == 3:
         from avida_tpu.models.experimental import SEM_IF_EQU_0, SEM_IF_NOT_0
         skip = jnp.where(is_op(SEM_IF_NOT_0), val == 0, skip)
@@ -531,9 +535,9 @@ def micro_step(params, st, key, exec_mask, return_signals=False,
     # divide (DIVIDE_METHOD 1): hardware reset -> registers cleared
     regs = jnp.where(div_m[:, None], 0, regs)
     if params.hw_type == 3:
-        (regs, facing, forage_target,
-         move_won, move_tgt) = _exp_spatial(params, st, sem, operand, val,
-                                            regs, setreg)
+        (regs, facing, forage_target, move_won, move_tgt,
+         atk_ok, atk_tgt) = _exp_spatial(params, st, sem, operand, val,
+                                         regs, setreg)
     else:
         facing, forage_target = st.facing, st.forage_target
         move_won = None
@@ -657,6 +661,15 @@ def micro_step(params, st, key, exec_mask, return_signals=False,
         # DIVIDE_METHOD 0: mother untouched; subsequent gestations measure
         # from the divide point (DivideReset cc:853-854)
         gestation_start = jnp.where(div_m, time_used, st.gestation_start)
+    # mating-type transitions (Inst_SetMatingType*, cc:10896-10946:
+    # male<->female transitions fail; juvenile always settable)
+    mating_type = st.mating_type
+    mating_type = jnp.where(
+        is_op(SEM_SET_MATE_MALE) & (mating_type != 0), 1, mating_type)
+    mating_type = jnp.where(
+        is_op(SEM_SET_MATE_FEMALE) & (mating_type != 1), 0, mating_type)
+    mating_type = jnp.where(is_op(SEM_SET_MATE_JUV), -1, mating_type)
+
     died = exec_mask & (st.max_executed > 0) & (time_used >= st.max_executed)
     alive = st.alive & ~died
     insts_executed = st.insts_executed + charge.astype(jnp.int32)
@@ -687,8 +700,11 @@ def micro_step(params, st, key, exec_mask, return_signals=False,
         deme_resources=deme_resources,
         facing=facing, forage_target=forage_target,
         energy=energy, energy_spent=energy_spent,
+        mating_type=mating_type,
     )
     if params.hw_type == 3:
+        if params.pred_prey_switch >= 0:
+            new_st = _apply_attacks(params, new_st, st, atk_ok, atk_tgt)
         new_st = _apply_moves(new_st, move_won, move_tgt)
     if return_signals:
         return new_st, {
@@ -815,7 +831,25 @@ def _exp_spatial(params, st, sem, operand, val, regs, setreg):
         jnp.where(intend, rows, BIG))
     won = intend & (claim[mtgt] == rows)
     regs = setreg(regs, operand, won.astype(jnp.int32), move)
-    return regs, facing, forage_target, won, mtgt
+
+    # attack-prey (Inst_AttackPrey cc:5407 -> ExecuteAttack cc:7001):
+    # faced living prey (forage target > -2) dies; attacker gains
+    # PRED_EFFICIENCY x its merit/bonus and becomes a predator.  The
+    # attack-chance roll and reaction/res-bin transfer are not modeled
+    # (documented); simultaneous attackers of one prey each gain
+    # (lockstep deviation).
+    from avida_tpu.models.experimental import SEM_ATTACK_PREY
+    atk = is_op(SEM_ATTACK_PREY)
+    if params.pred_prey_switch >= 0:
+        atgt, avalid = _facing_step(params, rows, facing,
+                                    jnp.ones_like(rows))
+        atk_ok = (atk & avalid & st.alive[atgt]
+                  & (st.forage_target[atgt] > -2) & (atgt != rows))
+    else:
+        atgt = rows
+        atk_ok = jnp.zeros_like(atk)
+    regs = setreg(regs, operand, atk_ok.astype(jnp.int32), atk)
+    return regs, facing, forage_target, won, mtgt, atk_ok, atgt
 
 
 # world-level / cell-bound fields that do NOT travel with a moving organism
@@ -826,6 +860,28 @@ _NON_ORG_FIELDS = frozenset({
 
     "nb_genome", "nb_len", "nb_cell", "nb_parent", "nb_update", "nb_count",
 })
+
+
+def _apply_attacks(params, st, pre, atk_ok, atk_tgt):
+    """Resolve this cycle's attack-prey kills (ExecuteAttack cc:7001):
+    prey stats are read from the PRE-cycle state, the prey dies, every
+    successful attacker gains PRED_EFFICIENCY x the prey's merit and
+    bonus and turns predator (MakePred: forage target -2)."""
+    n = st.alive.shape[0]
+    eff = params.pred_efficiency
+    prey_merit = pre.merit[atk_tgt]
+    prey_bonus = pre.cur_bonus[atk_tgt]
+    killed = jnp.zeros(n, bool).at[
+        jnp.where(atk_ok, atk_tgt, n)].set(True, mode="drop")
+    return st.replace(
+        merit=jnp.where(atk_ok, (st.merit + prey_merit * eff
+                                 ).astype(st.merit.dtype), st.merit),
+        cur_bonus=jnp.where(atk_ok, (st.cur_bonus + prey_bonus * eff
+                                     ).astype(st.cur_bonus.dtype),
+                            st.cur_bonus),
+        forage_target=jnp.where(atk_ok, -2, st.forage_target),
+        alive=st.alive & ~killed,
+    )
 
 
 def _apply_moves(st, won, target):

@@ -174,11 +174,18 @@ def eligible(params) -> bool:
     if params.max_cpu_threads > 1:
         return False     # intra-organism threads run on the XLA path
     from avida_tpu.models.heads import (SEM_FORK_TH, SEM_ID_TH,
-                                        SEM_KILL_TH)
-    if any(int(s) in (SEM_FORK_TH, SEM_KILL_TH, SEM_ID_TH)
+                                        SEM_IF_MATE_FEMALE,
+                                        SEM_IF_MATE_MALE, SEM_KILL_TH,
+                                        SEM_SET_MATE_FEMALE,
+                                        SEM_SET_MATE_JUV,
+                                        SEM_SET_MATE_MALE)
+    if any(int(s) in (SEM_FORK_TH, SEM_KILL_TH, SEM_ID_TH,
+                      SEM_SET_MATE_MALE, SEM_SET_MATE_FEMALE,
+                      SEM_SET_MATE_JUV, SEM_IF_MATE_MALE,
+                      SEM_IF_MATE_FEMALE)
            for s in params.sem):
-        return False     # fork-th's extra IP advance and id-th's register
-        #                  write exist only in the XLA interpreter
+        return False     # thread and mating-type instructions exist only
+        #                  in the XLA interpreter
     if params.energy_enabled:
         return False     # energy store/merit not implemented in-kernel
     if any(pi >= 0 for pi in getattr(params, "proc_product_idx", ())):

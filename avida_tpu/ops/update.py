@@ -49,8 +49,10 @@ def use_pallas_path(params) -> bool:
             raise ValueError(
                 "TPU_USE_PALLAS=1 but this configuration disqualifies the "
                 "Pallas cycle kernel (ops/pallas_cycles.eligible): a "
-                "resource-bound reaction, by-products, math tasks, or the "
-                "energy model; use TPU_USE_PALLAS=0 or 2")
+                "resource-bound reaction, by-products, math tasks, the "
+                "energy model, MAX_CPU_THREADS > 1, or an instruction set "
+                "with thread/mating-type instructions; use TPU_USE_PALLAS="
+                "0 or 2")
         return True
     return (pallas_cycles.eligible(params)
             and jax.device_count() == 1

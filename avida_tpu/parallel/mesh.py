@@ -48,7 +48,7 @@ _FIELD_SPECS = {"res_grid": P(None, CELL_AXIS), "resources": P(),
                 "grad_peak": P(),
                 # birth-chamber store: world-level, replicated
                 "bc_mem": P(), "bc_len": P(), "bc_merit": P(),
-                "bc_valid": P(),
+                "bc_valid": P(), "bc_type": P(),
                 # deme-axis state: small, replicated (the cell bands
                 # themselves are the sharded axis; deme counters/germlines
                 # ride along)

@@ -139,3 +139,86 @@ def test_move_conflict_lowest_index_wins():
     # loser's move register reports failure, winner's reports success
     assert int(np.asarray(st.regs)[tgt, 1]) == 1
     assert int(np.asarray(st.regs)[b, 1]) == 0
+
+
+def test_pred_look_instset_loads():
+    """The avatars-pred_look set (ref tests/avatars-pred_look/config/
+    instset.cfg) loads without raises and builds world params."""
+    from avida_tpu.config.instset import pred_look_instset
+    from avida_tpu.config.environment import default_logic9_environment
+    from avida_tpu.core.state import make_world_params
+    from avida_tpu.config import AvidaConfig
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 5
+    cfg.WORLD_Y = 5
+    s = pred_look_instset()
+    p = make_world_params(cfg, s, default_logic9_environment())
+    assert p.hw_type == 3 and p.num_insts == len(s.inst_names)
+
+
+def test_predator_hunts_and_kills_prey():
+    """Integration (avatars-pred_look-modeled): a predator program walks
+    toward a prey organism and attacks it -- the prey dies, the attacker
+    absorbs PRED_EFFICIENCY x its merit, turns predator, and the success
+    flag lands in ?BX? (Inst_AttackPrey cc:5407, ExecuteAttack cc:7001)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from avida_tpu.config import AvidaConfig
+    from avida_tpu.config.instset import pred_look_instset
+    from avida_tpu.config.environment import default_logic9_environment
+    from avida_tpu.core.state import make_world_params, zeros_population
+    from avida_tpu.ops.interpreter import micro_step
+
+    s = pred_look_instset()
+    s.inst_names.append("attack-prey")
+    s.redundancy = np.append(s.redundancy, 1.0)
+    s.cost = np.append(s.cost, 0).astype(np.int32)
+    s.ft_cost = np.append(s.ft_cost, 0).astype(np.int32)
+    s.energy_cost = np.append(s.energy_cost, 0.0)
+    s.prob_fail = np.append(s.prob_fail, 0.0)
+    s.addl_time_cost = np.append(s.addl_time_cost, 0).astype(np.int32)
+    s.res_cost = np.append(s.res_cost, 0.0)
+
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 5
+    cfg.WORLD_Y = 5
+    cfg.TPU_MAX_MEMORY = 32
+    cfg.PRED_PREY_SWITCH = 0
+    cfg.PRED_EFFICIENCY = 1.0
+    cfg.COPY_MUT_PROB = 0.0
+    p = make_world_params(cfg, s, default_logic9_environment())
+
+    n, L = p.num_cells, p.max_memory
+    st = zeros_population(n, L, p.num_reactions, num_registers=8)
+    # predator at cell 12 (2,2) facing north; prey at cell 2 (0,2), two
+    # steps north: program = move, attack-prey
+    move, atk = s.opcode("move"), s.opcode("attack-prey")
+    nopA = s.opcode("nop-A")
+    tape = np.zeros((n, L), np.uint8)
+    tape[12, :4] = [move, atk, nopA, nopA]
+    st = st.replace(
+        tape=jnp.asarray(tape),
+        mem_len=st.mem_len.at[12].set(4).at[2].set(4),
+        genome_len=st.genome_len.at[12].set(4).at[2].set(4),
+        alive=st.alive.at[12].set(True).at[2].set(True),
+        merit=jnp.ones(n, jnp.float32).at[2].set(5.0),
+        forage_target=st.forage_target.at[2].set(0),       # prey
+        )
+    mask = jnp.zeros(n, bool).at[12].set(True)
+    step = jax.jit(lambda s_, k: micro_step(p, s_, k, mask))
+    key = jax.random.key(0)
+    # cycle 1: predator moves north (12 -> 7)
+    key, k = jax.random.split(key)
+    st = step(st, k)
+    assert bool(np.asarray(st.alive)[7]) and not bool(np.asarray(st.alive)[12])
+    # the predator travels with its program; re-mask its new cell
+    mask2 = jnp.zeros(n, bool).at[7].set(True)
+    step2 = jax.jit(lambda s_, k: micro_step(p, s_, k, mask2))
+    # cycle 2: attack-prey kills the prey at cell 2
+    key, k = jax.random.split(key)
+    st = step2(st, k)
+    assert not bool(np.asarray(st.alive)[2]), "prey survived the attack"
+    assert float(np.asarray(st.merit)[7]) == 6.0   # 1 + 1.0 x 5
+    assert int(np.asarray(st.forage_target)[7]) == -2  # now a predator
+    assert int(np.asarray(st.regs)[7, 1]) == 1     # success flag in BX

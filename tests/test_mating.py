@@ -1,0 +1,191 @@
+"""Mating types + birth-chamber handlers (round-5, VERDICT r4 directive
+#7): set-mating-type-* instructions (cHardwareCPU.cc:10896-10946),
+typed assortative pairing (cBirthMatingTypeGlobalHandler::SelectOffspring),
+and modular continuous recombination (cBirthChamber.cc:316)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from avida_tpu.config import AvidaConfig
+from avida_tpu.config.instset import heads_sex_instset
+from avida_tpu.config.environment import default_logic9_environment
+from avida_tpu.core.state import make_world_params, zeros_population
+
+
+def _mating_instset():
+    s = heads_sex_instset()
+    for name in ("set-mating-type-male", "set-mating-type-female",
+                 "set-mating-type-juvenile", "if-mating-type-male",
+                 "if-mating-type-female"):
+        s.inst_names.append(name)
+        s.redundancy = np.append(s.redundancy, 1.0)
+        s.cost = np.append(s.cost, 0).astype(np.int32)
+        s.ft_cost = np.append(s.ft_cost, 0).astype(np.int32)
+        s.energy_cost = np.append(s.energy_cost, 0.0)
+        s.prob_fail = np.append(s.prob_fail, 0.0)
+        s.addl_time_cost = np.append(s.addl_time_cost, 0).astype(np.int32)
+        s.res_cost = np.append(s.res_cost, 0.0)
+    return s
+
+
+def _params(**kw):
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 4
+    cfg.WORLD_Y = 4
+    cfg.TPU_MAX_MEMORY = 64
+    cfg.MATING_TYPES = 1
+    cfg.COPY_MUT_PROB = 0.0
+    for k, v in kw.items():
+        cfg.set(k, v)
+    return make_world_params(cfg, _mating_instset(),
+                             default_logic9_environment())
+
+
+def test_mating_type_instructions():
+    """set-male/-female transitions + the male->female refusal + the
+    mating-type conditionals."""
+    from avida_tpu.ops.interpreter import micro_step
+    p = _params()
+    s = _mating_instset()
+    male = s.opcode("set-mating-type-male")
+    female = s.opcode("set-mating-type-female")
+    ifm = s.opcode("if-mating-type-male")
+    inc = s.opcode("inc")
+    st = zeros_population(p.num_cells, p.max_memory, p.num_reactions)
+    prog = [male, female, ifm, inc, ifm, inc]
+    tape = np.zeros((p.num_cells, p.max_memory), np.uint8)
+    tape[0, :len(prog)] = prog
+    st = st.replace(tape=jnp.asarray(tape),
+                    mem_len=st.mem_len.at[0].set(len(prog)),
+                    genome_len=st.genome_len.at[0].set(len(prog)),
+                    alive=st.alive.at[0].set(True))
+    mask = jnp.zeros(p.num_cells, bool).at[0].set(True)
+    key = jax.random.key(0)
+    step = jax.jit(lambda s_, k: micro_step(p, s_, k, mask))
+    assert int(st.mating_type[0]) == -1     # juvenile at birth
+    key, k = jax.random.split(key)
+    st = step(st, k)
+    assert int(st.mating_type[0]) == 1      # became male
+    key, k = jax.random.split(key)
+    st = step(st, k)
+    assert int(st.mating_type[0]) == 1      # set-female REFUSED (is male)
+    # if-mating-type-male executes the inc; BX becomes 1
+    for _ in range(2):
+        key, k = jax.random.split(key)
+        st = step(st, k)
+    assert int(st.regs[0, 1]) == 1
+
+
+def test_assortative_pairing_and_juvenile_loss():
+    """M+F pair (recombine), juvenile offspring lost, extra male stored
+    with its type."""
+    from avida_tpu.ops.birth import recombine_sexual
+    p = _params()
+    n, L = p.num_cells, p.max_memory
+    st = zeros_population(n, L, p.num_reactions)
+    g = np.zeros((n, L), np.int8)
+    for c in range(4):
+        g[c, :20] = c + 1
+    st = st.replace(
+        alive=jnp.asarray([True] * 4 + [False] * (n - 4)),
+        merit=jnp.ones(n, jnp.float32).at[0].set(8.0).at[2].set(2.0),
+        divide_pending=jnp.asarray([True] * 4 + [False] * (n - 4)),
+        off_sex=jnp.asarray([True] * 4 + [False] * (n - 4)),
+        # parents: male, male, female, juvenile
+        mating_type=jnp.asarray([1, 1, 0, -1] + [-1] * (n - 4), jnp.int32))
+    off_mem = jnp.asarray(g)
+    off_len = jnp.where(st.divide_pending, 20, 0)
+    pending = st.divide_pending
+    (om, ol, cm, placeable, dual, dm, dl, dmer, store) = recombine_sexual(
+        p, st, jax.random.key(2), off_mem, off_len, pending)
+    placeable = np.asarray(placeable)
+    # male 0 paired female 2: both placeable
+    assert placeable[0] and placeable[2]
+    # male 1 went to the store; juvenile 3's offspring dropped
+    assert not placeable[1] and not placeable[3]
+    bc_mem, bc_len, bc_merit, bc_valid, bc_type = store
+    assert bool(bc_valid) and int(bc_type) == 1
+    assert int(bc_len) == 20
+
+
+def test_stored_male_pairs_next_female():
+    """A stored male entry mates the next flush's female offspring."""
+    from avida_tpu.ops.birth import recombine_sexual
+    p = _params()
+    n, L = p.num_cells, p.max_memory
+    st = zeros_population(n, L, p.num_reactions)
+    st = st.replace(
+        alive=st.alive.at[5].set(True),
+        merit=jnp.ones(n, jnp.float32),
+        divide_pending=st.divide_pending.at[5].set(True),
+        off_sex=st.off_sex.at[5].set(True),
+        mating_type=jnp.full(n, -1, jnp.int32).at[5].set(0),  # female
+        bc_mem=jnp.full(L, 3, jnp.int8),
+        bc_len=jnp.asarray(16, jnp.int32),
+        bc_merit=jnp.asarray(4.0, jnp.float32),
+        bc_valid=jnp.asarray(True),
+        bc_type=jnp.asarray(1, jnp.int32))                    # stored male
+    off_mem = jnp.zeros((n, L), jnp.int8).at[5, :20].set(7)
+    off_len = jnp.zeros(n, jnp.int32).at[5].set(20)
+    (om, ol, cm, placeable, dual, dm, dl, dmer, store) = recombine_sexual(
+        p, st, jax.random.key(4), off_mem, off_len, st.divide_pending)
+    assert bool(np.asarray(placeable)[5])
+    assert bool(np.asarray(dual)[5])         # store child rides this row
+    assert not bool(store[3])                # store consumed
+
+
+def test_same_type_offspring_wait_not_pair():
+    """Two male-parent offspring do NOT pair with each other."""
+    from avida_tpu.ops.birth import recombine_sexual
+    p = _params()
+    n, L = p.num_cells, p.max_memory
+    st = zeros_population(n, L, p.num_reactions)
+    st = st.replace(
+        alive=st.alive.at[0].set(True).at[1].set(True),
+        merit=jnp.ones(n, jnp.float32),
+        divide_pending=st.divide_pending.at[0].set(True).at[1].set(True),
+        off_sex=st.off_sex.at[0].set(True).at[1].set(True),
+        mating_type=jnp.full(n, -1, jnp.int32).at[0].set(1).at[1].set(1))
+    off_mem = jnp.zeros((n, L), jnp.int8)
+    off_len = jnp.zeros(n, jnp.int32).at[0].set(20).at[1].set(20)
+    (om, ol, cm, placeable, dual, dm, dl, dmer, store) = recombine_sexual(
+        p, st, jax.random.key(5), off_mem, off_len, st.divide_pending)
+    assert not np.asarray(placeable)[:2].any()   # neither placed
+    assert bool(store[3]) and int(store[4]) == 1  # one stored (male)
+
+
+def test_modular_recombination_snaps_to_module_boundaries():
+    """MODULE_NUM=4 with equal 40-inst genomes: crossover cuts land on
+    multiples of 10, so swapped regions are whole modules and offspring
+    length stays 40 (DoModularContRecombination)."""
+    from avida_tpu.ops.birth import recombine_sexual
+    cfg_extra = dict(MATING_TYPES=0, MODULE_NUM=4)
+    p = _params(**cfg_extra)
+    n, L = p.num_cells, p.max_memory
+    for seed in range(6):
+        st = zeros_population(n, L, p.num_reactions)
+        st = st.replace(
+            alive=st.alive.at[0].set(True).at[1].set(True),
+            merit=jnp.ones(n, jnp.float32),
+            divide_pending=st.divide_pending.at[0].set(True).at[1].set(
+                True),
+            off_sex=st.off_sex.at[0].set(True).at[1].set(True))
+        g = np.zeros((n, L), np.int8)
+        g[0, :40] = 1
+        g[1, :40] = 2
+        off_mem = jnp.asarray(g)
+        off_len = jnp.zeros(n, jnp.int32).at[0].set(40).at[1].set(40)
+        (om, ol, cm, placeable, *_rest) = recombine_sexual(
+            p, st, jax.random.key(seed), off_mem, off_len,
+            st.divide_pending)
+        om = np.asarray(om)
+        ol = np.asarray(ol)
+        assert ol[0] == 40 and ol[1] == 40
+        # content switches only at module boundaries (multiples of 10)
+        child = om[0, :40]
+        switches = np.nonzero(np.diff(child))[0] + 1
+        assert all(sw % 10 == 0 for sw in switches), (seed, switches)
