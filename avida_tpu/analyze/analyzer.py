@@ -279,6 +279,67 @@ class Analyzer:
                 int((rel > 1.05).sum())])
         f.close()
 
+    def _cmd_ANALYZE_MODULARITY(self, args):
+        """Functional modularity via site knockouts
+        (cModularityAnalysis::CalcFunctionalModularity,
+        analyze/cModularityAnalysis.cc:54-240): null each site, batch-test
+        through the Test CPU, and mark site x task entries where the
+        knockout completely removes a task the base genotype performs.
+        Columns follow the reference's ADD_GDATA list (cc:42-50, scalar
+        subset)."""
+        fname = args[0] if args else "modularity.dat"
+        f = DatFile(
+            os.path.join(self.data_dir, fname), "Modularity analysis",
+            ["genotype id", "Number of Tasks Performed",
+             "Number of Instructions Involved in Tasks",
+             "Proportion of Sites in Tasks",
+             "Average Number of Tasks Per Site",
+             "Average Number of Sites Per Task",
+             "Average Task Overlap"])
+        nop = 0
+        for g in self.batch:
+            buf, lens = self._padded([g])
+            rbase = evaluate_genomes(self.params, buf, lens)
+            base = float(rbase.fitness[0]) if bool(rbase.viable[0]) else 0.0
+            base_tasks = rbase.task_counts[0] > 0
+            if base <= 0 or not base_tasks.any():
+                f.write_row([g.id, 0, 0, 0.0, 0.0, 0.0, 0.0])
+                continue
+            L = g.length
+            kos = []
+            for site in range(L):
+                m = g.sequence.copy()
+                m[site] = nop
+                kos.append(AnalyzeGenotype(m))
+            buf, lens = self._padded(kos)
+            r = evaluate_genomes(self.params, buf, lens)
+            fit = np.where(r.viable, r.fitness, 0.0)
+            # mod_matrix[task, site] = 1 iff the knockout (still viable)
+            # FULLY removes a task the base does (binary criterion, cc:119)
+            tdone = r.task_counts > 0                       # [L, R]
+            mod = (base_tasks[None, :] & ~tdone
+                   & (fit > 0)[:, None]).T                  # [R, L]
+            sites_per_task = mod.sum(axis=1)
+            tasks_per_site = mod.sum(axis=0)
+            total_task = int((sites_per_task > 0).sum())
+            total_inst = int((tasks_per_site > 0).sum())
+            total_all = int(mod.sum())
+            # average task overlap (cc:157-176)
+            sum_overlap = 0.0
+            if total_task > 1:
+                ov = (mod.astype(np.int64) @ mod.T.astype(np.int64))
+                for i in range(mod.shape[0]):
+                    if ov[i, i]:
+                        other = int(ov[i].sum() - ov[i, i])
+                        sum_overlap += other / (ov[i, i] * (total_task - 1))
+            f.write_row([
+                g.id, total_task, total_inst,
+                total_inst / max(L, 1),
+                (total_all / total_inst) if total_inst else 0.0,
+                (total_all / total_task) if total_task else 0.0,
+                (sum_overlap / total_task) if total_task else 0.0])
+        f.close()
+
     def _recalc_one(self, g) -> float:
         buf, lens = self._padded([g])
         r = evaluate_genomes(self.params, buf, lens)

@@ -68,6 +68,33 @@ class GenotypeArbiter:
         self._activate(cell, np.asarray(genome, np.int8), parent_gid=-1,
                        update=update)
 
+    def classify_seed_all(self, genome: np.ndarray, update: int = -1):
+        """Bulk InjectAll registration (cActionInjectAll): every cell
+        becomes one unit of a single genotype in O(previously occupied)
+        host work instead of num_cells _activate calls (round-4 review
+        weak #7)."""
+        seq = np.asarray(genome, np.int8)
+        for cell in np.nonzero(self.cell_gid >= 0)[0]:
+            self._remove_unit(int(self.cell_gid[cell]), update)
+        key = seq.tobytes()
+        g = self._by_seq.get(key)
+        if g is None:
+            g = Genotype(gid=self._next_id, sequence=seq.copy(),
+                         parent_gid=-1, depth=0, update_born=update)
+            self._next_id += 1
+            self._by_seq[key] = g
+            self.genotypes[g.gid] = g
+        n = self.cell_gid.shape[0]
+        # same per-unit bookkeeping as _activate, batched
+        g.num_units += n
+        g.total_units += n
+        g.last_birth_update = update
+        g.update_deactivated = -1
+        if g.total_units >= self.threshold:
+            g.threshold = True
+        self.num_births_total += n
+        self.cell_gid[:] = g.gid
+
     def _activate(self, cell: int, seq: np.ndarray, parent_gid: int, update: int):
         key = seq.tobytes()
         g = self._by_seq.get(key)

@@ -296,10 +296,7 @@ class World:
             jnp.full(n, float(glen), st.merit.dtype), k)
         self.state = st.replace(**updates)
         if self.systematics is not None:
-            # host-side loop is fine at test scale; large-world benches run
-            # with systematics off (the 100k InjectAll path)
-            for c in range(n):
-                self.systematics.classify_seed(c, g, update=self.update)
+            self.systematics.classify_seed_all(g, update=self.update)
 
     def _action_Exit(self, args):
         self._exit = True

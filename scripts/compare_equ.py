@@ -84,26 +84,34 @@ def fisher_exact(a_hit, a_n, b_hit, b_n):
 def main():
     ref_path, tpu_path = sys.argv[1], sys.argv[2]
     ref = {}
+    ref_last = {}
     for line in open(ref_path):
         parts = line.split()
-        if len(parts) == 2:
+        if len(parts) >= 2:
             ref[int(parts[0])] = int(parts[1])
+            # 3-column harvest format (scripts/harvest_ref_equ.py) carries
+            # the last update each run reached -- in-flight runs are
+            # censored EARLY and set the common comparison budget
+            ref_last[int(parts[0])] = (int(parts[2]) if len(parts) >= 3
+                                       else 20000)
     tpu_runs = json.load(open(tpu_path))
     if isinstance(tpu_runs, dict):
         tpu_runs = tpu_runs.get("runs", tpu_runs.get("results", []))
 
-    budget_r = max((v for v in ref.values() if v > 0), default=20000)
-    budget_r = max(budget_r, 20000)
-    ref_vals = [v if v > 0 else budget_r + 1 for v in ref.values()]
-    ref_hits = sum(1 for v in ref.values() if v > 0)
+    # censor BOTH sides at the smallest horizon ANY run (either side)
+    # reached
+    tpu_horizons = [r.get("updates_run", 20000) for r in tpu_runs] or [20000]
+    budget = min(min(ref_last.values(), default=20000),
+                 min(tpu_horizons), 20000)
+
+    ref_vals = [v if 0 < v <= budget else budget + 1 for v in ref.values()]
+    ref_hits = sum(1 for v in ref.values() if 0 < v <= budget)
 
     tpu_vals, tpu_hits = [], 0
-    budget_t = 20000
     for r in tpu_runs:
         equ = r["first_task_update"]["equ"]
-        budget_t = max(budget_t, r.get("updates_run", 0))
-        if equ is None:
-            tpu_vals.append(budget_t + 1)
+        if equ is None or equ > budget:
+            tpu_vals.append(budget + 1)
         else:
             tpu_vals.append(equ)
             tpu_hits += 1
@@ -116,6 +124,7 @@ def main():
         return s[len(s) // 2]
 
     out = {
+        "censor_budget_updates": budget,
         "reference": {"n": len(ref_vals), "equ_discovered": ref_hits,
                       "median_censored": med(ref_vals)},
         "tpu": {"n": len(tpu_vals), "equ_discovered": tpu_hits,

@@ -1,0 +1,43 @@
+"""Harvest updates-to-first-EQU from reference run directories, including
+RUNS STILL IN FLIGHT: reads each refbuild/ref_equ/seed*/data/tasks.dat
+(stock events print every 100 updates; EQU is column 10) and emits one
+"seed first_equ_update last_update" line per seed, -1 = not yet.
+
+Censoring note for scripts/compare_equ.py: a seed whose last_update is
+below the comparison budget and first_equ is -1 is censored EARLY -- the
+comparison should either wait or censor BOTH sides at min(last_update).
+
+Usage: python scripts/harvest_ref_equ.py [ref_equ_dir] > results.txt
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main():
+    base = sys.argv[1] if len(sys.argv) > 1 else "refbuild/ref_equ"
+    for name in sorted(os.listdir(base)):
+        if not name.startswith("seed"):
+            continue
+        path = os.path.join(base, name, "data", "tasks.dat")
+        if not os.path.exists(path):
+            continue
+        seed = name[4:]
+        first = -1
+        last = 0
+        for line in open(path):
+            if line.startswith("#") or not line.strip():
+                continue
+            parts = line.split()
+            if len(parts) < 10:
+                continue
+            last = int(parts[0])
+            if first < 0 and int(parts[9]) > 0:
+                first = last
+        print(f"{seed} {first} {last}")
+
+
+if __name__ == "__main__":
+    main()

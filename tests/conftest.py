@@ -27,3 +27,12 @@ def small_world_cfg():
     cfg.TPU_MAX_MEMORY = 320
     cfg.RANDOM_SEED = 7
     return cfg
+
+
+def pytest_configure(config):
+    # fast/slow split (round-4 review weak #9): `pytest -m "not slow"` is
+    # the quick pre-commit subset (~3-4 min); the full suite is the
+    # end-of-round recorded run
+    config.addinivalue_line(
+        "markers", "slow: multi-minute test (full gestations, chunked "
+        "runs, golden scenario sweeps)")

@@ -16,6 +16,10 @@ from avida_tpu.config import AvidaConfig
 from avida_tpu.config.events import parse_event_line
 from avida_tpu.world import World
 
+import pytest  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
 
 def _world(tmpdir, seed=11, **kw):
     cfg = AvidaConfig()

@@ -19,6 +19,10 @@ from avida_tpu.core.state import make_world_params, zeros_population
 from avida_tpu.ops import demes as deme_ops
 from avida_tpu.world import World
 
+import pytest  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
 
 def _params(num_demes=2, side=8, L=64, **kw):
     cfg = AvidaConfig()

@@ -20,6 +20,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
 
 from equ_harness import run_seed  # noqa: E402
 
+import pytest  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
 
 def test_task_ladder_progresses():
     # copy_mut above stock (0.02 vs 0.0075) compresses the discovery

@@ -19,6 +19,8 @@ from avida_tpu.core.state import init_population, make_world_params
 from avida_tpu.ops.interpreter import extract_offspring, micro_step
 from avida_tpu.world import default_ancestor
 
+pytestmark = pytest.mark.slow
+
 
 def make_single_org(cfg_updates=None):
     cfg = AvidaConfig()

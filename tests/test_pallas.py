@@ -25,6 +25,8 @@ from avida_tpu.config import AvidaConfig
 from avida_tpu.ops.update import update_step, use_pallas_path
 from avida_tpu.world import World
 
+pytestmark = pytest.mark.slow
+
 
 def _mk_world(use_pallas: int) -> World:
     cfg = AvidaConfig()

@@ -16,6 +16,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 
 def _build(world_x, world_y, seed=11, **overrides):
     from avida_tpu.config import AvidaConfig

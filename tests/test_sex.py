@@ -19,6 +19,8 @@ from avida_tpu.core.state import make_world_params, zeros_population
 from avida_tpu.ops import birth as birth_ops
 from avida_tpu.world import World, default_ancestor
 
+pytestmark = pytest.mark.slow
+
 
 def _sex_params(n_side=4, L=64):
     cfg = AvidaConfig()

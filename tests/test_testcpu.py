@@ -14,6 +14,8 @@ from avida_tpu.config.environment import default_logic9_environment
 from avida_tpu.core.state import make_world_params
 from avida_tpu.world import default_ancestor
 
+pytestmark = pytest.mark.slow
+
 
 def make_params(L=320):
     cfg = AvidaConfig()

@@ -17,6 +17,10 @@ from avida_tpu.config import AvidaConfig, transsmt_instset
 from avida_tpu.config.events import parse_event_line
 from avida_tpu.world import World, default_ancestor, default_parasite
 
+import pytest  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
 
 def _world(**kw):
     cfg = AvidaConfig()
