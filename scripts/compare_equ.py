@@ -98,11 +98,13 @@ def main():
     if isinstance(tpu_runs, dict):
         tpu_runs = tpu_runs.get("runs", tpu_runs.get("results", []))
 
-    # censor BOTH sides at the smallest horizon ANY run (either side)
-    # reached
-    tpu_horizons = [r.get("updates_run", 20000) for r in tpu_runs] or [20000]
-    budget = min(min(ref_last.values(), default=20000),
-                 min(tpu_horizons), 20000)
+    # censor BOTH sides at the smallest horizon among NON-discovering
+    # runs (a run that found EQU then stopped is an observed event, not a
+    # censoring bound; equ_harness exits each seed at discovery)
+    ref_nd = [ref_last[s] for s, v in ref.items() if v < 0] or [20000]
+    tpu_nd = [r.get("updates_run", 20000) for r in tpu_runs
+              if r["first_task_update"]["equ"] is None] or [20000]
+    budget = min(min(ref_nd), min(tpu_nd), 20000)
 
     ref_vals = [v if 0 < v <= budget else budget + 1 for v in ref.values()]
     ref_hits = sum(1 for v in ref.values() if 0 < v <= budget)
