@@ -526,10 +526,22 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
         r = jax.random.randint(jax.random.fold_in(k_place, 6), (n,), 0,
                                cpd, dtype=jnp.int32)
         target = (rows // cpd) * cpd + r
-    elif bm == 7:          # PARENT_FACING: the faced connection; the
-        # lockstep engine models no rotation, so facing = connection 0
-        # (documented deviation)
-        target = jnp.where(neighbors[:, 0] < 0, rows, neighbors[:, 0])
+    elif bm == 7:          # PARENT_FACING (cPopulation.cc:5259): the faced
+        # connection.  Experimental hardware (hw 3) has real facing state
+        # (rotate-x / rotate-org-id), so the offspring goes one step in
+        # the parent's facing direction; heads hardware models no
+        # rotation, so facing = connection 0 (documented deviation)
+        if params.hw_type == 3:
+            from avida_tpu.ops.interpreter import _facing_step
+            ftgt, fvalid = _facing_step(params, rows, st.facing,
+                                        jnp.ones_like(rows))
+            target = jnp.where(fvalid, ftgt, rows)
+            # off-grid facing on bounded geometries fails the birth (the
+            # parent retries), matching how move/attack treat invalid
+            # facing -- never a silent self-replacement
+            pending = pending & fvalid
+        else:
+            target = jnp.where(neighbors[:, 0] < 0, rows, neighbors[:, 0])
     elif bm == 8:          # NEXT_CELL
         target = (rows + 1) % n
     elif bm == 9:          # FULL_SOUP_ENERGY_USED (cPopulation.cc:5332):

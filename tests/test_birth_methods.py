@@ -152,3 +152,31 @@ def test_pop_cap_eldest_kills_oldest():
     assert alive.sum() == 6
     # the oldest fills (highest ages: cells 6,7 at ages 70,80) died first
     assert not alive[7] and not alive[6]
+
+
+def test_birth_method_7_uses_real_facing_on_experimental_hw():
+    """BIRTH_METHOD 7 (PARENT_FACING, cPopulation.cc:5259): on hw 3 the
+    offspring lands one step in the parent's facing direction."""
+    from avida_tpu.config.instset import experimental_instset
+
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 5
+    cfg.WORLD_Y = 5
+    cfg.BIRTH_METHOD = 7
+    p = make_world_params(cfg, experimental_instset(),
+                          default_logic9_environment())
+    n, L = p.num_cells, p.max_memory
+    st = zeros_population(n, L, p.num_reactions,
+                          num_registers=p.num_registers)
+    st = st.replace(
+        alive=st.alive.at[12].set(True),
+        merit=jnp.ones(n, jnp.float32),
+        divide_pending=st.divide_pending.at[12].set(True),
+        off_len=jnp.zeros(n, jnp.int32).at[12].set(12),
+        off_tape=jnp.zeros((n, L), jnp.uint8).at[12, :12].set(3),
+        facing=st.facing.at[12].set(2))   # ring dir 2 = east -> cell 13
+    neighbors = jnp.asarray(birth_ops.neighbor_table(5, 5, 2))
+    st2 = birth_ops.flush_births(p, st, jax.random.key(1), neighbors,
+                                 jnp.int32(1), use_off_tape=True)
+    born = np.nonzero(np.asarray(st2.alive) & ~np.asarray(st.alive))[0]
+    assert list(born) == [13], born
