@@ -15,6 +15,18 @@ Avida::Util::ProcessCmdLineArgs, source/util/CmdLine.cc:205):
   -a         analyze mode: run ANALYZE_FILE (analyze.cfg) through the
              batch VM instead of an evolution run (ANALYZE_MODE=1)
   -v         verbose
+
+TPU-build extras (no reference equivalent):
+
+  --telemetry        enable the runtime telemetry subsystem
+                     (avida_tpu/observability/): per-update phase timers,
+                     device counters and a telemetry.jsonl run log in the
+                     data dir.  Shorthand for -set TPU_TELEMETRY 1.
+                     Telemetry runs per-update with fenced phases --
+                     expect lower throughput than the fused default.
+  --profile-dir DIR  with --telemetry: capture a jax.profiler (XProf)
+                     trace of the first few updates into DIR
+                     (TPU_PROFILE_UPDATES controls how many).
 """
 
 from __future__ import annotations
@@ -35,11 +47,18 @@ def main(argv=None):
     p.add_argument("-u", "--updates", type=int, default=None)
     p.add_argument("-a", "--analyze", action="store_true")
     p.add_argument("-v", "--verbose", action="store_true")
+    p.add_argument("--telemetry", action="store_true")
+    p.add_argument("--profile-dir", default=None)
     args = p.parse_args(argv)
 
     overrides = list(map(tuple, args.overrides))
     if args.seed is not None:
         overrides.append(("RANDOM_SEED", args.seed))
+    if args.telemetry:
+        overrides.append(("TPU_TELEMETRY", 1))
+    if args.profile_dir:
+        overrides.append(("TPU_TELEMETRY", 1))
+        overrides.append(("TPU_PROFILE_DIR", args.profile_dir))
 
     from avida_tpu.world import World
     world = World(config_dir=args.config_dir, overrides=overrides,

@@ -25,10 +25,14 @@ from avida_tpu.analyze.testcpu import _sandbox_state
 def collect_trace(params, genome, max_cycles: int = 2000, seed: int = 0):
     """Run one genome in the sandbox, snapshotting state every cycle.
 
-    Returns a list of dicts (one per executed cycle): ip, read/write/flow
-    head positions, registers, top of stack, memory length, divide flag.
+    Returns a list of dicts (one per executed cycle): the fetched opcode
+    (`op`, read pre-execution through the interpreter's own fetch helper,
+    ops/interpreter.fetch_opcode -- the post-hoc memory read used before
+    could misreport instructions at sites the copy loop later overwrote),
+    ip, read/write/flow head positions, registers, top of stack, memory
+    length, divide flag.
     """
-    from avida_tpu.ops.interpreter import micro_step
+    from avida_tpu.ops.interpreter import fetch_opcode, micro_step
 
     genome = np.asarray(genome, np.int8)
     L = params.max_memory
@@ -42,11 +46,14 @@ def collect_trace(params, genome, max_cycles: int = 2000, seed: int = 0):
                         key)
     step = jax.jit(lambda s, k: micro_step(params, s, k, s.alive
                                            & ~s.divide_pending))
+    fetch = jax.jit(lambda s: fetch_opcode(params, s))
     snaps = []
     for t in range(max_cycles):
+        op = int(fetch(st)[0])
         st = step(st, jax.random.fold_in(key, t))
         snaps.append({
             "cycle": t + 1,
+            "op": op,
             "ip": int(st.heads[0, 0]),
             "read": int(st.heads[0, 1]),
             "write": int(st.heads[0, 2]),
@@ -67,14 +74,13 @@ def trace_genome(params, instset, genome, path: str,
     """Write a cHardwareStatusPrinter-style text trace to `path`."""
     genome = np.asarray(genome, np.int8)
     snaps, st = collect_trace(params, genome, max_cycles, seed)
-    mem = np.asarray(st.mem[0])
     names = instset.inst_names
     with open(path, "w") as f:
         f.write(f"# Trace of genome (length {len(genome)})\n")
         f.write("# " + " ".join(names[int(o)] for o in genome) + "\n\n")
         for s in snaps:
-            op = int(mem[s['ip'] % max(s['mem_len'], 1)])
             f.write(
+                f"{names[s['op']]:12s} "
                 f"U:{s['cycle']} IP:{s['ip']} AX:{s['regs'][0]} "
                 f"BX:{s['regs'][1]} CX:{s['regs'][2]} "
                 f"R-Head:{s['read']} W-Head:{s['write']} F-Head:{s['flow']} "

@@ -209,6 +209,18 @@ class AvidaConfig:
     # (use on TPU when the environment qualifies), 1 = force on (any
     # backend; interpret mode off-TPU), 2 = off (always XLA micro-steps).
     TPU_USE_PALLAS: int = 0
+    # Runtime telemetry (avida_tpu/observability/): 1 = phase-fenced
+    # staged updates, device counters and a telemetry.jsonl run log in
+    # DATA_DIR.  Opt-in: 0 (default) compiles to the identical update
+    # program as before the subsystem existed (tests/test_telemetry.py)
+    # and writes no files.  Telemetry forces per-update host dispatch
+    # (no update_scan chunking) and fences every phase, so expect the
+    # run to be slower -- it trades throughput for attribution.
+    TPU_TELEMETRY: int = 0
+    # Where `jax.profiler` traces go when telemetry is on ("-" = no trace
+    # capture).  The first TPU_PROFILE_UPDATES updates are captured.
+    TPU_PROFILE_DIR: str = "-"
+    TPU_PROFILE_UPDATES: int = 3
 
     extras: dict = field(default_factory=dict)
 

@@ -1,0 +1,40 @@
+"""Runtime telemetry: phase timers, device counters, structured run logs.
+
+The reference engine exposes its run state through a 244-action print
+library and per-cycle tracer hooks (cHardwareTracer, PrintActions.cc);
+this package is the lockstep port's equivalent visibility layer BELOW
+the .dat files -- where the update's wall time goes and what the device
+actually executed:
+
+  timeline.py -- `Timeline`: block_until_ready-fenced phase wall clocks
+                 + optional jax.profiler trace capture
+  counters.py -- device-side counter reductions: births/deaths, task
+                 triggers, per-block budget-tail utilization, and the
+                 instruction-dispatch-mix accumulator threaded through
+                 ops/update.interpret_phase
+  staged.py   -- `StagedUpdate`: the update's phase functions jitted
+                 separately and fenced (bit-identical trajectory to the
+                 fused ops/update.update_step)
+  runlog.py   -- `TelemetryRecorder`/`TelemetryWriter`: telemetry.jsonl
+                 (one JSON object per update: phases, counters, metadata)
+  harness.py  -- the unified profiling CLI (replaces
+                 scripts/profile_update.py) + bench.py's `phases` hook
+
+Everything is opt-in (TPU_TELEMETRY=1 / `python -m avida_tpu --telemetry`)
+and zero-cost when disabled: the production update program traces to the
+identical jaxpr whether or not this package is imported
+(tests/test_telemetry.py), and no files are written.
+"""
+
+from avida_tpu.observability.counters import (budget_block, budget_tail,
+                                              dispatch_init, update_counters)
+from avida_tpu.observability.harness import profile_phases
+from avida_tpu.observability.runlog import TelemetryRecorder, TelemetryWriter
+from avida_tpu.observability.staged import StagedUpdate
+from avida_tpu.observability.timeline import Timeline
+
+__all__ = [
+    "Timeline", "StagedUpdate", "TelemetryRecorder", "TelemetryWriter",
+    "profile_phases", "budget_block", "budget_tail", "dispatch_init",
+    "update_counters",
+]

@@ -1,0 +1,89 @@
+"""Device-side telemetry counters.
+
+Everything here is a small jitted reduction over existing state -- the
+counters read what the engine already tracks (insts_executed,
+birth_update, task_exe_total, the granted budget vector) rather than
+adding bookkeeping to the hot path.  The one exception is the
+instruction-dispatch mix, which needs a per-cycle accumulator threaded
+through the update's while_loop: ops/update.interpret_phase takes an
+optional int32[num_insts] `counters` carry and scatter-adds the opcode
+under every scheduled lane's IP each cycle (ops/interpreter.fetch_opcode).
+On the default single-thread path the mix sums exactly to the update's
+executed-instruction count.  The Pallas kernel path does not collect the
+mix (an in-kernel [num_insts] scatter per cycle is not cheap); its
+harness reports the budget/phase counters only, which need no kernel
+changes because `granted` is a kernel *input*.
+
+The budget-tail counters quantify the remaining uncapped throughput gap
+called out in ROUND5_NOTES.md: each kernel block's while_loop runs to
+the max granted budget of ITS lanes, so
+
+    utilization = granted.sum() / sum_b(block_size * max_b(granted))
+
+is the fraction of lockstep lane-cycles doing useful work (1.0 = no
+tail waste).  On the XLA path the whole population is one block.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def dispatch_init(params):
+    """Zeroed dispatch-mix accumulator for interpret_phase's `counters`."""
+    return jnp.zeros(params.num_insts, jnp.int32)
+
+
+@partial(jax.jit, static_argnums=0)
+def update_counters(params, st, alive_before, update_no):
+    """Per-update counter block, computed AFTER the birth flush so the
+    birth/death accounting matches what summarize()/light_stats() feed the
+    .dat files.  Returns a dict of device scalars plus the task-execution
+    lifetime totals vector (the host diffs consecutive updates, exactly
+    like tasks_exe.dat).  Budget blocking lives in budget_tail."""
+    alive = st.alive
+    n_alive = alive.sum()
+    births = (alive & (st.birth_update == update_no)).sum()
+    deaths = jnp.maximum(alive_before + births - n_alive, 0)
+    return {
+        "organisms": n_alive,
+        "births": births,
+        "deaths": deaths,
+        "divides_total": st.num_divides.sum(),
+        "task_exe_totals": st.task_exe_total.sum(axis=0),
+    }
+
+
+@partial(jax.jit, static_argnums=1)
+def budget_tail(granted, block):
+    """Per-block budget-tail utilization of the granted budget vector.
+    Returns device scalars: granted_sum, ceiling_sum (sum over blocks of
+    block_size * block_max -- the lane-cycles the lockstep loop actually
+    burns), block_max_max and block_mean_mean (mean-vs-max granted budget
+    per block, the ~1.5x gap ROUND5_NOTES.md identifies)."""
+    n = granted.shape[0]
+    pad = (-n) % block
+    g = jnp.pad(granted, (0, pad))            # padded lanes grant 0 cycles
+    gb = g.reshape(-1, block)
+    bmax = gb.max(axis=1)
+    bmean = gb.mean(axis=1)
+    return {
+        "granted_sum": granted.sum(),
+        "ceiling_sum": (bmax * block).sum(),
+        "block_max_max": bmax.max(),
+        "block_mean_mean": bmean.mean(),
+    }
+
+
+def budget_block(params, n) -> int:
+    """Blocking granularity of the current interpret path: the Pallas
+    launch block when the kernel runs, else the whole population (the XLA
+    while_loop runs every lane to the global max)."""
+    from avida_tpu.ops.update import use_pallas_path
+    if use_pallas_path(params):
+        from avida_tpu.ops.pallas_cycles import block_dims
+        return block_dims(params, n)[0]
+    return n

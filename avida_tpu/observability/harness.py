@@ -1,0 +1,119 @@
+"""Unified profiling harness: per-phase breakdown of one update's wall time.
+
+Replaces scripts/profile_update.py.  Times each phase of
+ops/update.update_step at bench scale through the SAME StagedUpdate
+runner the telemetry path uses -- scheduler draw, pack / kernel / unpack
+(Pallas path) or the XLA while_loop, birth flush -- plus the fused whole
+update for comparison.  Run on TPU:
+
+    python -m avida_tpu.observability.harness [world_side]
+
+bench.py calls `profile_phases` after its headline measurement to attach
+a `phases` breakdown to its JSON line.
+
+MEASUREMENT CAVEATS (learned the hard way; see BASELINE.md):
+ - repeated dispatches with IDENTICAL inputs can be elided/cached by the
+   runtime and report absurdly low times -- a round-5 budget-binning
+   optimization was accepted on a microbenchmark broken exactly this way
+   and had to be reverted.  This harness is immune by construction: every
+   rep runs the full staged update on the previous rep's evolved state,
+   so no phase ever sees the same input twice;
+ - per-call block_until_ready over a remote-device tunnel measures
+   network round-trips (100-300 ms, noisy), not device time -- phase
+   numbers are only trustworthy on a locally attached backend;
+ - fencing serializes phases XLA would overlap, so the phase sum is an
+   UPPER bound on the fused update (reported as `full_step` below);
+ - treat end-to-end `python bench.py` deltas as ground truth (run-to-run
+   noise ~ +/-2M inst/s at 102k organisms).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from avida_tpu.observability.staged import StagedUpdate
+from avida_tpu.observability.timeline import Timeline
+
+
+def profile_phases(params, st, neighbors, key, reps=3, warmup=1,
+                   update0=0, collect_dispatch=False):
+    """Mean per-phase wall time over `reps` staged updates (ms), after
+    `warmup` compile/warm updates.  Each rep advances the state, so no
+    phase repeats an input (see module docstring).  Returns
+    ({phase: ms}, final_state, total_granted)."""
+    staged = StagedUpdate(params, neighbors,
+                          collect_dispatch=collect_dispatch)
+    u = update0
+    warm_tl = Timeline()
+    for _ in range(max(warmup, 1)):
+        st, *_ = staged.run(st, jax.random.fold_in(key, u), u, warm_tl)
+        u += 1
+    tl = Timeline()
+    granted_total = 0
+    for _ in range(reps):
+        st, _, _, granted, _ = staged.run(
+            st, jax.random.fold_in(key, u), u, tl)
+        granted_total += int(granted.sum())
+        u += 1
+    acc = tl.drain()
+    return {name: ms / reps for name, ms in acc.items()}, st, granted_total
+
+
+def _timeit_chain(fn, st, key, u0, reps):
+    """Mean wall time of the FUSED update over a chain of evolving states
+    (distinct inputs per call; one fence at the end of the chain)."""
+    import time
+    st, _ = fn(st, jax.random.fold_in(key, u0), jnp.int32(u0))   # warm
+    jax.block_until_ready(st)
+    t0 = time.perf_counter()
+    for i in range(reps):
+        st, _ = fn(st, jax.random.fold_in(key, u0 + 1 + i),
+                   jnp.int32(u0 + 1 + i))
+    jax.block_until_ready(st)
+    return (time.perf_counter() - t0) / reps
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    sys.path.insert(0, ".")
+    from bench import build
+    from avida_tpu.ops.update import update_step, use_pallas_path
+
+    world = int(argv[0]) if argv else 320
+    reps = int(argv[1]) if len(argv) > 1 else 5
+    params, st, neighbors, key = build(world, world, 256, seed=100)
+    n = params.num_cells
+    cap = params.max_steps_per_update or "uncapped"
+    path = "pallas" if use_pallas_path(params) else "xla_while_loop"
+    print(f"world {world}x{world} = {n} cells, L={params.max_memory}, "
+          f"cap={cap}, platform={jax.devices()[0].platform}, path={path}")
+
+    # advance a few updates so state is "typical" (fused path)
+    for u in range(3):
+        key, k = jax.random.split(key)
+        st, _ = update_step(params, st, k, neighbors, jnp.int32(u))
+    jax.block_until_ready(st)
+
+    k_run = jax.random.key(1234)
+    phases, st2, granted = profile_phases(params, st, neighbors, k_run,
+                                          reps=reps, warmup=1, update0=3)
+    per_update = granted / reps
+    total = sum(phases.values())
+    for name, ms in phases.items():
+        print(f"{name:12s} {ms:8.2f} ms")
+    print(f"{'sum':12s} {total:8.2f} ms   "
+          f"({per_update / total * 1e3 / 1e6:.1f} M inst/s staged)")
+
+    t_full = _timeit_chain(
+        lambda s, k, u: update_step(params, s, k, neighbors, u),
+        st, k_run, 100, reps)
+    print(f"{'full_step':12s} {t_full * 1e3:8.2f} ms   "
+          f"({per_update / t_full / 1e6:.1f} M inst/s end-to-end fused)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,108 @@
+"""Phase-by-phase execution of one update, for timing attribution.
+
+`StagedUpdate` runs EXACTLY the phase functions that
+ops/update.update_step fuses -- resource_phase, schedule_phase,
+interpret_phase (split into pack / kernel / unpack on the Pallas path,
+mirroring run_cycles), bank_phase, birth_phase -- but jits each phase
+separately and fences between them, so a Timeline can attribute wall
+time per phase.  The state trajectory is bit-identical to the fused
+update_step given the same key (tests/test_telemetry.py asserts this):
+the phases are the same traced code in the same order, only the jit
+boundaries differ.
+
+Cost model: fencing serializes phases that XLA would otherwise overlap
+and each boundary round-trips the full state through HBM, so a staged
+update is strictly slower than the fused one.  That is the telemetry
+trade: attribution over throughput.  It is opt-in (TPU_TELEMETRY) and
+the fused path is untouched when it is off.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from avida_tpu.observability import counters as counters_mod
+from avida_tpu.ops.update import (bank_phase, birth_phase, interpret_phase,
+                                  resource_phase, schedule_phase, static_cap,
+                                  use_pallas_path)
+
+
+class StagedUpdate:
+    """Per-phase jitted update runner.
+
+    collect_dispatch: thread the instruction-dispatch-mix accumulator
+    through the interpret while_loop.  Only meaningful on the
+    single-threaded heads-hardware XLA path: the Pallas kernel does not
+    collect it (observability/counters.py), fetch_opcode reads the heads
+    IP over st.tape which the SMT interpreters (hw_type 1/2) do not use
+    as their instruction pointer, and under MAX_CPU_THREADS > 1 only one
+    of the T per-slice thread sub-steps would be sampled -- all three are
+    gated off rather than emitting plausible-looking garbage.
+    """
+
+    def __init__(self, params, neighbors, collect_dispatch=True):
+        self.params = params
+        self.neighbors = neighbors
+        self.pallas = use_pallas_path(params)
+        self.cap = static_cap(params)
+        self.collect_dispatch = (collect_dispatch and not self.pallas
+                                 and params.hw_type == 0
+                                 and params.max_cpu_threads <= 1)
+        cap = self.cap
+
+        self._resource = jax.jit(
+            lambda st, key, u: resource_phase(params, st, key, u))
+        self._schedule = jax.jit(
+            lambda st, k: schedule_phase(params, st, k))
+        if self.pallas:
+            from avida_tpu.ops import pallas_cycles
+            self._pack = jax.jit(
+                lambda st, g: pallas_cycles.pack_state(params, st, g))
+            self._kernel = jax.jit(
+                lambda packed, k: pallas_cycles.run_packed(
+                    params, packed, k, cap))
+            self._unpack = jax.jit(
+                lambda st, packed: pallas_cycles.unpack_state(
+                    params, st, packed))
+        else:
+            if self.collect_dispatch:
+                self._interpret = jax.jit(
+                    lambda st, k, g, mk: interpret_phase(
+                        params, st, k, g, mk, cap,
+                        counters_mod.dispatch_init(params)))
+            else:
+                self._interpret = jax.jit(
+                    lambda st, k, g, mk: interpret_phase(
+                        params, st, k, g, mk, cap))
+        self._bank = jax.jit(
+            lambda st, budgets, e0: bank_phase(params, st, budgets, e0))
+        self._birth = jax.jit(
+            lambda st, kb, ks, u: birth_phase(params, st, kb, ks,
+                                              neighbors, u))
+        self._alive_sum = jax.jit(lambda st: st.alive.sum())
+
+    def run(self, st, key, update_no, timeline):
+        """One update, phase-fenced into `timeline`.  Returns
+        (st, executed, dispatch_counts | None, granted, alive_before)."""
+        tl = timeline
+        update_no, k_budget, k_steps, k_birth = tl.run(
+            "schedule",
+            lambda: (jnp.int32(update_no),) + tuple(jax.random.split(key, 3)))
+        alive_before = tl.run("counters", self._alive_sum, st)
+        st = tl.run("resources", self._resource, st, key, update_no)
+        budgets, granted, max_k = tl.run("schedule", self._schedule,
+                                         st, k_budget)
+        executed0 = st.insts_executed
+        if self.pallas:
+            packed = tl.run("pack", self._pack, st, granted)
+            packed = tl.run("kernel", self._kernel, packed, k_steps)
+            st = tl.run("unpack", self._unpack, st, packed)
+            dispatch = None
+        else:
+            st, dispatch = tl.run("while_loop", self._interpret,
+                                  st, k_steps, granted, max_k)
+        st, executed = tl.run("bank", self._bank, st, budgets, executed0)
+        st = tl.run("birth_flush", self._birth, st, k_birth, k_steps,
+                    update_no)
+        return st, executed, dispatch, granted, alive_before

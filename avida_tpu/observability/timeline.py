@@ -1,0 +1,125 @@
+"""Phase wall-clock timers with device fencing.
+
+A `Timeline` brackets named phases of an update (pack, kernel, birth
+flush, events, host I/O, ...) and accumulates wall time per phase name.
+Device phases MUST be fenced -- JAX dispatch is asynchronous, so an
+unfenced bracket measures enqueue time, not execution time.  Use
+`Timeline.run(name, fn, *args)` for device work (it calls the function
+and `jax.block_until_ready`s its output inside the bracket) and the
+`Timeline.phase(name)` context manager for host-side work.
+
+Measurement caveats inherited from the retired scripts/profile_update.py
+(learned the hard way; BASELINE.md):
+
+ - repeated dispatches with IDENTICAL inputs can be elided/cached by the
+   runtime and report absurdly low times.  The staged harness
+   (observability/harness.py) is immune by construction: every rep feeds
+   the previous rep's evolved state, so no two calls see equal inputs;
+ - per-call block_until_ready over a remote-device tunnel measures
+   network round-trips (100-300 ms, noisy), not device time.  Phase
+   timings are only trustworthy on a locally attached backend; treat
+   end-to-end `python bench.py` deltas as ground truth either way.
+
+Optional `jax.profiler` trace capture: `start_trace(dir)` / `stop_trace()`
+wrap the profiler so a telemetry run can drop an XProf trace of its first
+few updates next to the phase numbers.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+import jax
+
+
+class Timeline:
+    """Accumulates {phase name -> seconds} between `drain()` calls."""
+
+    def __init__(self):
+        self._acc: dict[str, float] = {}
+        self._order: list[str] = []
+        self._window_start: float | None = None
+        self._tracing = False
+
+    # ---- phase brackets ----
+
+    def add(self, name: str, seconds: float):
+        if name not in self._acc:
+            self._acc[name] = 0.0
+            self._order.append(name)
+        self._acc[name] += seconds
+
+    def _open(self) -> float:
+        t0 = time.perf_counter()
+        if self._window_start is None:
+            self._window_start = t0      # first bracket since last drain
+        return t0
+
+    def run(self, name: str, fn, *args):
+        """Time `fn(*args)` as phase `name`, fencing the output.  Returns
+        the (ready) output."""
+        t0 = self._open()
+        out = fn(*args)
+        out = jax.block_until_ready(out)
+        self.add(name, time.perf_counter() - t0)
+        return out
+
+    @contextmanager
+    def phase(self, name: str):
+        """Host-side phase bracket (no fence -- use for file I/O, event
+        dispatch, python-side bookkeeping)."""
+        t0 = self._open()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0)
+
+    # ---- readout ----
+
+    def window_seconds(self) -> float:
+        """Wall time from the first bracket opened since the last drain
+        to now (the span the accumulated phases subdivide)."""
+        if self._window_start is None:
+            return 0.0
+        return time.perf_counter() - self._window_start
+
+    def drain(self) -> dict[str, float]:
+        """Return accumulated {name: milliseconds} in first-seen order and
+        reset the accumulator."""
+        out = {n: self._acc[n] * 1e3 for n in self._order}
+        self._acc = {}
+        self._order = []
+        self._window_start = None
+        return out
+
+    def peek_ms(self) -> dict[str, float]:
+        return {n: self._acc[n] * 1e3 for n in self._order}
+
+    # ---- jax.profiler trace capture ----
+
+    def start_trace(self, profile_dir: str) -> bool:
+        """Begin an XProf trace into `profile_dir` (idempotent; returns
+        whether a trace is now running)."""
+        if self._tracing:
+            return True
+        try:
+            jax.profiler.start_trace(profile_dir)
+            self._tracing = True
+        except Exception as e:
+            # profiler unavailable on this backend, unwritable dir, or a
+            # trace already active -- the run continues without a trace,
+            # but say why instead of silently dropping the capture
+            import sys
+            print(f"[avida-tpu] warning: jax.profiler trace capture into "
+                  f"{profile_dir!r} failed ({e}); continuing without a "
+                  f"trace", file=sys.stderr)
+            self._tracing = False
+        return self._tracing
+
+    def stop_trace(self):
+        if self._tracing:
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                self._tracing = False

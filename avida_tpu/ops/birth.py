@@ -419,6 +419,7 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
     child_merit = st.merit
     sexual = has_divide_sex(params)
     leftover = jnp.zeros(n, bool)
+    face_drop = None   # BIRTH_METHOD 7 on hw 3: invalid-facing drops
     dual = jnp.zeros(n, bool)
     dual_mem = dual_len = dual_merit = None
     store = None
@@ -536,9 +537,15 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
             ftgt, fvalid = _facing_step(params, rows, st.facing,
                                         jnp.ones_like(rows))
             target = jnp.where(fvalid, ftgt, rows)
-            # off-grid facing on bounded geometries fails the birth (the
-            # parent retries), matching how move/attack treat invalid
-            # facing -- never a silent self-replacement
+            # Off-grid facing on a bounded geometry can never produce a
+            # birth (the reference cannot reach this state: its facing
+            # indexes the connection list, which only holds in-grid
+            # cells).  The offspring is DROPPED and the parent resumes --
+            # same policy as the mating-type store drops.  Retrying
+            # instead would livelock the parent permanently: a
+            # divide-pending organism is excluded from exec_mask, so it
+            # could never execute rotate-x to fix its facing.
+            face_drop = pending & ~fvalid
             pending = pending & fvalid
         else:
             target = jnp.where(neighbors[:, 0] < 0, rows, neighbors[:, 0])
@@ -908,9 +915,15 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
                         bc_valid=bc_valid, bc_type=bc_type)
     # winners' (and dead parents') pending flags clear; a leftover sexual
     # offspring moved into the birth-chamber store, so its parent resumes
-    # too; living losers retry next update; a parent cell overwritten by a
-    # newborn is already governed by the newborn state
-    cleared = jnp.where(won | leftover | ~st.alive, False, st.divide_pending)
+    # too; a BIRTH_METHOD 7 parent whose facing points off-grid drops its
+    # offspring and resumes (the birth can never succeed -- retrying would
+    # livelock it out of exec_mask forever); living losers retry next
+    # update; a parent cell overwritten by a newborn is already governed
+    # by the newborn state
+    resumes = won | leftover | ~st.alive
+    if face_drop is not None:
+        resumes = resumes | face_drop
+    cleared = jnp.where(resumes, False, st.divide_pending)
     st = st.replace(divide_pending=cleared,
                     off_sex=st.off_sex & cleared)
     if params.energy_enabled:

@@ -112,6 +112,22 @@ def barrel_shift_left(plane, shift, L):
     return out
 
 
+def fetch_opcode(params, st):
+    """Opcode under every organism's IP, as micro_step will fetch it (same
+    fullAdjust + masked single-site reduction -- no gather).  One [N, L]
+    pass; consumers are the telemetry dispatch-mix counter
+    (observability/counters.py, threaded through ops/update.interpret_phase)
+    and the per-cycle tracer (analyze/trace.py)."""
+    n, L = st.tape.shape
+    cols = jnp.arange(L)
+    mlen = jnp.maximum(st.mem_len, 1)
+    ip = _adjust(st.heads[:, HEAD_IP], mlen)
+    m_ip = cols[None, :] == ip[:, None]
+    op = jnp.sum(jnp.where(m_ip, (st.tape & OP_MASK).astype(jnp.int32), 0),
+                 axis=1)
+    return jnp.clip(op, 0, params.num_insts - 1)
+
+
 def micro_step(params, st, key, exec_mask, return_signals=False,
                charge_time=True):
     """Execute one CPU cycle for every organism where exec_mask is set.
@@ -1108,26 +1124,40 @@ def micro_step_threads(params, st, key, exec_mask):
     after mid-stack kills can differ; after any kill, scheduling resumes
     from slot 0."""
     reps = params.max_cpu_threads if params.thread_slicing_method == 1 else 1
+    # The per-lane live-thread count is fixed ONCE at the top of the slice
+    # (the reference fixes num_inst_exec = GetNumThreads() before its loop,
+    # cHardwareCPU.cc:936): a thread forked by an earlier sub-step of this
+    # slice must neither raise the sub-step gate nor be scheduled until the
+    # next slice, so the slice-start t_alive snapshot also bounds which
+    # slots the round-robin advance may select (intersected with the live
+    # set so a thread killed mid-slice stops being scheduled immediately).
+    n_thr0 = 1 + st.t_alive.sum(axis=1)
+    sched_alive0 = st.t_alive
     for r in range(reps):
         st = _thread_substep(params, st, jax.random.fold_in(key, r),
-                             exec_mask, charge_time=(r == 0), rep=r)
+                             exec_mask, charge_time=(r == 0), rep=r,
+                             n_live=n_thr0, sched_alive=sched_alive0)
     return st
 
 
-def _thread_substep(params, st, key, exec_mask, charge_time, rep):
+def _thread_substep(params, st, key, exec_mask, charge_time, rep,
+                    n_live=None, sched_alive=None):
     T = params.max_cpu_threads
     Te = T - 1
     cols = jnp.arange(Te)
-    n_thr = 1 + st.t_alive.sum(axis=1)
+    if n_live is None:
+        n_live = 1 + st.t_alive.sum(axis=1)
+    if sched_alive is None:
+        sched_alive = st.t_alive
     # method 1 executes each live thread once per slice: sub-step r only
-    # runs lanes that still have an r+1-th thread
-    sub_mask = exec_mask & (n_thr > rep) if rep else exec_mask
+    # runs lanes that still had an r+1-th thread at slice start
+    sub_mask = exec_mask & (n_live > rep) if rep else exec_mask
 
     def slot_alive(cand):
         if Te == 0:
             return cand == 0
-        extra = ((cols[None, :] == (cand - 1)[:, None]) & st.t_alive).any(
-            axis=1)
+        extra = ((cols[None, :] == (cand - 1)[:, None]) & st.t_alive
+                 & sched_alive).any(axis=1)
         return (cand == 0) | extra
 
     # advance cur_thread to the next live slot (m_cur_thread++ wrap,

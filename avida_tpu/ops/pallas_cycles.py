@@ -153,7 +153,7 @@ CHUNK = 64           # sublane rows per register-resident traversal chunk
 
 # Debug/profiling knob: comma-separated feature names whose kernel code is
 # compiled OUT (semantics break!) to measure their cost by ablation, e.g.
-# TPU_KERNEL_ABLATE=search,extract python scripts/profile_update.py
+# TPU_KERNEL_ABLATE=search,extract python -m avida_tpu.observability.harness
 import os as _os
 _ABLATE = frozenset(
     f for f in _os.environ.get("TPU_KERNEL_ABLATE", "").split(",") if f)
@@ -1271,6 +1271,15 @@ def _dims(params, n, L0):
     # the kernel must cover the whole tape
     L = ((L0 + CHUNK - 1) // CHUNK) * CHUNK
     return B, n_pad, L
+
+
+def block_dims(params, n):
+    """(block_lanes, padded_n) of the kernel launch for an n-cell world --
+    the granularity at which each block's while_loop runs to its own max
+    granted budget.  The telemetry budget-tail counters
+    (observability/counters.py) bin `granted` at this width."""
+    B, n_pad, _ = _dims(params, n, params.max_memory)
+    return B, n_pad
 
 
 def _pack_words(tape, L):

@@ -12,6 +12,15 @@ num_orgs organism-instruction steps.  Here the whole update runs on device:
 
 Host code only orchestrates updates and reads back stats at report
 boundaries -- no per-step host/device synchronization.
+
+The update is decomposed into PHASE functions (resource_phase,
+schedule_phase, interpret_phase, bank_phase, birth_phase) so the same
+code runs two ways: `update_step` fuses all phases into one device
+program (the production path), while the telemetry harness
+(avida_tpu/observability/) jits each phase separately and fences between
+them to attribute wall time.  The phase split is pure code motion: with
+telemetry disabled `update_step` traces to the identical jaxpr
+(tests/test_telemetry.py guards this).
 """
 
 from __future__ import annotations
@@ -59,19 +68,26 @@ def use_pallas_path(params) -> bool:
             and jax.devices()[0].platform == "tpu")
 
 
-@partial(jax.jit, static_argnums=0)
-def update_step(params, st, key, neighbors, update_no):
-    """Run one update.  Returns (new_state, executed_this_update)."""
-    k_budget, k_steps, k_birth = jax.random.split(key, 3)
+def static_cap(params) -> int:
+    """The static per-update step cap (2^31-1 when uncapped)."""
+    cap = int(params.max_steps_per_update)
+    return cap if cap > 0 else 2**31 - 1
 
-    # resource dynamics integrate once per update (ops/resources.py)
+
+def resource_phase(params, st, key, update_no):
+    """Resource dynamics integrate once per update (ops/resources.py)."""
     st = st.replace(resources=res_ops.step_global(params, st.resources),
                     res_grid=res_ops.step_spatial(params, st.res_grid),
                     deme_resources=res_ops.step_deme(params,
                                                      st.deme_resources))
-    st = res_ops.step_gradient(params, st, jax.random.fold_in(key, 0x6AD),
-                               update_no)
+    return res_ops.step_gradient(params, st, jax.random.fold_in(key, 0x6AD),
+                                 update_no)
 
+
+def schedule_phase(params, st, k_budget):
+    """Sample merit-proportional budgets and apply the burst cap.
+    Returns (budgets, granted, max_k); the cap itself is static
+    (static_cap)."""
     budgets = sched_ops.compute_budgets(params, st, k_budget)
     # Budget carry-over (TPU lockstep semantic, SURVEY §7 step 3).  By
     # DEFAULT (TPU_MAX_STEPS_PER_UPDATE = 0) every organism executes its
@@ -92,31 +108,44 @@ def update_step(params, st, key, neighbors, update_no):
         max_k = jnp.minimum(budgets.max(), cap)
         granted = jnp.minimum(budgets, max_k)
     else:                  # uncapped: reference-faithful bursts
-        cap = 2**31 - 1
         max_k = budgets.max()
         granted = budgets
+    return budgets, granted, max_k
 
-    executed0 = st.insts_executed
 
+def interpret_phase(params, st, k_steps, granted, max_k, cap, counters=None):
+    """Run the update's lockstep cycles (Pallas kernel or XLA while_loop)
+    plus the end-of-update offspring materialization.
+
+    `counters` threads an optional telemetry block through the loop:
+    int32[num_insts] dispatch-mix accumulator (opcode under each scheduled
+    lane's IP, once per cycle -- sums to this update's executed count on
+    the default single-thread path).  With counters=None (the production
+    path) the trace is identical to the pre-telemetry code.  The Pallas
+    kernel does not collect the dispatch mix (an in-kernel [num_insts]
+    scatter per cycle is not cheap); it returns the accumulator unchanged
+    and the harness reports budget/phase counters only."""
     if use_pallas_path(params):
         # whole-update cycle loop in one VMEM-resident kernel launch
         # (ops/pallas_cycles.py); granted == min(budgets, cap) makes the
         # per-block while_loop inside the kernel equivalent to the XLA
         # while_loop below
         st = pallas_cycles.run_cycles(params, st, k_steps, granted, int(cap))
+        return st, counters
+
+    if params.hw_type in (1, 2):
+        from avida_tpu.ops.interpreter_smt import micro_step_smt
+        step_fn = micro_step_smt
+    elif params.max_cpu_threads > 1:
+        from avida_tpu.ops.interpreter import micro_step_threads
+        step_fn = micro_step_threads
     else:
+        step_fn = micro_step
+
+    if counters is None:
         def cond(carry):
             s, _ = carry
             return s < max_k
-
-        if params.hw_type in (1, 2):
-            from avida_tpu.ops.interpreter_smt import micro_step_smt
-            step_fn = micro_step_smt
-        elif params.max_cpu_threads > 1:
-            from avida_tpu.ops.interpreter import micro_step_threads
-            step_fn = micro_step_threads
-        else:
-            step_fn = micro_step
 
         def body(carry):
             s, st = carry
@@ -130,32 +159,59 @@ def update_step(params, st, key, neighbors, update_no):
 
         pending_before = st.divide_pending
         _, st = jax.lax.while_loop(cond, body, (jnp.int32(0), st))
-        if params.hw_type == 0:
-            # materialize this update's new offspring into off_tape (the
-            # Pallas kernel does this at the divide cycle; here one masked
-            # barrel roll per update keeps the two paths bit-identical) --
-            # a stalled parent's tape is frozen, so end-of-update extraction
-            # sees exactly the divide-time bytes
-            from avida_tpu.ops.interpreter import barrel_shift_left, tape_ops
-            new_div = st.divide_pending & ~pending_before
-            n_, L_ = st.tape.shape
-            ext = barrel_shift_left(
-                tape_ops(st.tape).astype(jnp.uint8), st.off_start, L_)
-            ext = jnp.where(jnp.arange(L_)[None, :] < st.off_len[:, None],
-                            ext, jnp.uint8(0))
-            st = st.replace(off_tape=jnp.where(new_div[:, None], ext,
-                                               st.off_tape))
+    else:
+        from avida_tpu.ops.interpreter import fetch_opcode
+
+        def cond_c(carry):
+            s, _, _ = carry
+            return s < max_k
+
+        def body_c(carry):
+            s, st, cnt = carry
+            exec_mask = st.alive & (s < granted) & ~st.divide_pending
+            op = fetch_opcode(params, st)
+            cnt = cnt.at[op].add(exec_mask.astype(jnp.int32))
+            st = step_fn(params, st, jax.random.fold_in(k_steps, s),
+                         exec_mask)
+            return s + 1, st, cnt
+
+        pending_before = st.divide_pending
+        _, st, counters = jax.lax.while_loop(
+            cond_c, body_c, (jnp.int32(0), st, counters))
+    if params.hw_type == 0:
+        # materialize this update's new offspring into off_tape (the
+        # Pallas kernel does this at the divide cycle; here one masked
+        # barrel roll per update keeps the two paths bit-identical) --
+        # a stalled parent's tape is frozen, so end-of-update extraction
+        # sees exactly the divide-time bytes
+        from avida_tpu.ops.interpreter import barrel_shift_left, tape_ops
+        new_div = st.divide_pending & ~pending_before
+        n_, L_ = st.tape.shape
+        ext = barrel_shift_left(
+            tape_ops(st.tape).astype(jnp.uint8), st.off_start, L_)
+        ext = jnp.where(jnp.arange(L_)[None, :] < st.off_len[:, None],
+                        ext, jnp.uint8(0))
+        st = st.replace(off_tape=jnp.where(new_div[:, None], ext,
+                                           st.off_tape))
+    return st, counters
+
+
+def bank_phase(params, st, budgets, executed0):
+    """Bank unexecuted budget and snapshot the per-update execution count.
+    Returns (st, executed): the snapshot is taken BEFORE the birth flush
+    because flush_births zeroes insts_executed on every cell receiving a
+    newborn, so a post-flush difference would subtract the prior
+    occupant's lifetime count (undercounting, possibly negative)."""
     # bank whatever each organism earned but did not execute (cap or stall)
     executed_this = st.insts_executed - executed0
     carry = jnp.clip(budgets - executed_this, 0, 100 * params.ave_time_slice)
     st = st.replace(budget_carry=jnp.where(st.alive, carry, 0))
-
-    # snapshot the per-update execution count BEFORE the birth flush:
-    # flush_births zeroes insts_executed on every cell receiving a newborn,
-    # so a post-flush difference would subtract the prior occupant's
-    # lifetime count (undercounting, possibly negative)
     executed = executed_this.sum()
+    return st, executed
 
+
+def birth_phase(params, st, k_birth, k_steps, neighbors, update_no):
+    """Flush pending births, age demes, run the point-mutation sweep."""
     st = birth_ops.flush_births(params, st, k_birth, neighbors, update_no,
                                 use_off_tape=True)
 
@@ -164,6 +220,27 @@ def update_step(params, st, key, neighbors, update_no):
 
     if params.point_mut_prob > 0:
         st = _point_mutation_sweep(params, st, jax.random.fold_in(k_steps, 0x7FFFFFFF))
+    return st
+
+
+@partial(jax.jit, static_argnums=0)
+def update_step(params, st, key, neighbors, update_no):
+    """Run one update.  Returns (new_state, executed_this_update)."""
+    k_budget, k_steps, k_birth = jax.random.split(key, 3)
+
+    # resource dynamics integrate once per update (ops/resources.py)
+    st = resource_phase(params, st, key, update_no)
+
+    budgets, granted, max_k = schedule_phase(params, st, k_budget)
+    cap = static_cap(params)
+
+    executed0 = st.insts_executed
+
+    st, _ = interpret_phase(params, st, k_steps, granted, max_k, cap)
+
+    st, executed = bank_phase(params, st, budgets, executed0)
+
+    st = birth_phase(params, st, k_birth, k_steps, neighbors, update_no)
 
     return st, executed
 

@@ -212,6 +212,19 @@ class World:
         self.data = DataManager(self)
         register_standard_providers(self.data)
 
+        # opt-in runtime telemetry (avida_tpu/observability/): phase-fenced
+        # staged updates, device counters and a telemetry.jsonl run log.
+        # With TPU_TELEMETRY=0 (default) nothing is built, written or
+        # traced -- the update program is byte-identical to a build
+        # without the subsystem (tests/test_telemetry.py).
+        self.telemetry = None
+        if int(cfg.get("TPU_TELEMETRY", 0)):
+            from avida_tpu.observability import TelemetryRecorder
+            pdir = str(cfg.get("TPU_PROFILE_DIR", "-") or "-")
+            self.telemetry = TelemetryRecorder(
+                self, profile_dir=(pdir if pdir not in ("-", "") else None),
+                profile_updates=int(cfg.get("TPU_PROFILE_UPDATES", 3)))
+
         # offspring reversion/sterilization via the batched Test CPU
         # (cHardwareBase::Divide_TestFitnessMeasures cc:866); fitness
         # lookups memoize per genotype (systematics/test_metrics.py)
@@ -670,6 +683,17 @@ class World:
         self.key, k = jax.random.split(self.key)
         orgs = spop.load_population(path, self.params, k)
         self.state = spop.restore_population(self.params, orgs, k)
+        # per-cell task-execution lifetime totals are not part of the
+        # reference .spop format; a sidecar written by SavePopulation
+        # restores them so tasks_exe.dat stays continuous across a
+        # save/load (absent sidecar -> totals restart at zero)
+        side = path + ".tasks.npy"
+        if os.path.exists(side):
+            totals = np.load(side)
+            if totals.shape == tuple(self.state.task_exe_total.shape):
+                self.state = self.state.replace(
+                    task_exe_total=jnp.asarray(totals, jnp.int32))
+        self._reset_task_exe_baseline()
         if self.systematics is not None:
             from avida_tpu.systematics import GenotypeArbiter
             self.systematics = GenotypeArbiter(self.params.num_cells)
@@ -677,12 +701,29 @@ class World:
                 self.systematics.classify_seed(o["cell"], o["genome"],
                                                update=self.update)
 
+    def _reset_task_exe_baseline(self):
+        """Seed/reset the tasks_exe.dat diff baseline from the CURRENT
+        state.  Must run whenever state is (re)loaded wholesale
+        (LoadPopulation): the baseline is a host-side snapshot of the
+        device lifetime totals, so after a restore the stale value would
+        make the first tasks_exe.dat row report lifetime totals as one
+        update's work -- or a negative delta if the restored totals are
+        smaller."""
+        self._summary_cache_update = None      # cached summary is stale too
+        self._task_exe_prev = np.asarray(
+            jnp.sum(self.state.task_exe_total, axis=0), np.int64)
+        if self.telemetry is not None:
+            self.telemetry.seed_task_totals(self._task_exe_prev)
+
     def _action_SavePopulation(self, args):
         from avida_tpu.utils import spop
         os.makedirs(self.data_dir, exist_ok=True)
-        spop.save_population(
-            os.path.join(self.data_dir, f"detail-{self.update}.spop"),
-            self.params, self.state, self.update)
+        path = os.path.join(self.data_dir, f"detail-{self.update}.spop")
+        spop.save_population(path, self.params, self.state, self.update)
+        # sidecar: per-cell task-execution lifetime totals (not
+        # representable in the reference .spop columns) so a LoadPopulation
+        # keeps tasks_exe.dat deltas continuous
+        np.save(path + ".tasks.npy", np.asarray(self.state.task_exe_total))
 
     def _dispatch(self, ev):
         handler = getattr(self, f"_action_{ev.action}", None)
@@ -734,7 +775,20 @@ class World:
         """Run ONE update (does not advance self.update; callers do).
         Device-side bookkeeping lives in ops/update.update_scan -- this is
         the chunk-of-1 case plus the per-update reversion test and
-        systematics feed."""
+        systematics feed.  Under telemetry the update runs phase-fenced
+        through the recorder (bit-identical trajectory; observability/)
+        and an update record lands in telemetry.jsonl."""
+        tel = self.telemetry
+        if tel is not None:
+            executed = tel.update(self)
+            if self._revert_on:
+                with tel.timeline.phase("host_revert"):
+                    self._apply_reversion()
+            if self.systematics is not None:
+                with tel.timeline.phase("host_systematics"):
+                    self._feed_systematics()
+            tel.emit(self)
+            return executed
         executed = self._scan_updates(1)
         if self._revert_on:
             self._apply_reversion()
@@ -947,15 +1001,21 @@ class World:
                 self.inject()
         start_insts = self._cum_insts
         # event-free stretches run as one device program; anything needing
-        # per-update host work (systematics, generation triggers) forces
-        # single stepping
-        can_chunk = (not self._revert_on and
+        # per-update host work (systematics, generation triggers,
+        # telemetry phase fencing) forces single stepping
+        can_chunk = (not self._revert_on and self.telemetry is None and
                      not any(ev.trigger in ("generation", "births")
                              for ev in self.events))
         while not self._exit:
             if max_updates is not None and self.update >= max_updates:
                 break
-            self.process_events()
+            if self.telemetry is not None:
+                # event dispatch covers the .dat writes and their device
+                # readbacks -- the "host I/O" share of the next record
+                with self.telemetry.timeline.phase("events_io"):
+                    self.process_events()
+            else:
+                self.process_events()
             if self._exit:
                 break
             stretch = 1
@@ -984,6 +1044,8 @@ class World:
         for f in self._files.values():
             f.close()
         self._files = {}
+        if self.telemetry is not None:
+            self.telemetry.close()
         return self._flush_exec() - start_insts
 
     @property

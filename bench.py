@@ -6,7 +6,12 @@ with the default ancestor so the measurement starts at target population).
 Baseline = 1e8 org-inst/sec (BASELINE.json north star; the reference itself
 publishes no absolute numbers).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"phases"}.  The headline fields are measured exactly as before (fused
+device-resident scan, host sync only at the end); "phases" is an
+informational per-phase wall-time breakdown (ms/update) from the staged
+telemetry harness (avida_tpu/observability/harness.py), measured AFTER
+the headline timing on the same world.  BENCH_PHASES=0 skips it.
 """
 
 from __future__ import annotations
@@ -133,12 +138,27 @@ def main():
     # device-resident; host sync only at the end -- anything else measures
     # dispatch round-trips, not the engine.
     ips = measure(world, warmup, timed)
-    print(json.dumps({
+    line = {
         "metric": "org_instructions_per_sec",
         "value": round(ips, 1),
         "unit": "inst/s",
         "vs_baseline": round(ips / BASELINE_INST_PER_SEC, 4),
-    }))
+    }
+    if os.environ.get("BENCH_PHASES", "1") != "0":
+        line["phases"] = phase_breakdown(world)
+    print(json.dumps(line))
+
+
+def phase_breakdown(world, reps=2, seed=100):
+    """Per-phase ms/update via the staged harness (runs after -- and does
+    not perturb -- the headline measurement).  Fenced phases serialize
+    work the fused scan overlaps, so these attribute the update's time;
+    they do not sum to the headline's per-update cost."""
+    from avida_tpu.observability.harness import profile_phases
+    params, st, neighbors, key = build(world, world, 256, seed=seed)
+    phases, _, _ = profile_phases(params, st, neighbors, key,
+                                  reps=reps, warmup=1)
+    return {name: round(ms, 3) for name, ms in phases.items()}
 
 
 if __name__ == "__main__":

@@ -180,3 +180,50 @@ def test_birth_method_7_uses_real_facing_on_experimental_hw():
                                  jnp.int32(1), use_off_tape=True)
     born = np.nonzero(np.asarray(st2.alive) & ~np.asarray(st.alive))[0]
     assert list(born) == [13], born
+
+
+def test_birth_method_7_invalid_facing_drops_offspring():
+    """BIRTH_METHOD 7 on experimental hardware with BOUNDED geometry: an
+    edge parent facing off-grid can never place its offspring (the
+    reference cannot reach this state -- its facing indexes the in-grid
+    connection list).  The offspring must be dropped and divide_pending
+    cleared so the parent resumes executing; the pre-fix retry path left
+    divide_pending set forever, excluding the parent from exec_mask --
+    a permanent livelock (round-5 advisor finding)."""
+    from avida_tpu.config.instset import experimental_instset
+
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 5
+    cfg.WORLD_Y = 5
+    cfg.BIRTH_METHOD = 7
+    cfg.WORLD_GEOMETRY = 1         # bounded grid: edges exist
+    p = make_world_params(cfg, experimental_instset(),
+                          default_logic9_environment())
+    assert p.geometry == 1
+    n, L = p.num_cells, p.max_memory
+    st = zeros_population(n, L, p.num_reactions,
+                          num_registers=p.num_registers)
+    st = st.replace(
+        alive=st.alive.at[0].set(True),        # NW corner
+        merit=jnp.ones(n, jnp.float32),
+        divide_pending=st.divide_pending.at[0].set(True),
+        off_len=jnp.zeros(n, jnp.int32).at[0].set(12),
+        off_tape=jnp.zeros((n, L), jnp.uint8).at[0, :12].set(3),
+        mem_len=st.mem_len.at[0].set(12),
+        genome_len=st.genome_len.at[0].set(12),
+        facing=st.facing.at[0].set(0))         # facing 0 = north: off-grid
+    neighbors = jnp.asarray(birth_ops.neighbor_table(5, 5, 1))
+    st2 = birth_ops.flush_births(p, st, jax.random.key(1), neighbors,
+                                 jnp.int32(1), use_off_tape=True)
+    # no birth anywhere, offspring dropped, parent resumed
+    assert np.asarray(st2.alive).sum() == 1
+    assert bool(st2.alive[0])
+    assert not bool(st2.divide_pending[0]), \
+        "invalid-facing parent stayed divide-pending (livelock)"
+    # an in-grid facing on the same bounded world still births normally
+    st3 = st.replace(facing=st.facing.at[0].set(2))    # east -> cell 1
+    st4 = birth_ops.flush_births(p, st3, jax.random.key(1), neighbors,
+                                 jnp.int32(1), use_off_tape=True)
+    born = np.nonzero(np.asarray(st4.alive) & ~np.asarray(st3.alive))[0]
+    assert list(born) == [1], born
+    assert not bool(st4.divide_pending[0])

@@ -90,3 +90,55 @@ def test_births_trigger_fires(tmp_path):
     w.run(max_updates=30)
     assert fired, "BIRTHS trigger never fired"
     assert fired[0] >= 5
+
+
+def test_tasks_exe_baseline_reset_on_load(tmp_path):
+    """tasks_exe.dat after a LoadPopulation must report a per-update
+    DELTA, not lifetime totals or a negative diff: the host-side
+    _task_exe_prev baseline is reseeded from the restored state, and the
+    per-cell lifetime totals travel in a .spop sidecar (round-5 advisor
+    finding)."""
+    w = _world(tmp_path, seed=21)
+    w.events = []
+    w.inject()
+    w.run(max_updates=5)
+    # give the population distinctive lifetime task-execution totals
+    fake = jnp.ones_like(w.state.task_exe_total) * 7
+    w.state = w.state.replace(task_exe_total=fake)
+    w._summary_cache_update = None
+    w.update = 5
+    w._action_SavePopulation([])
+    spop_path = os.path.join(str(tmp_path), "detail-5.spop")
+    assert os.path.exists(spop_path + ".tasks.npy")
+
+    # same-process reload after further evolution: the baseline must not
+    # go stale (pre-fix: first row after reload = restored - stale
+    # baseline, possibly negative)
+    w.run(max_updates=9)
+    w._action_PrintTasksExeData([])            # refreshes _task_exe_prev
+    w._action_LoadPopulation([spop_path])
+    totals = np.asarray(w.state.task_exe_total)
+    np.testing.assert_array_equal(totals, np.asarray(fake))   # sidecar round-trip
+    w._summary_cache_update = None
+    w._action_PrintTasksExeData([])
+    rows = [l.split() for l in
+            open(os.path.join(str(tmp_path), "tasks_exe.dat"))
+            if l.strip() and not l.startswith("#")]
+    last = [int(x) for x in rows[-1][1:]]
+    assert all(v == 0 for v in last), \
+        f"first tasks_exe row after restore must be a zero delta, got {last}"
+
+    # fresh-process shape: a brand-new World loading the checkpoint also
+    # reports deltas, not the 7-per-cell lifetime totals
+    w2 = _world(tmp_path / "w2", seed=22)
+    w2.events = []
+    w2.update = 5
+    w2._action_LoadPopulation([spop_path])
+    np.testing.assert_array_equal(np.asarray(w2.state.task_exe_total),
+                                  np.asarray(fake))
+    w2._action_PrintTasksExeData([])
+    rows2 = [l.split() for l in
+             open(os.path.join(str(tmp_path / "w2"), "tasks_exe.dat"))
+             if l.strip() and not l.startswith("#")]
+    last2 = [int(x) for x in rows2[-1][1:]]
+    assert all(v == 0 for v in last2), last2

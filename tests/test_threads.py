@@ -147,6 +147,34 @@ def test_slicing_method_1_runs_all_threads_per_cycle():
     assert int(st.time_used[0]) == 2       # one charge per cycle
 
 
+def test_slicing_method_1_fork_waits_for_next_slice():
+    """Fork timing under THREAD_SLICING_METHOD 1: the per-lane live-thread
+    count is fixed BEFORE the sub-step loop (num_inst_exec at
+    cHardwareCPU.cc:936), so a thread forked in an earlier sub-step of the
+    slice neither raises the sub-step gate nor gets scheduled in the same
+    slice -- it first runs in the NEXT slice."""
+    p = _params(max_threads=2, slicing=1)
+    s = _thread_instset()
+    fork, inc, dec = s.opcode("fork-th"), s.opcode("inc"), s.opcode("dec")
+    nopA = s.opcode("nop-A")
+    # 0:fork, 1:inc (child starts here), 2:dec (parent resumes here)
+    st = _one_org(p, [fork, inc, dec, nopA, nopA, nopA, nopA, nopA])
+    st = _run(p, st, 1)
+    # slice 1: only the fork executed.  The child exists but must NOT
+    # have run its inc yet (the pre-fix code both gated sub-step 1 open
+    # via the recomputed thread count and scheduled the newborn in it).
+    assert bool(st.t_alive[0, 0])
+    assert int(st.t_heads[0, 0, 0]) == 1           # child parked at fork+1
+    assert int(st.t_regs[0, 0, 1]) == 0            # child has not run inc
+    assert int(st.regs[0, 0]) == 0                 # parent has not run dec
+    assert int(st.time_used[0]) == 1               # one charge per slice
+    st = _run(p, st, 1, seed=3)
+    # slice 2: both threads run -- child inc (?BX?), parent dec + nop-A
+    assert int(st.t_regs[0, 0, 1]) == 1
+    assert int(st.regs[0, 0]) == -1
+    assert int(st.time_used[0]) == 2
+
+
 def test_divide_resets_threads():
     """A successful divide collapses the parent to a single thread."""
     cfg = AvidaConfig()
