@@ -209,6 +209,29 @@ class AvidaConfig:
     # (use on TPU when the environment qualifies), 1 = force on (any
     # backend; interpret mode off-TPU), 2 = off (always XLA micro-steps).
     TPU_USE_PALLAS: int = 0
+    # Budget-aware lane packing for the Pallas kernel (ops/pallas_cycles.py):
+    # organisms are permuted into kernel lanes sorted by granted budget so
+    # each block's while_loop runs close to its MEAN budget instead of its
+    # max (the ~1.55x budget-tail waste; observability/counters.budget_tail).
+    # Value = refresh period K in updates: the persistent permutation is
+    # recomputed every K updates (K=1: re-sorted by this update's granted
+    # vector -- the exact tail fix; K>1: sorted by merit, amortizing the
+    # sort, with binomial budget noise left in the tail).  0 = off
+    # (identity lanes).  The permutation rides pack/unpack as major-axis
+    # row gathers -- NOT the lane-axis packed-state permute that was
+    # reverted in rounds 4/5.
+    TPU_LANE_PERM: int = 1
+    # With TPU_LANE_PERM > 1: also refresh the permutation early whenever
+    # the measured per-block budget utilization (granted.sum / lockstep
+    # ceiling) of the CURRENT permutation falls below this threshold.
+    TPU_LANE_PERM_MIN_UTIL: float = 0.5
+    # Kernel launch sharding: the Pallas cycle kernel is shard_map'd over
+    # the `cells` mesh axis (parallel/mesh.py), one independent launch per
+    # shard (blocks never communicate, so the split is free).  0 = auto
+    # (one shard per visible device -- single-device runs are unsharded),
+    # N > 0 = exactly N shards (must not exceed the device count; tests
+    # use 1 to force the unsharded reference trajectory).
+    TPU_KERNEL_SHARDS: int = 0
     # Runtime telemetry (avida_tpu/observability/): 1 = phase-fenced
     # staged updates, device counters and a telemetry.jsonl run log in
     # DATA_DIR.  Opt-in: 0 (default) compiles to the identical update

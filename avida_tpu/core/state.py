@@ -84,6 +84,13 @@ class WorldParams(struct.PyTreeNode):
     inherit_merit: bool = struct.field(pytree_node=False, default=True)
     max_steps_per_update: int = struct.field(pytree_node=False, default=0)
     use_pallas: int = struct.field(pytree_node=False, default=0)
+    # budget-aware kernel lane packing: refresh period K of the persistent
+    # lane permutation (0 = off; see TPU_LANE_PERM in config/schema.py)
+    lane_perm_k: int = struct.field(pytree_node=False, default=0)
+    lane_perm_min_util: float = struct.field(pytree_node=False, default=0.5)
+    # kernel launch sharding over the cells mesh axis (0 = auto: every
+    # visible device; see TPU_KERNEL_SHARDS in config/schema.py)
+    kernel_shards: int = struct.field(pytree_node=False, default=0)
     # energy model (cPhenotype energy store; cAvidaConfig.h:649-667)
     energy_enabled: bool = struct.field(pytree_node=False, default=False)
     energy_given_on_inject: float = struct.field(pytree_node=False, default=0.0)
@@ -304,6 +311,9 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         inherit_merit=bool(cfg.INHERIT_MERIT),
         max_steps_per_update=cfg.TPU_MAX_STEPS_PER_UPDATE,
         use_pallas=cfg.TPU_USE_PALLAS,
+        lane_perm_k=int(cfg.get("TPU_LANE_PERM", 1)),
+        lane_perm_min_util=float(cfg.get("TPU_LANE_PERM_MIN_UTIL", 0.5)),
+        kernel_shards=int(cfg.get("TPU_KERNEL_SHARDS", 0)),
         num_demes=cfg.NUM_DEMES,
         demes_use_germline=cfg.DEMES_USE_GERMLINE,
         germline_copy_mut=cfg.GERMLINE_COPY_MUT,
@@ -586,6 +596,15 @@ class PopulationState(struct.PyTreeNode):
     insts_executed: jax.Array  # int32[N]  lifetime instructions executed
     budget_carry: jax.Array    # int32[N]  banked cycles (ops/update.py cap)
 
+    # --- budget-aware kernel lane packing (ops/update.perm_phase): the
+    # persistent organism<->kernel-slot indirection.  lane_perm[slot] =
+    # organism packed into that kernel lane, lane_inv its inverse.  A
+    # WORLD-level indirection (not per-organism state): births/deaths
+    # never touch it; it is refreshed wholesale every TPU_LANE_PERM
+    # updates.  Identity when the feature is off. ---
+    lane_perm: jax.Array       # int32[N]  slot -> organism
+    lane_inv: jax.Array        # int32[N]  organism -> slot
+
     # --- resources (world-level state carried with the population) ---
     resources: jax.Array       # f32[Rg]    global pools (cResourceCount)
     res_grid: jax.Array        # f32[Rs, N] spatial per-cell (cSpatialResCount)
@@ -673,6 +692,8 @@ def zeros_population(n: int, L: int, R: int, n_global_res: int = 0,
         cost_wait=i32(n), ft_paid_lo=i32(n), ft_paid_hi=i32(n),
         insts_executed=i32(n),
         budget_carry=i32(n),
+        lane_perm=jnp.arange(n, dtype=jnp.int32),
+        lane_inv=jnp.arange(n, dtype=jnp.int32),
         resources=f32(n_global_res),
         res_grid=f32((n_spatial_res, n)),
         grad_peak=jnp.full((n_spatial_res, 2), -1, jnp.int32),
@@ -688,11 +709,13 @@ def make_cell_inputs(key: jax.Array, n: int) -> jax.Array:
 
 
 # world-level / cell-bound fields that are NOT per-organism rows
+# (lane_perm/lane_inv are [N]-shaped but index kernel SLOTS, a world-level
+# indirection -- seeding an organism must not reset its entries)
 WORLD_LEVEL_FIELDS = frozenset({
     "resources", "res_grid", "grad_peak",
     "bc_mem", "bc_len", "bc_merit", "bc_valid",
     "deme_birth_count", "deme_age", "germ_mem", "germ_len", "deme_resources",
-
+    "lane_perm", "lane_inv",
     "nb_genome", "nb_len", "nb_cell", "nb_parent", "nb_update", "nb_count",
 })
 

@@ -62,19 +62,20 @@ def budget_tail(granted, block):
     """Per-block budget-tail utilization of the granted budget vector.
     Returns device scalars: granted_sum, ceiling_sum (sum over blocks of
     block_size * block_max -- the lane-cycles the lockstep loop actually
-    burns), block_max_max and block_mean_mean (mean-vs-max granted budget
-    per block, the ~1.5x gap ROUND5_NOTES.md identifies)."""
+    burns; the ceiling itself is ops/scheduler.block_ceiling, the SAME
+    definition perm_phase's early-refresh trigger uses), block_max_max
+    and block_mean_mean (mean-vs-max granted budget per block, the ~1.5x
+    gap ROUND5_NOTES.md identifies)."""
+    from avida_tpu.ops.scheduler import block_ceiling
     n = granted.shape[0]
     pad = (-n) % block
     g = jnp.pad(granted, (0, pad))            # padded lanes grant 0 cycles
     gb = g.reshape(-1, block)
-    bmax = gb.max(axis=1)
-    bmean = gb.mean(axis=1)
     return {
         "granted_sum": granted.sum(),
-        "ceiling_sum": (bmax * block).sum(),
-        "block_max_max": bmax.max(),
-        "block_mean_mean": bmean.mean(),
+        "ceiling_sum": block_ceiling(granted, block),
+        "block_max_max": gb.max(axis=1).max(),
+        "block_mean_mean": gb.mean(axis=1).mean(),
     }
 
 

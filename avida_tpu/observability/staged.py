@@ -24,8 +24,8 @@ import jax.numpy as jnp
 
 from avida_tpu.observability import counters as counters_mod
 from avida_tpu.ops.update import (bank_phase, birth_phase, interpret_phase,
-                                  resource_phase, schedule_phase, static_cap,
-                                  use_pallas_path)
+                                  perm_phase, resource_phase, schedule_phase,
+                                  static_cap, use_pallas_path)
 
 
 class StagedUpdate:
@@ -55,16 +55,23 @@ class StagedUpdate:
             lambda st, key, u: resource_phase(params, st, key, u))
         self._schedule = jax.jit(
             lambda st, k: schedule_phase(params, st, k))
+        self._perm = jax.jit(
+            lambda st, g, u: perm_phase(params, st, g, u))
         if self.pallas:
             from avida_tpu.ops import pallas_cycles
+            use_perm = int(getattr(params, "lane_perm_k", 0)) > 0
+            shards = pallas_cycles.kernel_shards(params)
             self._pack = jax.jit(
-                lambda st, g: pallas_cycles.pack_state(params, st, g))
+                lambda st, g: pallas_cycles.pack_state(
+                    params, st, g, st.lane_perm if use_perm else None,
+                    shards))
             self._kernel = jax.jit(
                 lambda packed, k: pallas_cycles.run_packed(
                     params, packed, k, cap))
             self._unpack = jax.jit(
                 lambda st, packed: pallas_cycles.unpack_state(
-                    params, st, packed))
+                    params, st, packed,
+                    st.lane_inv if use_perm else None))
         else:
             if self.collect_dispatch:
                 self._interpret = jax.jit(
@@ -93,6 +100,7 @@ class StagedUpdate:
         st = tl.run("resources", self._resource, st, key, update_no)
         budgets, granted, max_k = tl.run("schedule", self._schedule,
                                          st, k_budget)
+        st = tl.run("schedule", self._perm, st, granted, update_no)
         executed0 = st.insts_executed
         if self.pallas:
             packed = tl.run("pack", self._pack, st, granted)

@@ -82,6 +82,13 @@ def recombine_sexual(params, st, key, off_mem, off_len, pending):
         # lockstep: males waiting then females selecting is the same
         # symmetric pairing.  Excess waiters beyond the one store slot are
         # dropped (bounded-store deviation, as in the asex path).
+        #
+        # Per-type ranks are RANDOMLY permuted each flush (one uniform
+        # draw per row, ranked within type), so which male mates which
+        # female is a fresh random matching -- the reference draws a
+        # random eligible mate per offspring; deterministic
+        # rank-by-cell-index pairing made mate choice a function of grid
+        # position (round-5 advisor; README documented deviations).
         ptype = st.mating_type
         juv_drop = sexp & (ptype == -1)
         sexp = sexp & ~juv_drop
@@ -89,8 +96,17 @@ def recombine_sexual(params, st, key, off_mem, off_len, pending):
         is_f = sexp & (ptype == 0)
         store_m = has_store & (st.bc_type == 1)
         store_f = has_store & (st.bc_type == 0)
-        rank_m = jnp.cumsum(is_m) - 1 + store_m.astype(jnp.int32)
-        rank_f = jnp.cumsum(is_f) - 1 + store_f.astype(jnp.int32)
+        u_pair = jax.random.uniform(jax.random.fold_in(key, 0x9A13), (n,))
+
+        def rand_rank(mask):
+            # rank of each mask row among mask rows, ordered by u_pair
+            # (masked rows sort to the end and get ranks >= mask.sum())
+            order = jnp.argsort(jnp.where(mask, u_pair, jnp.inf))
+            return jnp.zeros(n, jnp.int32).at[order].set(
+                jnp.arange(n, dtype=jnp.int32))
+
+        rank_m = rand_rank(is_m) + store_m.astype(jnp.int32)
+        rank_f = rand_rank(is_f) + store_f.astype(jnp.int32)
         rank = jnp.where(is_m, rank_m, rank_f)
         tot_m = is_m.sum() + store_m.astype(jnp.int32)
         tot_f = is_f.sum() + store_f.astype(jnp.int32)

@@ -873,7 +873,7 @@ _NON_ORG_FIELDS = frozenset({
     "inputs", "resources", "res_grid", "grad_peak",
     "bc_mem", "bc_len", "bc_merit", "bc_valid",
     "deme_birth_count", "deme_age", "germ_mem", "germ_len", "deme_resources",
-
+    "lane_perm", "lane_inv",
     "nb_genome", "nb_len", "nb_cell", "nb_parent", "nb_update", "nb_count",
 })
 

@@ -54,10 +54,18 @@ Design notes (v2 -- the round-4 performance rewrite):
   placement lost a conflict retries from it next update.
 
 * Per-block budget stop.  Each block's internal while_loop runs only to
-  the max granted budget of ITS organisms.  (Sorting organisms by budget
-  before blocking would cut the per-block max from ~1.55x to ~1.03x of
-  the mean, but permuting the packed state costs ~10 ms/update of
-  gather/transpose on this part and was reverted -- see run_cycles.)
+  the max granted budget of ITS organisms.  Budget-aware lane packing
+  (TPU_LANE_PERM; run_cycles + ops/update.perm_phase) permutes organisms
+  into budget-sorted lanes via major-axis row gathers in pack/unpack,
+  cutting the per-block max from ~1.55x to ~1.03x of the mean without
+  the lane-axis packed-state permute that was reverted in rounds 4/5.
+
+* Sharded launches.  Blocks never communicate, so the launch splits into
+  one shard_map shard per device over the `cells` mesh axis (run_packed;
+  TPU_KERNEL_SHARDS) with per-shard PRNG seed bases keeping the sharded
+  trajectory bit-identical to the unsharded one.  This is what makes the
+  kernel the fast path on multi-chip meshes -- pallas_call itself has no
+  GSPMD partitioning rule.
 
 Semantics are the heads hardware exactly as ops/interpreter.micro_step
 implements it (same reference citations apply, cHardwareCPU.cc:908-1079);
@@ -1264,9 +1272,27 @@ def _make_kernel(params, L, B, num_steps, interpret=False):
     return kernel, NI
 
 
-def _dims(params, n, L0):
+def kernel_shards(params) -> int:
+    """How many independent shard_map shards the kernel launch splits
+    into: TPU_KERNEL_SHARDS, or (auto) one per visible device.  The
+    fast-path precondition guarantees blocks are independent, so the
+    split needs no cross-shard communication -- each shard runs its own
+    pallas_call over its band of lanes."""
+    s = int(getattr(params, "kernel_shards", 0))
+    if s > jax.device_count():
+        raise ValueError(
+            f"TPU_KERNEL_SHARDS={s} exceeds the visible device count "
+            f"({jax.device_count()}); shards map 1:1 onto devices")
+    return jax.device_count() if s <= 0 else s
+
+
+def _dims(params, n, L0, shards=1):
     B = min(DEFAULT_BLOCK, max(128, 1 << (n - 1).bit_length()))
-    n_pad = ((n + B - 1) // B) * B
+    # lane padding: a whole number of blocks per SHARD (padded lanes are
+    # dead: granted 0, alive 0 -- their blocks exit the while_loop
+    # immediately)
+    q = B * max(shards, 1)
+    n_pad = ((n + q - 1) // q) * q
     # L padded to a CHUNK multiple: every `range(L // CHUNK)` traversal in
     # the kernel must cover the whole tape
     L = ((L0 + CHUNK - 1) // CHUNK) * CHUNK
@@ -1326,13 +1352,23 @@ def _words_to_flag(words, bit, L):
     return by.reshape(n, L)
 
 
-def pack_state(params, st, granted):
+def pack_state(params, st, granted, perm=None, shards=1):
     """PopulationState -> (tape_t, off_t, ivec, fvec) kernel layout
-    (traced)."""
+    (traced).
+
+    perm (int32[N], slot -> organism) packs organism perm[s] into kernel
+    lane s -- the budget-aware lane permutation (ops/update.perm_phase).
+    Every permute here is a MAJOR-axis row gather of an [N, ...] array
+    (tape rows; the per-organism scalars ride ONE batched [N, K] gather),
+    never a lane-axis gather of the packed planes -- the data movement
+    that sank the round-4/5 budget-sort attempts (see run_cycles)."""
     n, L0 = st.tape.shape
     R = params.num_reactions
-    B, n_pad, L = _dims(params, n, L0)
+    B, n_pad, L = _dims(params, n, L0, shards)
     NI, LW, IV_COPIED_BM, IV_DYN = _layout(params, L)
+
+    def rows(x):
+        return x if perm is None else x[perm]
 
     def padn(x):
         return jnp.pad(x, ((0, n_pad - n),) + ((0, 0),) * (x.ndim - 1))
@@ -1340,17 +1376,23 @@ def pack_state(params, st, granted):
     # ---- tape: 4-opcodes-per-int32 word plane (byte j of word w =
     # position 4w+j; little-endian bitcast, same convention as
     # _flag_to_words) + site-flag bitplanes ----
-    tape_p = jnp.pad(st.tape, ((0, 0), (0, L - L0)))
+    tape_p = jnp.pad(rows(st.tape), ((0, 0), (0, L - L0)))
     opc_t = padn(_pack_words(tape_p & jnp.uint8(63), L)).T     # [LP, n_pad]
     exec_w = _flag_to_words(tape_p, 6, L)                      # [n, LW]
     cop_w = _flag_to_words(tape_p, 7, L)
-    off_p = jnp.pad(st.off_tape, ((0, 0), (0, L - L0)))
+    off_p = jnp.pad(rows(st.off_tape), ((0, 0), (0, L - L0)))
     off_t = padn(_pack_words(off_p, L)).T                      # [LP, n_pad]
 
     iv = [None] * NI
 
+    # per-organism scalars are collected and permuted as ONE [N, K]
+    # row-gather (scal rows are stacked, transposed to organism-major,
+    # gathered, transposed back) instead of K separate [N] gathers
+    scal_i, scal_v = [], []
+
     def setrow(i, x):
-        iv[i] = padn(x.astype(jnp.int32))
+        scal_i.append(i)
+        scal_v.append(x.astype(jnp.int32))
 
     setrow(IV_MEM_LEN, st.mem_len)
     setrow(IV_ACTIVE_STACK, st.active_stack)
@@ -1408,83 +1450,140 @@ def pack_state(params, st, granted):
         setrow(IV_DYN + R + r, st.cur_reaction_count[:, r])
         setrow(IV_DYN + 2 * R + r, st.last_task_count[:, r])
         setrow(IV_DYN + 3 * R + r, st.task_exe_total[:, r])
+
+    mat = jnp.stack(scal_v, axis=0)                            # [K, n]
+    if perm is not None:
+        mat = mat.T[perm].T        # one organism-major row gather
+    mat = jnp.pad(mat, ((0, 0), (0, n_pad - n)))
+    for j, i in enumerate(scal_i):
+        iv[i] = mat[j]
     for i in range(NI):
         if iv[i] is None:
             iv[i] = jnp.zeros(n_pad, jnp.int32)
     ivec = jnp.stack(iv, axis=0)                               # [NI, n_pad]
 
-    fv = [jnp.zeros(n_pad, jnp.float32)] * NF
-
-    def fpad(x):
-        return padn(x.astype(jnp.float32))
-
-    fv[FV_MERIT] = fpad(st.merit)
-    fv[FV_CUR_BONUS] = fpad(st.cur_bonus)
-    fv[FV_FITNESS] = fpad(st.fitness)
-    fv[FV_LAST_BONUS] = fpad(st.last_bonus)
-    fv[FV_LAST_MERIT_BASE] = fpad(st.last_merit_base)
-    fvec = jnp.stack(fv, axis=0)
+    fmat = jnp.stack([st.merit, st.cur_bonus, st.fitness, st.last_bonus,
+                      st.last_merit_base], axis=0).astype(jnp.float32)
+    # row order above must follow FV_MERIT..FV_LAST_MERIT_BASE = 0..4
+    if perm is not None:
+        fmat = fmat.T[perm].T
+    fvec = jnp.pad(fmat, ((0, NF - 5), (0, n_pad - n)))        # [NF, n_pad]
     return opc_t, off_t, ivec, fvec
 
 
 def run_packed(params, packed, key, num_steps):
-    """One kernel launch over the packed state quad (traced)."""
+    """Kernel launch(es) over the packed state quad (traced).
+
+    Single device: one pallas_call over all blocks.  Multiple shards
+    (kernel_shards): the SAME launch is shard_map'd over the `cells` mesh
+    axis -- pallas_call registers no GSPMD partitioning rule, so the
+    manual shard_map is what keeps a sharded multi-chip update on the
+    kernel instead of silently falling back to the HBM-round-tripping XLA
+    while_loop.  Blocks are independent (fast-path precondition), so
+    shards need no communication; each shard's per-block PRNG seed is
+    offset by its global block base so the sharded trajectory is
+    bit-identical to the unsharded one."""
     tape_t, off_t, ivec, fvec = packed
     LP, n_pad = tape_t.shape
     L = LP * 4
     NI, LW, _, _ = _layout(params, L)
-    B = min(DEFAULT_BLOCK, n_pad)
+    S = kernel_shards(params)
+    if S > 1 and (n_pad % S or (n_pad // S) % 128):
+        S = 1                        # caller packed without shard padding
+    n_loc = n_pad // S
+    B = min(DEFAULT_BLOCK, n_loc)
 
     seed = jax.random.randint(key, (1,), 0, 2**31 - 1, dtype=jnp.int32)
 
     interpret = jax.devices()[0].platform != "tpu"
     kernel, _ = _make_kernel(params, L, B, num_steps, interpret)
-    grid = (n_pad // B,)
-    out = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((LP, B), lambda i: (0, i)),
-            pl.BlockSpec((LP, B), lambda i: (0, i)),
-            pl.BlockSpec((NI, B), lambda i: (0, i)),
-            pl.BlockSpec((NF, B), lambda i: (0, i)),
-        ],
-        out_specs=[
-            pl.BlockSpec((LP, B), lambda i: (0, i)),
-            pl.BlockSpec((LP, B), lambda i: (0, i)),
-            pl.BlockSpec((NI, B), lambda i: (0, i)),
-            pl.BlockSpec((NF, B), lambda i: (0, i)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((LP, n_pad), jnp.int32),
-            jax.ShapeDtypeStruct((LP, n_pad), jnp.int32),
-            jax.ShapeDtypeStruct((NI, n_pad), jnp.int32),
-            jax.ShapeDtypeStruct((NF, n_pad), jnp.float32),
-        ],
-        input_output_aliases={1: 0, 2: 1, 3: 2, 4: 3},
-        interpret=interpret,
+    grid = (n_loc // B,)
+
+    def launch(seed, tape_t, off_t, ivec, fvec):
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((LP, B), lambda i: (0, i)),
+                pl.BlockSpec((LP, B), lambda i: (0, i)),
+                pl.BlockSpec((NI, B), lambda i: (0, i)),
+                pl.BlockSpec((NF, B), lambda i: (0, i)),
+            ],
+            out_specs=[
+                pl.BlockSpec((LP, B), lambda i: (0, i)),
+                pl.BlockSpec((LP, B), lambda i: (0, i)),
+                pl.BlockSpec((NI, B), lambda i: (0, i)),
+                pl.BlockSpec((NF, B), lambda i: (0, i)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((LP, n_loc), jnp.int32),
+                jax.ShapeDtypeStruct((LP, n_loc), jnp.int32),
+                jax.ShapeDtypeStruct((NI, n_loc), jnp.int32),
+                jax.ShapeDtypeStruct((NF, n_loc), jnp.float32),
+            ],
+            input_output_aliases={1: 0, 2: 1, 3: 2, 4: 3},
+            interpret=interpret,
+        )(seed, tape_t, off_t, ivec, fvec)
+
+    if S == 1:
+        return tuple(launch(seed, tape_t, off_t, ivec, fvec))
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    from avida_tpu.parallel.mesh import CELL_AXIS, make_mesh
+
+    mesh = make_mesh(jax.devices()[:S])
+
+    def launch_shard(seed, tape_t, off_t, ivec, fvec):
+        # per-shard seed base = global block index of the shard's first
+        # block, so block b of shard s seeds exactly like global block
+        # s*grid + b of an unsharded launch (bit-exactness under sharding)
+        base = seed + jax.lax.axis_index(CELL_AXIS) * grid[0]
+        return launch(base, tape_t, off_t, ivec, fvec)
+
+    lane = P(None, CELL_AXIS)
+    out = shard_map(
+        launch_shard, mesh=mesh,
+        in_specs=(P(), lane, lane, lane, lane),
+        out_specs=(lane, lane, lane, lane),
+        check_rep=False,
     )(seed, tape_t, off_t, ivec, fvec)
     return tuple(out)
 
 
-def unpack_state(params, st, packed):
+def unpack_state(params, st, packed, inv=None):
     """Kernel layout -> PopulationState, preserving untouched fields of
-    `st` (genome, breed_true, resources...) (traced)."""
+    `st` (genome, breed_true, resources...) (traced).
+
+    inv (int32[N], organism -> slot) undoes the pack-time lane
+    permutation: organism o's state is read back from kernel lane inv[o].
+    As in pack_state, every permute is a major-axis row gather (the ivec/
+    fvec planes ride one organism-major gather each)."""
     tape_o, off_o, ivec_o, fvec_o = packed
     n, L0 = st.tape.shape
     R = params.num_reactions
     L = tape_o.shape[0] * 4
     NI, LW, IV_COPIED_BM, IV_DYN = _layout(params, L)
 
+    tape_rows = tape_o.T[:n]                                   # [n, LP]
+    off_rows = off_o.T[:n]
+    iv_rows = ivec_o[:, :n]                                    # [NI, n]
+    fv_rows = fvec_o[:, :n]
+    if inv is not None:
+        tape_rows = tape_rows[inv]
+        off_rows = off_rows[inv]
+        iv_rows = iv_rows.T[inv].T
+        fv_rows = fv_rows.T[inv].T
+
     def row(i):
-        return ivec_o[i, :n]
+        return iv_rows[i]
 
     def frow(i):
-        return fvec_o[i, :n]
+        return fv_rows[i]
 
     # rebuild the flag-bit tape from the packed word plane + bitplanes
-    opc = _unpack_words(tape_o.T[:n], L)                       # [n, L]
+    opc = _unpack_words(tape_rows, L)                          # [n, L]
     exec_w = jnp.stack([row(IV_EXEC_BM + w) for w in range(LW)], axis=1)
     cop_w = jnp.stack([row(IV_COPIED_BM + w) for w in range(LW)], axis=1)
     tape = (opc | _words_to_flag(exec_w, 6, L)
@@ -1493,7 +1592,7 @@ def unpack_state(params, st, packed):
     flags = row(IV_FLAGS)
     return st.replace(
         tape=tape,
-        off_tape=_unpack_words(off_o.T[:n], L)[:, :L0],
+        off_tape=_unpack_words(off_rows, L)[:, :L0],
         mem_len=row(IV_MEM_LEN),
         regs=jnp.stack([row(IV_REGS + k) for k in range(3)], axis=1),
         heads=jnp.stack([row(IV_HEADS + k) for k in range(4)], axis=1),
@@ -1543,14 +1642,22 @@ def run_cycles(params, st, key, granted, num_steps):
     `granted` (int32[N]) through the VMEM-resident kernel.  Returns the new
     PopulationState.  Caller must check `eligible(params)` first.
 
-    (Budget-sorted lane permutations were tried twice -- per-lane in round
-    4 (~10 ms of gathers) and 8-lane-tile-granular in round 5 (~15 ms
-    fused; the microbenchmark that suggested 0.2 ms was invalidated by
-    identical-input result caching) -- and reverted both times: ANY
-    traced lane-axis gather of the packed state swamps the tail saving.
-    The throughput knob for heavy-tailed budgets remains
-    TPU_MAX_STEPS_PER_UPDATE.)"""
-    packed = pack_state(params, st, granted)
+    Budget-aware lane packing (TPU_LANE_PERM, ops/update.perm_phase): the
+    persistent st.lane_perm/lane_inv indirection packs budget-sorted
+    organisms into kernel lanes so each block's while_loop runs near its
+    mean granted budget instead of its max (~1.55x -> ~1.03x lockstep
+    ceiling).  Budget-sorted blocking was tried twice before and reverted
+    -- per-lane in round 4 (~10 ms of gathers) and 8-lane-tile-granular
+    in round 5 (~15 ms fused) -- because both permuted the PACKED planes
+    along the minor (lane) axis.  This version permutes the UNPACKED
+    [N, ...] arrays on their major axis inside pack/unpack (tape-row
+    gathers plus one batched scalar-matrix gather each way) and keeps the
+    permutation itself persistent state, so the sort is refreshed on the
+    perm_phase schedule rather than recomputed here."""
+    use_perm = int(getattr(params, "lane_perm_k", 0)) > 0
+    perm = st.lane_perm if use_perm else None
+    inv = st.lane_inv if use_perm else None
+    packed = pack_state(params, st, granted, perm, kernel_shards(params))
     packed = run_packed(params, packed, key, num_steps)
-    return unpack_state(params, st, packed)
+    return unpack_state(params, st, packed, inv)
 

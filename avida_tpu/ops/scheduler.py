@@ -64,6 +64,9 @@ def compute_budgets(params, st, key):
         return k
 
     if params.slicing_method == 2:
+        # (the argsort here shows per-update [N] sorts are affordable on
+        # this path -- the lane-permutation refresh in ops/update.perm_phase
+        # relies on the same cost profile)
         share = p * ud_size.astype(p.dtype)
         base = jnp.floor(share)
         frac = share - base
@@ -75,3 +78,25 @@ def compute_budgets(params, st, key):
         return jnp.where(alive, k, 0)
 
     raise NotImplementedError(f"SLICING_METHOD {params.slicing_method}")
+
+
+def block_ceiling(granted, block: int):
+    """Lockstep lane-cycle ceiling of a granted-budget vector under
+    `block`-wide blocking: sum over blocks of block_size * block_max --
+    the cycles the per-block while_loop actually burns (each block runs
+    to the max granted budget of ITS lanes).  Shares the definition with
+    observability/counters.budget_tail; traced (device scalar out)."""
+    n = granted.shape[0]
+    pad = (-n) % block
+    g = jnp.pad(granted, (0, pad))           # padded lanes grant 0 cycles
+    return (g.reshape(-1, block).max(axis=1) * block).sum()
+
+
+def block_utilization(granted, block: int):
+    """granted.sum() / block_ceiling: the fraction of lockstep lane-cycles
+    doing useful work (1.0 = no budget tail).  The device-side imbalance
+    statistic that triggers an early lane-permutation refresh
+    (ops/update.perm_phase) and the bench's budget_tail_util field."""
+    ceil = block_ceiling(granted, block)
+    return (granted.sum().astype(jnp.float32)
+            / jnp.maximum(ceil, 1).astype(jnp.float32))

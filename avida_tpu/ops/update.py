@@ -40,15 +40,18 @@ from avida_tpu.ops.interpreter import micro_step
 def use_pallas_path(params) -> bool:
     """Trace-time routing between the VMEM-resident Pallas cycle kernel
     (ops/pallas_cycles.py) and the XLA micro-step loop.  TPU_USE_PALLAS:
-    0 = auto (kernel on a SINGLE real TPU chip when the environment
-    qualifies), 1 = force (kernel everywhere; interpret mode off-TPU --
+    0 = auto (kernel on TPU when the environment qualifies -- any device
+    count), 1 = force (kernel everywhere; interpret mode off-TPU --
     tests use this; raises if the environment disqualifies the kernel),
     2 = off.
 
-    Auto mode additionally requires a single visible device: pallas_call
-    registers no GSPMD partitioning rule, so a sharded multi-chip
-    update (parallel/mesh.py) must stay on the XLA while_loop path, which
-    GSPMD partitions cleanly."""
+    Multi-device runs take the kernel too: pallas_call registers no GSPMD
+    partitioning rule, so pallas_cycles.run_packed shard_maps the launch
+    over the `cells` mesh axis itself (one independent launch per shard;
+    blocks never communicate under the fast-path precondition).  The
+    birth flush stays OUTSIDE the shard_map on the ordinary GSPMD path,
+    so boundary-crossing births keep tests/test_parallel.py's sharded ==
+    unsharded bit-exactness guarantee."""
     if params.hw_type != 0:
         return False      # the cycle kernel implements heads hardware only
     if params.use_pallas == 2:
@@ -64,7 +67,6 @@ def use_pallas_path(params) -> bool:
                 "0 or 2")
         return True
     return (pallas_cycles.eligible(params)
-            and jax.device_count() == 1
             and jax.devices()[0].platform == "tpu")
 
 
@@ -111,6 +113,48 @@ def schedule_phase(params, st, k_budget):
         max_k = budgets.max()
         granted = budgets
     return budgets, granted, max_k
+
+
+def perm_phase(params, st, granted, update_no):
+    """Refresh the persistent budget-aware lane permutation
+    (st.lane_perm/lane_inv; consumed by pallas_cycles.run_cycles to pack
+    budget-sorted organisms into kernel lanes).  KERNEL path only: the
+    XLA while_loop has no lane blocks, and compiling the sort into every
+    XLA-path update program measurably inflates suite-wide compile time
+    (~+35% per update_step on CPU) for zero benefit -- so on the XLA
+    path the fields stay identity and cross-engine comparisons skip them
+    (tests/test_pallas.py; the permutation is transparent to physics).
+
+    Schedule: K = lane_perm_k.  K == 1 re-sorts by THIS update's granted
+    vector (exact budget packing -- kills the binomial sampling noise in
+    the block tail, not just merit heterogeneity).  K > 1 amortizes the
+    sort: refresh on update_no % K == 0, sorted by merit (the stable
+    signal budgets are drawn from), plus an early refresh whenever the
+    measured block utilization of the CURRENT permutation drops below
+    lane_perm_min_util (the cheap device-side imbalance statistic --
+    same definition as observability/counters.budget_tail)."""
+    K = int(params.lane_perm_k)
+    if K <= 0 or not use_pallas_path(params):
+        return st
+    n = granted.shape[0]
+
+    def refresh(_):
+        key_vec = (granted if K == 1
+                   else jnp.where(st.alive, st.merit, -1.0))
+        p = jnp.argsort(key_vec).astype(jnp.int32)
+        inv = jnp.zeros_like(p).at[p].set(jnp.arange(n, dtype=jnp.int32))
+        return p, inv
+
+    if K == 1:
+        p, inv = refresh(None)
+    else:
+        block = pallas_cycles.block_dims(params, n)[0]
+        util = sched_ops.block_utilization(granted[st.lane_perm], block)
+        due = (update_no % K) == 0
+        p, inv = jax.lax.cond(
+            due | (util < params.lane_perm_min_util), refresh,
+            lambda _: (st.lane_perm, st.lane_inv), None)
+    return st.replace(lane_perm=p, lane_inv=inv)
 
 
 def interpret_phase(params, st, k_steps, granted, max_k, cap, counters=None):
@@ -234,6 +278,8 @@ def update_step(params, st, key, neighbors, update_no):
     budgets, granted, max_k = schedule_phase(params, st, k_budget)
     cap = static_cap(params)
 
+    st = perm_phase(params, st, granted, update_no)
+
     executed0 = st.insts_executed
 
     st, _ = interpret_phase(params, st, k_steps, granted, max_k, cap)
@@ -260,7 +306,7 @@ def _point_mutation_sweep(params, st, key):
     return st.replace(tape=jnp.where(hit, mutated, st.tape))
 
 
-@partial(jax.jit, static_argnums=(0, 2))
+@partial(jax.jit, static_argnums=(0, 2), donate_argnums=(1,))
 def update_scan(params, st, chunk, run_key, neighbors, u0):
     """Run `chunk` consecutive updates in ONE device program (lax.scan).
 
@@ -272,7 +318,13 @@ def update_scan(params, st, chunk, run_key, neighbors, u0):
     (chunked vs single-step, any event schedule).  Returns the final state
     plus per-update int32[chunk] vectors of executed instructions, births
     and deaths, and f32[chunk] avida-time deltas and average generations
-    (all the host bookkeeping World needs, at update granularity)."""
+    (all the host bookkeeping World needs, at update granularity).
+
+    The input state is DONATED: XLA updates the ~100k-organism buffers in
+    place instead of double-buffering them, so the caller's reference to
+    the pre-call state is invalid afterwards (World reassigns self.state
+    from the return value; any device-array the caller still needs from
+    the old state must be copied out before the call)."""
     def body(st, i):
         k = jax.random.fold_in(run_key, u0 + i)
         alive_before = st.alive.sum()
@@ -327,8 +379,25 @@ def summarize(params, st, update_no=jnp.int32(-1)):
                                & (st.birth_update == update_no)).sum(),
         "num_breed_true": (alive & st.breed_true).sum(),
         "num_no_birth": (alive & (st.num_divides == 0)).sum(),
+        # lifetime executed-instruction total.  With x64 disabled a plain
+        # int32 sum SILENTLY WRAPS on long uncapped runs (per-cell
+        # counters near 2^31 summed over 100k cells is ~2^47); the exact
+        # value always rides total_insts_words (three 11-bit field sums,
+        # each < 2^31 for up to ~1e6 cells -- recombine with
+        # total_insts_exact()).  The scalar fallback here recombines in
+        # f32: monotone and non-wrapping, ~2^-24 relative error
+        # (documented approximation, NOT a wrap).
         "total_insts": st.insts_executed.astype(jnp.int64).sum()
-        if jax.config.jax_enable_x64 else st.insts_executed.sum(),
+        if jax.config.jax_enable_x64 else (
+            (st.insts_executed & 0x7FF).sum().astype(jnp.float32)
+            + ((st.insts_executed >> 11) & 0x7FF).sum().astype(jnp.float32)
+            * jnp.float32(2048.0)
+            + (st.insts_executed >> 22).sum().astype(jnp.float32)
+            * jnp.float32(4194304.0)),
+        "total_insts_words": jnp.stack([
+            (st.insts_executed & 0x7FF).sum(),
+            ((st.insts_executed >> 11) & 0x7FF).sum(),
+            (st.insts_executed >> 22).sum()]),
         "task_counts": task_counts,
         "task_doing": task_doing,
         # lifetime execution totals (all cells, dead included -- the
@@ -337,6 +406,14 @@ def summarize(params, st, update_no=jnp.int32(-1)):
         "task_exe_totals": st.task_exe_total.sum(axis=0),
         "num_divides": st.num_divides.sum(),
     }
+
+
+def total_insts_exact(words) -> int:
+    """Exact lifetime executed-instruction total from summarize()'s
+    total_insts_words (host side, arbitrary-precision Python ints)."""
+    import numpy as _np
+    w = _np.asarray(words, _np.int64)
+    return int(w[0]) + (int(w[1]) << 11) + (int(w[2]) << 22)
 
 
 @partial(jax.jit, static_argnums=0)

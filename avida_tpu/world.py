@@ -196,6 +196,8 @@ class World:
         self._total_births = jnp.int32(0)     # device scalar (BIRTHS trigger)
         self._events_done_for = None
         self._warned_actions = set()
+        self._nb_pending = None      # deferred newborn-drain snapshot
+        self._last_drain_update = 0
         # per-generation-event next-fire bookkeeping (cEventList generation
         # triggers compare against population average generation)
         self._gen_next = {}
@@ -916,17 +918,74 @@ class World:
                     nxt = min(nxt, cand)
         return nxt
 
-    def _feed_systematics(self):
+    _NB_SNAP_FIELDS = ("nb_count", "nb_genome", "nb_len", "nb_cell",
+                       "nb_parent", "nb_update", "alive", "birth_update",
+                       "genome", "genome_len", "parent_id")
+
+    def _snapshot_newborns(self):
+        """Device-side copy of everything the systematics drain reads
+        (newborn ring buffer + the occupancy/ancestry arrays the overflow
+        fallback scans), for a DEFERRED drain: the copies are async
+        device ops (no host sync), the live buffer counter is zeroed, and
+        the host ingests the snapshot one chunk later -- after the next
+        chunk has been dispatched -- so phylogeny bookkeeping overlaps
+        device compute (the zero-sync run-loop pipeline)."""
+        st = self.state
+        snap = {name: jnp.copy(getattr(st, name))
+                for name in self._NB_SNAP_FIELDS}
+        snap["update_at"] = self.update
+        snap["win_start"] = self._last_drain_update
+        self._last_drain_update = self.update
+        self.state = st.replace(nb_count=jnp.zeros((), jnp.int32))
+        return snap
+
+    def _flush_newborn_drain(self):
+        """Ingest any deferred newborn snapshot NOW (a host sync point).
+        Called at event/report boundaries, before any non-chunked step,
+        and before phylogeny pruning, so systematics observers never see
+        a stale tree and drain records stay in update order."""
+        snap, self._nb_pending = self._nb_pending, None
+        if snap is not None:
+            self._feed_systematics(snap)
+
+    def _events_fire_now(self) -> bool:
+        """Does any event fire at the CURRENT update?  (Generation/births
+        triggers force per-update stepping, so they count as always-due;
+        used to decide whether a pending newborn snapshot must be
+        ingested before process_events reads systematics.)"""
+        for ev in self.events:
+            if ev.trigger == "update":
+                if ev.fires_at(self.update):
+                    return True
+            elif ev.trigger == "immediate":
+                if self.update == 0:
+                    return True
+            else:
+                return True
+        return False
+
+    def _feed_systematics(self, snap=None):
         """Drain the device-side newborn record buffer into the host
         phylogeny (chunked-run capable: records carry their update number,
         so a K-update scan feeds K groups in order -- including newborns
         that were overwritten later in the chunk, which the old
         state-scan feed missed).  Overflow (more births than the 2N-record
-        buffer) falls back to a state scan for the window and warns."""
-        st = self.state
-        count = int(np.asarray(st.nb_count))
-        cap = st.nb_genome.shape[0]
-        alive = np.asarray(st.alive)
+        buffer) falls back to a state scan for the window and warns.
+
+        snap: a deferred snapshot from _snapshot_newborns (the pipelined
+        run loop); None reads the live state synchronously."""
+        if snap is None:
+            st = self.state
+            snap = {name: getattr(st, name)
+                    for name in self._NB_SNAP_FIELDS}
+            snap["update_at"] = self.update
+            snap["win_start"] = self._last_drain_update
+            self._last_drain_update = self.update
+            if int(np.asarray(st.nb_count)):
+                self.state = st.replace(nb_count=jnp.zeros((), jnp.int32))
+        count = int(np.asarray(snap["nb_count"]))
+        cap = snap["nb_genome"].shape[0]
+        alive = np.asarray(snap["alive"])
         overflow = count > cap
         if overflow:
             import sys
@@ -936,34 +995,34 @@ class World:
                   f"this window)", file=sys.stderr)
             count = cap
         if count:
-            genomes = np.asarray(st.nb_genome[:count])
-            lens = np.asarray(st.nb_len[:count])
-            cells = np.asarray(st.nb_cell[:count])
-            parents = np.asarray(st.nb_parent[:count])
-            updates = np.asarray(st.nb_update[:count])
+            genomes = np.asarray(snap["nb_genome"][:count])
+            lens = np.asarray(snap["nb_len"][:count])
+            cells = np.asarray(snap["nb_cell"][:count])
+            parents = np.asarray(snap["nb_parent"][:count])
+            updates = np.asarray(snap["nb_update"][:count])
             if overflow:
                 # state-scan fallback for the dropped tail: any cell whose
                 # birth_update falls inside this drain window and is not
                 # among the buffered records still exists in state (it is
                 # the cell's LAST birth); recover genome/parent from the
-                # live arrays.  Only newborns that were overwritten by a
-                # later birth AND died are unrecoverable.
-                bu = np.asarray(st.birth_update)
+                # snapshotted arrays.  Only newborns that were overwritten
+                # by a later birth AND died are unrecoverable.
+                bu = np.asarray(snap["birth_update"])
                 # window = updates since the last drain (inclusive: the
-                # previous drain set _last_drain_update to one past ITS
+                # previous drain set the window start to one past ITS
                 # window); bu >= 0 excludes seed cells (bu == -1)
-                win_start = getattr(self, "_last_drain_update", 0)
+                win_start = snap["win_start"]
                 in_window = alive & (bu >= max(win_start, 0))
                 recorded = set(zip(cells.tolist(), updates.tolist()))
                 extra = np.asarray([c for c in np.nonzero(in_window)[0]
                                     if (int(c), int(bu[c])) not in recorded],
                                    np.int64)
                 if extra.size:
-                    pid = np.asarray(st.parent_id)
+                    pid = np.asarray(snap["parent_id"])
                     genomes = np.concatenate(
-                        [genomes, np.asarray(st.genome[extra])])
+                        [genomes, np.asarray(snap["genome"][extra])])
                     lens = np.concatenate(
-                        [lens, np.asarray(st.genome_len[extra])])
+                        [lens, np.asarray(snap["genome_len"][extra])])
                     cells = np.concatenate([cells, extra])
                     parents = np.concatenate([parents, pid[extra]])
                     updates = np.concatenate([updates, bu[extra]])
@@ -986,12 +1045,9 @@ class World:
                     start = i
         else:
             self.systematics.process(
-                self.update, alive, np.zeros(0, np.int64),
+                snap["update_at"], alive, np.zeros(0, np.int64),
                 np.zeros((0, self.params.max_memory), np.int8),
                 np.zeros(0, np.int32), np.zeros(0, np.int32))
-        if count or int(np.asarray(st.nb_count)):
-            self.state = st.replace(nb_count=jnp.zeros((), jnp.int32))
-        self._last_drain_update = self.update
 
     def run(self, max_updates: int | None = None):
         if self.state is None:
@@ -1009,6 +1065,11 @@ class World:
         while not self._exit:
             if max_updates is not None and self.update >= max_updates:
                 break
+            if self._nb_pending is not None and self._events_fire_now():
+                # report/event boundary: the phylogeny must be current
+                # before any Print action reads it -- the ONE host sync
+                # point of the pipelined loop
+                self._flush_newborn_drain()
             if self.telemetry is not None:
                 # event dispatch covers the .dat writes and their device
                 # readbacks -- the "host I/O" share of the next record
@@ -1032,15 +1093,26 @@ class World:
             if stretch > 1:
                 self._pending_exec.append(self.run_updates(stretch))
                 if self.systematics is not None:
-                    self._feed_systematics()
+                    # zero-sync pipeline: snapshot this chunk's newborn
+                    # records device-side (async copies), then ingest the
+                    # PREVIOUS chunk's snapshot while this chunk is still
+                    # running on device -- host phylogeny bookkeeping
+                    # overlaps device compute instead of fencing it
+                    prev, self._nb_pending = (self._nb_pending,
+                                              self._snapshot_newborns())
+                    if prev is not None:
+                        self._feed_systematics(prev)
             else:
                 # queue the device vector; host-sync at report boundaries
+                self._flush_newborn_drain()
                 self._pending_exec.append(self.run_update())
                 self.update += 1
             if len(self._pending_exec) >= 256:
                 self._flush_exec()
             if self.systematics is not None and self.update % 100 == 0:
+                self._flush_newborn_drain()
                 self.systematics.prune_extinct(keep_ancestry=True)
+        self._flush_newborn_drain()
         for f in self._files.values():
             f.close()
         self._files = {}

@@ -79,11 +79,22 @@ def build(world_x, world_y, max_memory, seed):
     return w.params, st, neighbors, key
 
 
-def measure(world, warmup, timed, chunk=25, seed=100):
-    """org-inst/s at a given world side length (world x world organisms)."""
+def measure(world, warmup, timed, chunk=25, seed=100, sharded=False):
+    """org-inst/s at a given world side length (world x world organisms).
+    Returns (inst_per_sec, params, final_state).
+
+    sharded=True places the population over ALL visible devices
+    (parallel/mesh.py) before timing -- the same protocol, measured
+    through the shard_map'd kernel path (BENCH_SHARDED=1)."""
     from avida_tpu.ops.update import update_step
 
     params, st, neighbors, key = build(world, world, 256, seed=seed)
+    if sharded:
+        from avida_tpu.parallel import (make_mesh, shard_neighbors,
+                                        shard_population)
+        mesh = make_mesh()
+        st = shard_population(st, mesh)
+        neighbors = shard_neighbors(neighbors, mesh)
 
     @partial(jax.jit, donate_argnums=(0,))
     def run_chunk(st, key, u0):
@@ -107,7 +118,35 @@ def measure(world, warmup, timed, chunk=25, seed=100):
     jax.block_until_ready(st)
     dt = time.perf_counter() - t0
     executed_total = int(sum(int(x) for x in counts))
-    return executed_total / dt
+    return executed_total / dt, params, st
+
+
+def kernel_facts(params, st):
+    """Routing + budget-tail facts for the bench JSON line: which
+    interpret path the measurement took, over how many devices/shards,
+    and the measured per-block budget utilization of the final state
+    under the CURRENT lane permutation (1.0 = no lockstep tail waste)."""
+    from avida_tpu.ops import scheduler as sched_ops
+    from avida_tpu.ops.pallas_cycles import block_dims, kernel_shards
+    from avida_tpu.ops.update import schedule_phase, use_pallas_path
+
+    pallas = bool(use_pallas_path(params))
+    block = block_dims(params, params.num_cells)[0] if pallas \
+        else params.num_cells
+
+    @jax.jit
+    def util_fn(st):
+        _, granted, _ = schedule_phase(params, st, jax.random.key(17))
+        gp = granted[st.lane_perm] if params.lane_perm_k > 0 else granted
+        return sched_ops.block_utilization(gp, block)
+
+    return {
+        "device_count": jax.device_count(),
+        "pallas_path": pallas,
+        "kernel_shards": kernel_shards(params) if pallas else 1,
+        "lane_perm": params.lane_perm_k,
+        "budget_tail_util": round(float(util_fn(st)), 4),
+    }
 
 
 def main():
@@ -124,7 +163,7 @@ def main():
         # One JSON line per size (the driver's headline line is the plain
         # `python bench.py` run).
         for w in ([60, 100, 180, 320] if on_tpu else [20, 40, 60]):
-            ips = measure(w, warmup, timed)
+            ips, _, _ = measure(w, warmup, timed)
             print(json.dumps({
                 "metric": "org_instructions_per_sec",
                 "organisms": w * w,
@@ -134,16 +173,24 @@ def main():
             }))
         return
 
+    # BENCH_SHARDED=1: the same protocol with the population sharded over
+    # every visible device (shard_map'd kernel path) -- the sharded perf
+    # trajectory, tracked alongside the single-chip headline.
+    sharded = os.environ.get("BENCH_SHARDED", "0") == "1"
+
     # Multi-update scan inside measure(): the whole timed segment is
     # device-resident; host sync only at the end -- anything else measures
     # dispatch round-trips, not the engine.
-    ips = measure(world, warmup, timed)
+    ips, params, st = measure(world, warmup, timed, sharded=sharded)
     line = {
         "metric": "org_instructions_per_sec",
         "value": round(ips, 1),
         "unit": "inst/s",
         "vs_baseline": round(ips / BASELINE_INST_PER_SEC, 4),
     }
+    if sharded:
+        line["sharded"] = True
+    line.update(kernel_facts(params, st))
     if os.environ.get("BENCH_PHASES", "1") != "0":
         line["phases"] = phase_breakdown(world)
     print(json.dumps(line))

@@ -1,0 +1,115 @@
+"""Jaxpr-snapshot regression gate for the production update program.
+
+Records a digest of the disabled-telemetry `update_step` jaxpr on the
+canonical small world (6x6, L=64 -- the same setup
+tests/test_telemetry.py uses) and fails when a refactor changes the
+traced program unintentionally.  tests/test_telemetry.py guards the
+telemetry flag specifically; THIS gate catches any other accidental
+trace change (pure code motion must keep the jaxpr byte-identical --
+the repo workflow for update_step refactors).
+
+Usage:
+    python scripts/check_jaxpr.py            # verify against snapshot
+    python scripts/check_jaxpr.py --update   # re-record (INTENTIONAL
+                                             # trace changes only: say
+                                             # why in the commit message)
+
+The check runs single-process on the forced-CPU test platform (the
+digest depends on backend and jax version, both recorded in the
+snapshot; a jax upgrade re-records rather than failing).  Wired into the
+fast test tier via tests/test_jaxpr_snapshot.py, which calls compute()
+and check() in-process.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+
+SNAPSHOT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "jaxpr_digest.json")
+
+
+def _force_cpu():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+
+
+def compute() -> dict:
+    """Trace the production update_step and digest the jaxpr string."""
+    import jax
+    import jax.numpy as jnp
+
+    from avida_tpu.config import AvidaConfig
+    from avida_tpu.config.environment import default_logic9_environment
+    from avida_tpu.config.instset import default_instset
+    from avida_tpu.core.state import make_world_params, zeros_population
+    from avida_tpu.ops import birth as birth_ops
+    from avida_tpu.ops.update import update_step
+
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 6
+    cfg.WORLD_Y = 6
+    cfg.TPU_MAX_MEMORY = 64
+    p = make_world_params(cfg, default_instset(),
+                          default_logic9_environment())
+    st = zeros_population(p.num_cells, p.max_memory, p.num_reactions)
+    nb = jnp.asarray(birth_ops.neighbor_table(6, 6, p.geometry))
+    jx = str(jax.make_jaxpr(
+        lambda s, k, u: update_step(p, s, k, nb, u))(
+            st, jax.random.key(0), jnp.int32(0)))
+    return {
+        "update_step_sha256": hashlib.sha256(jx.encode()).hexdigest(),
+        "jaxpr_lines": jx.count("\n") + 1,
+        "jax_version": jax.__version__,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def check(current: dict | None = None) -> tuple[bool, str]:
+    """(ok, message).  A jax-version or platform difference re-baselines
+    implicitly (the digest is only meaningful within one toolchain)."""
+    if not os.path.exists(SNAPSHOT):
+        return False, (f"no snapshot at {SNAPSHOT}; run "
+                       f"`python scripts/check_jaxpr.py --update`")
+    with open(SNAPSHOT) as f:
+        want = json.load(f)
+    cur = current or compute()
+    if (cur["jax_version"] != want.get("jax_version")
+            or cur["platform"] != want.get("platform")):
+        return True, (f"toolchain changed (jax {want.get('jax_version')} "
+                      f"-> {cur['jax_version']}, platform "
+                      f"{want.get('platform')} -> {cur['platform']}); "
+                      f"digest not comparable -- re-record with --update")
+    if cur["update_step_sha256"] != want["update_step_sha256"]:
+        return False, (
+            "disabled-telemetry update_step traces to a DIFFERENT jaxpr "
+            f"({cur['jaxpr_lines']} lines, was {want.get('jaxpr_lines')}).\n"
+            "If this refactor was meant to be pure code motion, it is not "
+            "-- diff str(jax.make_jaxpr(update_step ...)) before/after.\n"
+            "If the trace change is intentional (new feature/perf work), "
+            "re-record: python scripts/check_jaxpr.py --update")
+    return True, "update_step jaxpr unchanged"
+
+
+def main() -> int:
+    _force_cpu()
+    cur = compute()
+    if "--update" in sys.argv:
+        with open(SNAPSHOT, "w") as f:
+            json.dump(cur, f, indent=1)
+            f.write("\n")
+        print(f"recorded {cur['update_step_sha256'][:16]}... "
+              f"({cur['jaxpr_lines']} jaxpr lines) -> {SNAPSHOT}")
+        return 0
+    ok, msg = check(cur)
+    print(("OK: " if ok else "FAIL: ") + msg)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,27 @@
+"""Fast-tier wiring for the jaxpr-snapshot regression gate
+(scripts/check_jaxpr.py): the disabled-telemetry update_step must trace
+to the recorded program.  Runs IN-PROCESS (tier-1 runs solo on a 1-core
+host; no subprocess spawn) on the conftest-forced CPU platform --
+exactly the toolchain the snapshot was recorded under."""
+
+from __future__ import annotations
+
+import sys
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+import check_jaxpr  # noqa: E402
+
+
+def test_update_step_jaxpr_matches_snapshot():
+    ok, msg = check_jaxpr.check()
+    assert ok, msg
+
+
+def test_snapshot_digest_is_current_format():
+    import json
+    with open(check_jaxpr.SNAPSHOT) as f:
+        snap = json.load(f)
+    assert len(snap["update_step_sha256"]) == 64
+    assert snap["platform"] == "cpu"

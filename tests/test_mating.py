@@ -103,10 +103,12 @@ def test_assortative_pairing_and_juvenile_loss():
     (om, ol, cm, placeable, dual, dm, dl, dmer, store) = recombine_sexual(
         p, st, jax.random.key(2), off_mem, off_len, pending)
     placeable = np.asarray(placeable)
-    # male 0 paired female 2: both placeable
-    assert placeable[0] and placeable[2]
-    # male 1 went to the store; juvenile 3's offspring dropped
-    assert not placeable[1] and not placeable[3]
+    # exactly ONE male paired female 2 (pairing is a per-flush random
+    # matching, so either male may be chosen); the other male waits
+    assert placeable[2]
+    assert placeable[0] != placeable[1], placeable[:4]
+    # the unpaired male went to the store; juvenile 3's offspring dropped
+    assert not placeable[3]
     bc_mem, bc_len, bc_merit, bc_valid, bc_type = store
     assert bool(bc_valid) and int(bc_type) == 1
     assert int(bc_len) == 20

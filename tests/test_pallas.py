@@ -56,6 +56,13 @@ def test_pallas_path_selected():
     assert not use_pallas_path(w2.params)
 
 
+# engine-internal kernel-lane bookkeeping: refreshed on the pallas path
+# only (ops/update.perm_phase), identity on the XLA path -- transparent
+# to physics, so cross-ENGINE comparisons skip it (same-engine sharding
+# comparisons in tests/test_parallel.py still cover it exactly)
+_ENGINE_INTERNAL = {"lane_perm", "lane_inv"}
+
+
 def test_kernel_bit_equivalence_through_gestation():
     wk = _mk_world(1)   # kernel (interpret mode on CPU)
     wx = _mk_world(2)   # XLA micro-step loop
@@ -71,6 +78,8 @@ def test_kernel_bit_equivalence_through_gestation():
         if bool(np.asarray(sx.num_divides).sum() > 0):
             saw_divide = True
         for name in sk.__dataclass_fields__:
+            if name in _ENGINE_INTERNAL:
+                continue
             a = np.asarray(getattr(sk, name))
             b = np.asarray(getattr(sx, name))
             np.testing.assert_array_equal(
@@ -119,6 +128,8 @@ def _assert_equivalent(wk, wx, n_updates=8, need_divide=True):
         if bool(np.asarray(sx.num_divides).sum() > 0):
             saw_divide = True
         for name in sk.__dataclass_fields__:
+            if name in _ENGINE_INTERNAL:
+                continue
             a = np.asarray(getattr(sk, name))
             b = np.asarray(getattr(sx, name))
             np.testing.assert_array_equal(
@@ -171,6 +182,73 @@ def test_kernel_prob_fail_suppresses_in_kernel():
         wk.run_update()
         wk.update += 1
     assert int(np.asarray(wk.state.alive).sum()) >= 2
+
+
+def _mk_world_lane(use_pallas: int, lane_perm: int) -> World:
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 8
+    cfg.WORLD_Y = 8
+    cfg.TPU_MAX_MEMORY = 200
+    cfg.RANDOM_SEED = 11
+    cfg.COPY_MUT_PROB = 0.0
+    cfg.DIVIDE_INS_PROB = 0.0
+    cfg.DIVIDE_DEL_PROB = 0.0
+    cfg.SLICING_METHOD = 0
+    cfg.AVE_TIME_SLICE = 100
+    cfg.TPU_MAX_STEPS_PER_UPDATE = 100
+    cfg.TPU_USE_PALLAS = use_pallas
+    cfg.set("TPU_LANE_PERM", lane_perm)
+    cfg.set("TPU_SYSTEMATICS", 0)
+    w = World(cfg=cfg)
+    w.inject()
+    return w
+
+
+def test_kernel_equivalence_under_lane_permutation():
+    """Budget-aware lane packing (TPU_LANE_PERM): pallas-vs-XLA
+    bit-equivalence must hold with the permutation ACTIVE.  On a mostly-
+    empty world the budget sort is strongly non-identity (dead lanes
+    grant 0 cycles and sort ahead of the live ones), so this exercises
+    real permuted packing, not a no-op."""
+    wk = _mk_world_lane(1, lane_perm=1)
+    wx = _mk_world_lane(2, lane_perm=1)
+    _assert_equivalent(wk, wx, n_updates=8)
+    # the permutation really is non-identity mid-run
+    n = wk.params.num_cells
+    assert not np.array_equal(np.asarray(wk.state.lane_perm), np.arange(n))
+
+
+def test_kernel_equivalence_identity_permutation():
+    """TPU_LANE_PERM=0: identity lanes, the pre-permutation packing."""
+    wk = _mk_world_lane(1, lane_perm=0)
+    wx = _mk_world_lane(2, lane_perm=0)
+    _assert_equivalent(wk, wx, n_updates=8)
+    n = wk.params.num_cells
+    assert np.array_equal(np.asarray(wk.state.lane_perm), np.arange(n))
+
+
+def test_pack_unpack_roundtrip_under_permutation():
+    """pack_state(perm) . unpack_state(inv) is the identity on every
+    kernel-covered field, for an arbitrary (non-sorted) permutation."""
+    from avida_tpu.ops import pallas_cycles
+
+    w = _mk_world(2)
+    for _ in range(5):           # evolve some nontrivial state
+        w.run_update()
+        w.update += 1
+    st = w.state
+    n = w.params.num_cells
+    rng = np.random.default_rng(7)
+    perm = jnp.asarray(rng.permutation(n).astype(np.int32))
+    inv = jnp.zeros(n, jnp.int32).at[perm].set(jnp.arange(n, dtype=jnp.int32))
+    granted = jnp.where(st.alive, 100, 0).astype(jnp.int32)
+
+    packed = pallas_cycles.pack_state(w.params, st, granted, perm, 1)
+    st2 = pallas_cycles.unpack_state(w.params, st, packed, inv)
+    for name in st.__dataclass_fields__:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(st, name)), np.asarray(getattr(st2, name)),
+            err_msg=f"field {name} not restored through permuted pack")
 
 
 def test_widened_eligibility():

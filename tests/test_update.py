@@ -95,6 +95,24 @@ def test_summarize_fields():
     assert s["task_counts"].shape == (9,)
 
 
+def test_total_insts_words_exact_without_x64():
+    """summarize's lifetime executed total must not silently wrap when
+    x64 is off: the three 11-bit field sums recombine exactly on the
+    host (total here ~2.1e11, far beyond int32), and the scalar f32
+    fallback is positive/monotone rather than wrapped-negative."""
+    from avida_tpu.ops.update import total_insts_exact
+    params, st, nbrs = make_world()
+    n = st.insts_executed.shape[0]
+    per_cell = 2**31 - 5
+    st = st.replace(insts_executed=jnp.full(n, per_cell, jnp.int32))
+    s = summarize(params, st)
+    assert not jax.config.jax_enable_x64
+    assert total_insts_exact(s["total_insts_words"]) == n * per_cell
+    approx = float(np.asarray(s["total_insts"]))
+    assert approx > 0
+    assert abs(approx - n * per_cell) / (n * per_cell) < 1e-6
+
+
 def test_world_end_to_end(tmp_path):
     w = World(overrides=[("WORLD_X", 8), ("WORLD_Y", 8), ("RANDOM_SEED", 3),
                          ("TPU_MAX_MEMORY", 320)],
