@@ -27,6 +27,13 @@ TPU-build extras (no reference equivalent):
   --profile-dir DIR  with --telemetry: capture a jax.profiler (XProf)
                      trace of the first few updates into DIR
                      (TPU_PROFILE_UPDATES controls how many).
+  --resume [DIR]     restore the newest valid native checkpoint
+                     generation (utils/checkpoint.py) before running;
+                     DIR defaults to TPU_CKPT_DIR.  With TPU_CKPT_DIR
+                     set, SIGTERM/SIGINT preemption saves a final
+                     checkpoint and exits 0, so a preempt/restart cycle
+                     of `--resume` runs is bit-exact with an
+                     uninterrupted run.
 """
 
 from __future__ import annotations
@@ -49,6 +56,8 @@ def main(argv=None):
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("--telemetry", action="store_true")
     p.add_argument("--profile-dir", default=None)
+    p.add_argument("--resume", nargs="?", const="", default=None,
+                   metavar="DIR")
     args = p.parse_args(argv)
 
     overrides = list(map(tuple, args.overrides))
@@ -73,9 +82,31 @@ def main(argv=None):
         az.run_file(path)
         return 0
 
+    if args.resume is not None:
+        # restart-loop friendly: a preemptible job launches with ONE fixed
+        # command line including --resume; on the very first boot the
+        # checkpoint directory is empty, which means "start fresh", not
+        # "crash" (generations that exist but fail verification still
+        # raise -- that needs a human)
+        from avida_tpu.utils.checkpoint import list_generations
+        base = args.resume or world._ckpt_base()
+        if base and not list_generations(base):
+            print(f"[avida-tpu] no checkpoint under {base}; starting fresh",
+                  file=sys.stderr)
+        else:
+            at = world.resume(args.resume or None)
+            if args.verbose:
+                print(f"resumed at update {at}", file=sys.stderr)
+
     t0 = time.time()
     world.run(max_updates=args.updates)
     dt = time.time() - t0
+    if world.preempted:
+        # preemption is a CLEAN exit: the final checkpoint is on disk and
+        # a follow-up `--resume` run continues bit-exactly
+        print(f"[avida-tpu] preempted at update {world.update}; "
+              f"checkpoint saved", file=sys.stderr)
+        return 0
     if args.verbose:
         print(f"{world.update} updates, {world.num_organisms} organisms, "
               f"{dt:.1f}s", file=sys.stderr)

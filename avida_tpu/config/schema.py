@@ -244,6 +244,24 @@ class AvidaConfig:
     # capture).  The first TPU_PROFILE_UPDATES updates are captured.
     TPU_PROFILE_DIR: str = "-"
     TPU_PROFILE_UPDATES: int = 3
+    # Native bit-exact checkpoints (utils/checkpoint.py): directory for
+    # rolling ckpt-<update> generations ("-" = checkpointing off).  With a
+    # directory set, World.run installs SIGTERM/SIGINT handlers that stop
+    # at the next update-chunk boundary, save a final checkpoint and
+    # return cleanly (preemption handling); World.resume() restores the
+    # newest valid generation bit-exactly (falling back past corrupt
+    # ones via the per-array CRC manifest).
+    TPU_CKPT_DIR: str = "-"
+    # Auto-save period in updates (0 = save only on preemption; requires
+    # TPU_CKPT_DIR).  Saves land at update-chunk boundaries, so the
+    # actual spacing can overshoot by up to one chunk (<= 128 updates).
+    TPU_CKPT_EVERY: int = 0
+    # Rolling retention: how many checkpoint generations to keep.
+    TPU_CKPT_KEEP: int = 2
+    # State invariant auditor (utils/audit.py): run audit_state every K
+    # updates inside World.run (0 = only at checkpoint save/load).  A
+    # violation raises StateInvariantError naming the broken invariant.
+    TPU_AUDIT_EVERY: int = 0
 
     extras: dict = field(default_factory=dict)
 

@@ -720,6 +720,27 @@ WORLD_LEVEL_FIELDS = frozenset({
 })
 
 
+def state_field_names() -> tuple:
+    """Canonical ordered leaf names of PopulationState -- the single
+    enumeration authority for whole-state serialization.  The native
+    checkpoint writer (utils/checkpoint.py) saves exactly these fields
+    and its loader refuses a manifest whose field set differs, so adding
+    a field to PopulationState automatically versions the checkpoint
+    format (an old checkpoint fails loudly instead of resuming with a
+    silently-defaulted field)."""
+    return tuple(PopulationState.__dataclass_fields__)
+
+
+def state_array_specs(st: PopulationState) -> dict:
+    """{field: (shape tuple, dtype str)} for every leaf of `st`.  The
+    checkpoint format test cross-checks written manifests against this
+    (tests/test_native_checkpoint.py), so shape/dtype drift between the
+    live state and the on-disk schema fails loudly."""
+    return {name: (tuple(getattr(st, name).shape),
+                   str(getattr(st, name).dtype))
+            for name in state_field_names()}
+
+
 def seed_organism(params: WorldParams, st: PopulationState,
                   seed_genome: np.ndarray, key: jax.Array,
                   cell: int) -> PopulationState:

@@ -57,6 +57,62 @@ class TelemetryWriter:
             self._f = None
 
 
+def trim_update_records(path: str, max_update: int):
+    """Resume continuity for telemetry.jsonl: drop per-update records
+    at or past the restored update (a crash that outran the last
+    auto-save leaves newer records on disk; re-run updates would
+    otherwise appear twice).  STRICT cutoff: update records are labeled
+    with the index of the update being executed, so a checkpoint at
+    update N owns records 0..N-1 and the resumed run re-emits from N.
+    Meta/event records carry no update number and are kept.  Atomic
+    rewrite; missing file is a no-op."""
+    if not os.path.exists(path):
+        return
+    kept = []
+    dropped = 0
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                dropped += 1          # torn tail line from the crash
+                continue
+            if rec.get("record") == "update" \
+                    and int(rec.get("update", -1)) >= max_update:
+                dropped += 1
+                continue
+            kept.append(line)
+    if dropped:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.writelines(kept)
+        os.replace(tmp, path)
+
+
+def emit_event(world, event: str, **fields):
+    """Structured out-of-band run event ({"record": "event", ...}).
+
+    The checkpoint/resume machinery (utils/checkpoint.py) and any other
+    robustness path report through this: the record lands in
+    telemetry.jsonl when the run has an open telemetry writer, and is
+    always echoed to stderr so headless runs without telemetry still
+    surface warnings (checkpoint corruption fallback, preemption,
+    invariant trips).  Never raises -- a logging failure must not take
+    down the save/restore path it is reporting on."""
+    import sys
+
+    rec = {"record": "event", "event": event, "time": time.time(), **fields}
+    try:
+        tel = getattr(world, "telemetry", None)
+        if tel is not None and tel._writer is not None:
+            tel._writer.write(rec)
+    except Exception:
+        pass
+    detail = " ".join(f"{k}={v}" for k, v in fields.items())
+    print(f"[avida-tpu] {event}" + (f": {detail}" if detail else ""),
+          file=sys.stderr)
+
+
 class TelemetryRecorder:
     """Drives phase-fenced updates for a World and emits telemetry.jsonl.
 

@@ -115,6 +115,16 @@ def schedule_phase(params, st, k_budget):
     return budgets, granted, max_k
 
 
+def scheduler_probe(params, st, seed: int = 0):
+    """Deterministic re-sample of the scheduler's budget grant with a
+    FIXED key, outside the run's PRNG stream.  Out-of-band consumers
+    only: the state auditor's dead-lane/scheduler-consistency invariant
+    (utils/audit.py) and bench.py's budget-tail facts.  Never called
+    from update_step, so the production update trace is untouched
+    (scripts/check_jaxpr.py digest)."""
+    return schedule_phase(params, st, jax.random.key(seed))
+
+
 def perm_phase(params, st, granted, update_no):
     """Refresh the persistent budget-aware lane permutation
     (st.lane_perm/lane_inv; consumed by pallas_cycles.run_cycles to pack

@@ -1,0 +1,154 @@
+"""State invariant auditor: fast corruption detection for run state.
+
+`audit_state(params, st)` is a single jitted device program that checks
+~15 structural invariants of a PopulationState and returns a per-invariant
+violation count (int32 each).  It runs on every native checkpoint save
+and restore (utils/checkpoint.py via World.save_checkpoint/resume) and
+optionally every `TPU_AUDIT_EVERY` updates inside World.run -- a cheap
+tripwire that names WHICH property broke (NaN merit, out-of-bounds head,
+clobbered lane permutation, negative resource) instead of letting silent
+corruption propagate for another 1e6 updates.
+
+It is deliberately a SEPARATE jit from ops/update.update_step: with
+auditing disabled nothing here is traced and the production update
+program is byte-identical (scripts/check_jaxpr.py digest unchanged).
+
+Invariant catalogue (each maps to a structural guarantee of the engine;
+the comment names the code that establishes it):
+
+  merit_finite        alive merit is finite and non-negative (phenotype
+                      merit math, ops/interpreter.py DivideReset)
+  fitness_finite      alive fitness is finite and non-negative
+  bonus_finite        alive cur_bonus is finite
+  ip_in_bounds        alive IP in [0, mem_len) after _adjust semantics
+  heads_in_bounds     alive READ/WRITE/FLOW heads in [0, mem_len)
+  genome_len_range    alive genome_len in [min_genome_len, max_memory]
+  mem_len_range       alive mem_len in [1, max_memory]
+  genome_ops_valid    alive genome opcodes in [0, num_insts)
+  input_ptr_nonneg    alive input_ptr >= 0 (a monotone IO counter, read
+                      modulo 3 -- ops/interpreter.py:481)
+  stack_ptr_range     alive stack pointers in [0, 10)
+  generation_nonneg   alive generation >= 0
+  time_nonneg         alive time_used / cpu_cycles >= 0
+  budget_carry_range  budget_carry in [0, 100 * AVE_TIME_SLICE]
+                      (ops/update.bank_phase clips exactly this window)
+  dead_lane_granted   the scheduler grants zero cycles to dead lanes
+                      (ops/scheduler.compute_budgets masks by alive;
+                      probed with a fixed out-of-stream key)
+  lane_perm_bijective lane_perm is a permutation of [0, N)
+  lane_inv_inverse    lane_inv composes with lane_perm to the identity
+  resources_nonneg    global/spatial/deme resource pools >= -1e-3
+                      (float tolerance for diffusion round-off)
+  resources_finite    every resource pool entry is finite
+  off_window_valid    pending offspring windows lie inside the tape
+  nb_count_nonneg     newborn ring-buffer cursor >= 0
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class StateInvariantError(AssertionError):
+    """Raised by check_invariants with a per-invariant violation report."""
+
+    def __init__(self, message: str, violations: dict):
+        super().__init__(message)
+        self.violations = violations
+
+
+@partial(jax.jit, static_argnums=0)
+def audit_state(params, st):
+    """Returns {invariant_name: int32 violation count} for the whole
+    population state.  All-zero means the state passes."""
+    from avida_tpu.ops.update import scheduler_probe
+
+    n, L = st.tape.shape
+    alive = st.alive
+    mlen = jnp.maximum(st.mem_len, 1)
+
+    def rows(mask):
+        return mask.sum().astype(jnp.int32)
+
+    checks = {}
+    checks["merit_finite"] = rows(
+        alive & (~jnp.isfinite(st.merit) | (st.merit < 0)))
+    checks["fitness_finite"] = rows(
+        alive & (~jnp.isfinite(st.fitness) | (st.fitness < 0)))
+    checks["bonus_finite"] = rows(alive & ~jnp.isfinite(st.cur_bonus))
+
+    ip = st.heads[:, 0]
+    checks["ip_in_bounds"] = rows(alive & ((ip < 0) | (ip >= mlen)))
+    other = st.heads[:, 1:]
+    checks["heads_in_bounds"] = rows(
+        alive & ((other < 0) | (other >= mlen[:, None])).any(axis=1))
+
+    checks["genome_len_range"] = rows(
+        alive & ((st.genome_len < params.min_genome_len)
+                 | (st.genome_len > L)))
+    checks["mem_len_range"] = rows(
+        alive & ((st.mem_len < 1) | (st.mem_len > L)))
+
+    in_genome = jnp.arange(L)[None, :] < st.genome_len[:, None]
+    bad_op = (st.genome < 0) | (st.genome >= params.num_insts)
+    checks["genome_ops_valid"] = rows(
+        alive & (in_genome & bad_op).any(axis=1))
+
+    checks["input_ptr_nonneg"] = rows(alive & (st.input_ptr < 0))
+    checks["stack_ptr_range"] = rows(
+        alive & ((st.sp < 0) | (st.sp >= 10)).any(axis=1))
+    checks["generation_nonneg"] = rows(alive & (st.generation < 0))
+    checks["time_nonneg"] = rows(
+        alive & ((st.time_used < 0) | (st.cpu_cycles < 0)))
+
+    carry_cap = 100 * params.ave_time_slice
+    checks["budget_carry_range"] = rows(
+        (st.budget_carry < 0) | (st.budget_carry > carry_cap))
+
+    _, granted, _ = scheduler_probe(params, st)
+    checks["dead_lane_granted"] = rows(~alive & (granted != 0))
+
+    counts = jnp.zeros(n, jnp.int32).at[jnp.clip(st.lane_perm, 0, n - 1)].add(1)
+    in_range = (st.lane_perm >= 0) & (st.lane_perm < n)
+    checks["lane_perm_bijective"] = rows(~in_range) + rows(counts != 1)
+    safe_perm = jnp.clip(st.lane_perm, 0, n - 1)
+    checks["lane_inv_inverse"] = rows(
+        st.lane_inv[safe_perm] != jnp.arange(n, dtype=st.lane_inv.dtype))
+
+    res_neg = jnp.int32(0)
+    res_nan = jnp.int32(0)
+    for pool in (st.resources, st.res_grid, st.deme_resources):
+        res_neg = res_neg + rows(pool < -1e-3)
+        res_nan = res_nan + rows(~jnp.isfinite(pool))
+    checks["resources_nonneg"] = res_neg
+    checks["resources_finite"] = res_nan
+
+    checks["off_window_valid"] = rows(
+        st.divide_pending & ((st.off_len < 0) | (st.off_len > L)
+                             | (st.off_start < 0) | (st.off_start >= L)))
+    checks["nb_count_nonneg"] = jnp.where(st.nb_count < 0, 1, 0
+                                          ).astype(jnp.int32)
+    return checks
+
+
+def check_invariants(params, st, where: str = "") -> dict:
+    """Host-side wrapper: run the auditor, raise StateInvariantError with
+    a per-invariant report when anything is violated, else return the
+    (all-zero) count dict."""
+    counts = {k: int(v) for k, v in audit_state(params, st).items()}
+    bad = {k: v for k, v in counts.items() if v}
+    if bad:
+        ctx = f" at {where}" if where else ""
+        report = ", ".join(f"{k}={v} cell(s)" for k, v in sorted(bad.items()))
+        raise StateInvariantError(
+            f"state invariant violation{ctx}: {report}", bad)
+    return counts
+
+
+def audit_ok(params, st) -> bool:
+    """Boolean convenience for callers that log instead of raising."""
+    return not any(int(v) for v in audit_state(params, st).values())

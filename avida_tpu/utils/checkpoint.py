@@ -1,0 +1,469 @@
+"""Native bit-exact run checkpoints (crash-safe save / verified resume).
+
+The reference-parity `.spop` format (utils/spop.py) is lossy by design:
+genotype-grouped, per-genotype *averaged* merit, no CPU registers or
+threads, no PRNG key, no resource or systematics state.  A run killed by
+TPU preemption cannot be resumed bit-exactly from it.  This module is the
+robustness staple the long-run regime needs (cf. Orbax-style async
+checkpointing, PAPERS.md; the reference's SavePopulation/LoadPopulation
+pair is the ecosystem-facing sibling, not a replacement):
+
+  * a checkpoint DIRECTORY per generation (`ckpt-<update>`), one `.npy`
+    per PopulationState leaf plus the typed PRNG keys, a systematics
+    snapshot and a host-counter block;
+  * `manifest.json` as the integrity root: per-array CRC32 + shape +
+    dtype.  A byte flip or truncation anywhere fails verification;
+  * ATOMIC writes: everything lands in a `.tmp-*` sibling, every file is
+    fsync'd, then one rename publishes the generation (a crash mid-save
+    never clobbers the previous good checkpoint);
+  * rolling retention (`TPU_CKPT_KEEP`, default 2) so a corrupt newest
+    generation falls back to the previous one.
+
+Resume is BIT-EXACT because the run PRNG stream is a pure function of
+(`_run_key`, update number) -- ops/update.update_scan's fold_in design --
+so restoring the state pytree, the keys and the update counter replays
+the identical trajectory regardless of how the driver re-chunks updates.
+
+`update_scan` donation caveat: the scan DONATES its input state buffers,
+so checkpointing always reads the state object World holds AFTER a chunk
+returns (never a reference captured before the call).  `save_checkpoint`
+materializes host copies via np.asarray before anything else runs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+import zlib
+
+import numpy as np
+
+MANIFEST = "manifest.json"
+FORMAT_VERSION = 1
+PREFIX = "ckpt-"
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint generation failed verification or could not be read."""
+
+
+class CheckpointMismatchError(CheckpointError):
+    """Checkpoint is intact but incompatible with this world's config
+    (different grid / memory / instruction-set shape) -- falling back to
+    an older generation cannot help, so this is never swallowed."""
+
+
+# ---------------------------------------------------------------------------
+# low-level generation store (pure host / numpy -- unit-testable without jax)
+# ---------------------------------------------------------------------------
+
+def generation_name(update: int) -> str:
+    return f"{PREFIX}{int(update):012d}"
+
+
+def _fsync_dir(path: str):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _crc32_file(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+def list_generations(base_dir: str) -> list:
+    """Paths of all published generations, oldest -> newest."""
+    if not os.path.isdir(base_dir):
+        return []
+    out = [os.path.join(base_dir, d) for d in os.listdir(base_dir)
+           if d.startswith(PREFIX)]
+    return sorted(out)
+
+
+def write_generation(base_dir: str, update: int, arrays: dict,
+                     host: dict, files: dict | None = None,
+                     keep: int = 2) -> str:
+    """Write one checkpoint generation atomically; returns its path.
+
+    arrays: name -> np.ndarray (saved as <name>.npy, CRC'd)
+    host:   JSON-able scalar block (stored inside the manifest)
+    files:  name -> bytes sidecar blobs (CRC'd like arrays)
+
+    The generation directory only appears (rename) after every byte is
+    written and fsync'd; a crash at any earlier point leaves a `.tmp-*`
+    sibling that the next save sweeps away.  After publishing, retention
+    drops the oldest generations beyond `keep`.
+    """
+    os.makedirs(base_dir, exist_ok=True)
+    final = os.path.join(base_dir, generation_name(update))
+    tmp = os.path.join(base_dir,
+                       f".tmp-{generation_name(update)}.{os.getpid()}")
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+
+    manifest = {
+        "format": FORMAT_VERSION,
+        "update": int(update),
+        "saved_at": time.time(),
+        "arrays": {},
+        "files": {},
+        "host": host,
+    }
+    for name, arr in arrays.items():
+        arr = np.asarray(arr)
+        fname = f"{name}.npy"
+        fpath = os.path.join(tmp, fname)
+        with open(fpath, "wb") as f:
+            np.save(f, arr)
+            f.flush()
+            os.fsync(f.fileno())
+        manifest["arrays"][name] = {
+            "file": fname,
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+            "crc32": _crc32_file(fpath),
+        }
+    for name, blob in (files or {}).items():
+        fpath = os.path.join(tmp, name)
+        with open(fpath, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        manifest["files"][name] = {
+            "size": len(blob),
+            "crc32": zlib.crc32(blob) & 0xFFFFFFFF,
+        }
+    mpath = os.path.join(tmp, MANIFEST)
+    with open(mpath, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_dir(tmp)
+
+    # publish: a same-update re-save replaces the old generation, but the
+    # old one is moved ASIDE first and removed only after the rename --
+    # a crash at any point leaves either the old or the new generation
+    # published (never zero; the aside/tmp siblings are swept next save)
+    aside = None
+    if os.path.exists(final):
+        aside = os.path.join(base_dir,
+                             f".old-{generation_name(update)}.{os.getpid()}")
+        if os.path.exists(aside):
+            shutil.rmtree(aside)
+        os.rename(final, aside)
+    os.rename(tmp, final)
+    _fsync_dir(base_dir)
+    if aside is not None:
+        shutil.rmtree(aside, ignore_errors=True)
+
+    # retention + stale tmp/aside sweep
+    gens = list_generations(base_dir)
+    for old in gens[:-max(int(keep), 1)] if keep else []:
+        shutil.rmtree(old, ignore_errors=True)
+    for d in os.listdir(base_dir):
+        p = os.path.join(base_dir, d)
+        if (d.startswith(".tmp-") or d.startswith(".old-")) and p != tmp:
+            shutil.rmtree(p, ignore_errors=True)
+    return final
+
+
+def verify_generation(path: str) -> dict:
+    """Validate a generation's manifest + every CRC; returns the manifest.
+    Raises CheckpointError on any missing/corrupt/truncated piece."""
+    mpath = os.path.join(path, MANIFEST)
+    if not os.path.exists(mpath):
+        raise CheckpointError(f"{path}: no {MANIFEST}")
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (json.JSONDecodeError, OSError) as e:
+        raise CheckpointError(f"{path}: unreadable manifest ({e})")
+    if manifest.get("format") != FORMAT_VERSION:
+        raise CheckpointError(
+            f"{path}: unsupported checkpoint format "
+            f"{manifest.get('format')!r} (want {FORMAT_VERSION})")
+    for name, spec in manifest.get("arrays", {}).items():
+        fpath = os.path.join(path, spec["file"])
+        if not os.path.exists(fpath):
+            raise CheckpointError(f"{path}: missing array file {spec['file']}")
+        crc = _crc32_file(fpath)
+        if crc != spec["crc32"]:
+            raise CheckpointError(
+                f"{path}: CRC mismatch on {name} "
+                f"({crc:#010x} != {spec['crc32']:#010x})")
+    for name, spec in manifest.get("files", {}).items():
+        fpath = os.path.join(path, name)
+        if not os.path.exists(fpath):
+            raise CheckpointError(f"{path}: missing sidecar {name}")
+        if os.path.getsize(fpath) != spec["size"] \
+                or _crc32_file(fpath) != spec["crc32"]:
+            raise CheckpointError(f"{path}: corrupt sidecar {name}")
+    return manifest
+
+
+def read_generation(path: str) -> tuple:
+    """(manifest, arrays, files) with every CRC verified.  Array dtypes
+    and shapes are additionally checked against the manifest (a np.save
+    header flip that keeps the CRC is impossible, but the belt matches
+    the braces)."""
+    manifest = verify_generation(path)
+    arrays = {}
+    for name, spec in manifest["arrays"].items():
+        arr = np.load(os.path.join(path, spec["file"]))
+        if list(arr.shape) != spec["shape"] or str(arr.dtype) != spec["dtype"]:
+            raise CheckpointError(
+                f"{path}: array {name} shape/dtype drifted from manifest")
+        arrays[name] = arr
+    files = {}
+    for name in manifest["files"]:
+        with open(os.path.join(path, name), "rb") as f:
+            files[name] = f.read()
+    return manifest, arrays, files
+
+
+def restore_candidates(base_dir: str) -> list:
+    """Generation paths to try on restore, best-first: published
+    generations newest-to-oldest, then any `.old-*` aside left by a
+    crash inside write_generation's publish window (old generation moved
+    aside but the new one not yet renamed in) -- so even that two-rename
+    window cannot strand a run without a resumable checkpoint."""
+    gens = list(reversed(list_generations(base_dir)))
+    if os.path.isdir(base_dir):
+        gens += sorted((os.path.join(base_dir, d)
+                        for d in os.listdir(base_dir)
+                        if d.startswith(".old-")), reverse=True)
+    return gens
+
+
+def latest_valid(base_dir: str, on_skip=None) -> tuple:
+    """Newest generation that verifies, as (path, manifest).  Corrupt
+    generations are skipped newest-to-oldest (on_skip(path, error) is
+    called for each).  Raises CheckpointError when none survives."""
+    gens = restore_candidates(base_dir)
+    if not gens:
+        raise CheckpointError(f"no checkpoints under {base_dir!r}")
+    last_err = None
+    for path in gens:
+        try:
+            return path, verify_generation(path)
+        except CheckpointError as e:
+            last_err = e
+            if on_skip is not None:
+                on_skip(path, e)
+    raise CheckpointError(
+        f"no valid checkpoint under {base_dir!r} "
+        f"({len(gens)} generation(s) all failed; last: {last_err})")
+
+
+# ---------------------------------------------------------------------------
+# World-level save / restore
+# ---------------------------------------------------------------------------
+
+_STATE_PREFIX = "state."
+
+
+def _host_snapshot(world) -> dict:
+    """Everything trajectory- or output-relevant that lives on the host:
+    update counter, event cursors, device-scalar accumulators, .dat diff
+    baselines, the reversion RNG and the telemetry cursor."""
+    world._flush_exec()
+    host = {
+        "update": int(world.update),
+        "seed": int(world.cfg.RANDOM_SEED),
+        "avida_time": float(np.asarray(world._avida_time)),
+        "last_ave_gen": float(np.asarray(world._last_ave_gen)),
+        "deaths_this": int(np.asarray(world._deaths_this)),
+        "prev_alive": (None if world._prev_alive is None
+                       else int(np.asarray(world._prev_alive))),
+        "total_births": int(np.asarray(world._total_births)),
+        "cum_insts": int(world._cum_insts),
+        "insts_prev_total": int(world._insts_prev_total),
+        "time_prev": int(getattr(world, "_time_prev", 0)),
+        "last_drain_update": int(world._last_drain_update),
+        "events_done_for": world._events_done_for,
+        # generation/births event cursors, aligned with world.events order
+        # (the live dict is keyed by id(ev), which does not survive a
+        # process restart)
+        "gen_next": [world._gen_next.get(id(ev)) for ev in world.events],
+        "task_exe_prev": (
+            np.asarray(world._task_exe_prev, np.int64).tolist()
+            if getattr(world, "_task_exe_prev", None) is not None else None),
+    }
+    if getattr(world, "_revert_on", False):
+        host["revert_rng"] = world._revert_rng.bit_generator.state
+    tel = getattr(world, "telemetry", None)
+    if tel is not None and tel._task_prev is not None:
+        host["telemetry"] = {
+            "task_prev": np.asarray(tel._task_prev, np.int64).tolist(),
+            "updates_run": int(tel._updates_run),
+        }
+    return host
+
+
+def _host_restore(world, host: dict):
+    import jax.numpy as jnp
+    world.update = int(host["update"])
+    world._avida_time = jnp.float32(host["avida_time"])
+    world._last_ave_gen = jnp.float32(host["last_ave_gen"])
+    world._deaths_this = jnp.int32(host["deaths_this"])
+    world._prev_alive = (None if host["prev_alive"] is None
+                         else jnp.int32(host["prev_alive"]))
+    world._total_births = jnp.int32(host["total_births"])
+    world._cum_insts = int(host["cum_insts"])
+    world._insts_prev_total = int(host["insts_prev_total"])
+    world._pending_exec = []
+    world._time_prev = int(host["time_prev"])
+    world._last_drain_update = int(host["last_drain_update"])
+    world._events_done_for = host["events_done_for"]
+    world._gen_next = {id(ev): v
+                       for ev, v in zip(world.events, host.get("gen_next", []))
+                       if v is not None}
+    world._nb_pending = None
+    world._summary_cache_update = None
+    if host.get("task_exe_prev") is not None:
+        world._task_exe_prev = np.asarray(host["task_exe_prev"], np.int64)
+    if "revert_rng" in host and getattr(world, "_revert_on", False):
+        world._revert_rng.bit_generator.state = host["revert_rng"]
+    tel = getattr(world, "telemetry", None)
+    if tel is not None:
+        if host.get("telemetry"):
+            tel.seed_task_totals(np.asarray(host["telemetry"]["task_prev"],
+                                            np.int64))
+            tel._updates_run = int(host["telemetry"]["updates_run"])
+        # resume continuity: a preempted run's telemetry.jsonl in the same
+        # data_dir is APPENDED to (the recorder's reopen-append flag),
+        # mirroring the .dat append mode -- not truncated by mode "w"
+        if os.path.exists(os.path.join(world.data_dir, "telemetry.jsonl")):
+            tel._log_opened = True
+
+
+def save_checkpoint(base_dir: str, world) -> str:
+    """Serialize the ENTIRE run state of `world` into a new generation
+    under base_dir.  The caller (World.save_checkpoint) is responsible
+    for draining the deferred newborn snapshot first so the systematics
+    snapshot is current."""
+    import jax
+
+    from avida_tpu.core.state import state_field_names
+
+    st = world.state
+    if st is None:
+        raise CheckpointError("no population state to checkpoint")
+    arrays = {_STATE_PREFIX + name: np.asarray(getattr(st, name))
+              for name in state_field_names()}
+    arrays["prng.key"] = np.asarray(jax.random.key_data(world.key))
+    arrays["prng.run_key"] = np.asarray(jax.random.key_data(world._run_key))
+    host = _host_snapshot(world)
+    files = {}
+    if world.systematics is not None:
+        files["systematics.json"] = json.dumps(
+            world.systematics.to_snapshot()).encode()
+    keep = int(world.cfg.get("TPU_CKPT_KEEP", 2))
+    return write_generation(base_dir, world.update, arrays, host,
+                            files=files, keep=keep)
+
+
+def _build_state(world, arrays: dict):
+    """Reassemble a PopulationState from a generation's array dict,
+    checking field-set and world-shape compatibility."""
+    import jax.numpy as jnp
+    from avida_tpu.core.state import PopulationState, state_field_names
+
+    fields = list(state_field_names())
+    have = {k[len(_STATE_PREFIX):] for k in arrays if k.startswith(_STATE_PREFIX)}
+    missing = [f for f in fields if f not in have]
+    extra = sorted(have - set(fields))
+    if missing or extra:
+        raise CheckpointMismatchError(
+            f"checkpoint state fields do not match this build "
+            f"(missing {missing[:4]}, unknown {extra[:4]})")
+    st = PopulationState(**{
+        name: jnp.asarray(arrays[_STATE_PREFIX + name]) for name in fields})
+    p = world.params
+    if st.alive.shape != (p.num_cells,) \
+            or st.tape.shape != (p.num_cells, p.max_memory):
+        raise CheckpointMismatchError(
+            f"checkpoint world shape {tuple(st.tape.shape)} does not match "
+            f"config ({p.num_cells} cells x {p.max_memory} memory) -- "
+            f"resume with the run's original config")
+    return st
+
+
+def _apply(world, manifest: dict, arrays: dict, files: dict):
+    import jax
+    import jax.numpy as jnp
+
+    st = _build_state(world, arrays)
+    world.state = st
+    world.key = jax.random.wrap_key_data(jnp.asarray(arrays["prng.key"]))
+    world._run_key = jax.random.wrap_key_data(
+        jnp.asarray(arrays["prng.run_key"]))
+    _host_restore(world, manifest["host"])
+    if world.systematics is not None:
+        from avida_tpu.systematics import GenotypeArbiter
+        if "systematics.json" in files:
+            world.systematics = GenotypeArbiter.from_snapshot(
+                json.loads(files["systematics.json"].decode()))
+        else:
+            # checkpoint was written with systematics off: rebuild an
+            # ancestry-free phylogeny from the live population (documented
+            # approximation -- depth/lineage restart at zero)
+            from avida_tpu.observability.runlog import emit_event
+            emit_event(world, "checkpoint_no_systematics",
+                       detail="rebuilding genotype table from live state; "
+                              "phylogenetic depth restarts at 0")
+            arb = GenotypeArbiter(world.params.num_cells)
+            alive = np.asarray(st.alive)
+            genomes = np.asarray(st.genome)
+            lens = np.asarray(st.genome_len)
+            for c in np.nonzero(alive)[0]:
+                arb.classify_seed(int(c), genomes[c, :lens[c]],
+                                  update=world.update)
+            world.systematics = arb
+
+
+def restore_checkpoint(base_dir: str, world) -> int:
+    """Restore `world` from the newest VALID generation under base_dir.
+
+    Corrupt or truncated generations (manifest/CRC failures) are skipped
+    with a runlog warning, falling back to the previous retained one;
+    config-incompatible checkpoints raise immediately.  Returns the
+    restored update number."""
+    from avida_tpu.observability.runlog import emit_event
+
+    def on_skip(path, err):
+        emit_event(world, "checkpoint_corrupt", path=path, error=str(err),
+                   detail="falling back to previous retained generation")
+
+    last_err = None
+    for path in restore_candidates(base_dir):
+        try:
+            manifest, arrays, files = read_generation(path)
+        except CheckpointMismatchError:
+            raise
+        except CheckpointError as e:
+            last_err = e
+            on_skip(path, e)
+            continue
+        try:
+            _apply(world, manifest, arrays, files)
+        except CheckpointMismatchError:
+            raise
+        emit_event(world, "checkpoint_restored", path=path,
+                   update=int(manifest["update"]))
+        return int(manifest["update"])
+    raise CheckpointError(
+        f"no valid checkpoint under {base_dir!r} (last error: {last_err})")

@@ -7,6 +7,23 @@ ecosystem tooling keeps working, and reloads them
 (cPopulation::LoadPopulation cc:6723) by injecting genomes and fast-forwarding
 each organism `gest_offset` cycles with masked lockstep micro-steps -- the
 TPU-native analogue of the reference's mid-gestation reconstruction.
+
+FIDELITY LIMITS (reference parity, asserted by
+tests/test_checkpoint.py::test_spop_fidelity_limits): the format is
+genotype-grouped, so a round-trip preserves EXACTLY
+
+  * alive mask, genome sequence and genome_len, per organism;
+  * merit / gestation_time / fitness only as the PER-GENOTYPE MEAN
+    (every restored member of a genotype gets the group average);
+  * generation from the group's first listed cell;
+
+and REBUILDS (does not preserve) CPU state: registers, heads, stacks,
+threads and phenotype task counters are re-derived by fast-forwarding
+`gest_offset` cycles from a fresh CPU.  PRNG keys, resource pools,
+systematics ancestry and per-update accounting are NOT in the format at
+all (resources restart at initial levels).  Runs needing bit-exact
+persistence use the native checkpoint format (utils/checkpoint.py);
+.spop stays for ecosystem tooling parity.
 """
 
 from __future__ import annotations
@@ -18,12 +35,35 @@ import jax.numpy as jnp
 import numpy as np
 
 
+# Reference sequence encoding (cInstruction::GetSymbol, cInstruction.cc:33):
+# opcodes 0-25 map to 'a'-'z', 26-51 to 'A'-'Z'.  Larger instruction sets
+# have no symbol alphabet in the .spop format -- refuse rather than emit
+# unparseable punctuation (the pre-fix code silently wrote chr(ord('a')+op)
+# garbage past 'z').
+_SEQ_ALPHABET = ("abcdefghijklmnopqrstuvwxyz"
+                 "ABCDEFGHIJKLMNOPQRSTUVWXYZ")
+_SEQ_DECODE = {c: i for i, c in enumerate(_SEQ_ALPHABET)}
+
+
 def _seq_to_string(ops: np.ndarray) -> str:
-    return "".join(chr(ord("a") + int(o)) for o in ops)
+    out = []
+    for o in ops:
+        o = int(o)
+        if not 0 <= o < len(_SEQ_ALPHABET):
+            raise ValueError(
+                f"opcode {o} has no .spop symbol (the a-zA-Z encoding "
+                f"covers 52 instructions); use the native checkpoint "
+                f"format (utils/checkpoint.py) for larger instruction sets")
+        out.append(_SEQ_ALPHABET[o])
+    return "".join(out)
 
 
 def _string_to_seq(s: str) -> np.ndarray:
-    return np.asarray([ord(c) - ord("a") for c in s], np.int8)
+    try:
+        return np.asarray([_SEQ_DECODE[c] for c in s], np.int8)
+    except KeyError as e:
+        raise ValueError(
+            f"invalid .spop sequence symbol {e.args[0]!r} (expected a-zA-Z)")
 
 
 def save_population(path: str, params, st, update: int, instset_name: str = "heads_default"):
@@ -106,7 +146,8 @@ def restore_population(params, orgs, key, neighbors=None):
                           smt=(params.hw_type in (1, 2)),
                           num_registers=params.num_registers,
                           nb_cap=params.nb_cap,
-                          n_deme_res=params.num_deme_res)
+                          n_deme_res=params.num_deme_res,
+                          max_threads=params.max_cpu_threads)
     k_in, key = jax.random.split(key)
     st = st.replace(
         inputs=make_cell_inputs(k_in, n),

@@ -185,6 +185,8 @@ class World:
         self.update = 0
         self.state: PopulationState | None = None
         self._exit = False
+        self._preempt = False        # SIGTERM/SIGINT tripwire (run loop)
+        self.preempted = False       # last run() ended via preemption
         self._files = {}
         self._cum_insts = 0          # host-accumulated, birth-reset-proof
         self._insts_prev_total = 0
@@ -318,8 +320,18 @@ class World:
 
     def _file(self, name, opener, *a):
         if name not in self._files:
-            self._files[name] = opener(self.data_dir, *a)
+            with self._dat_open_ctx():
+                self._files[name] = opener(self.data_dir, *a)
         return self._files[name]
+
+    def _dat_open_ctx(self):
+        """After a checkpoint resume, newly opened .dat files APPEND to
+        the preempted run's rows instead of truncating them (resume
+        continuity; utils/output.append_existing)."""
+        if getattr(self, "_dat_append", False):
+            return output_mod.append_existing()
+        import contextlib
+        return contextlib.nullcontext()
 
     def _summary(self):
         if getattr(self, "_summary_cache_update", None) != self.update:
@@ -467,8 +479,9 @@ class World:
             ids = [s.strip() for s in fmt.split(",") if s.strip()]
             specs = [(i, self.data.describe(i) if i != "core.update"
                       else "Update") for i in ids]
-            self._files[key] = DatRecorder(
-                self.data_dir, fname, "Avida data", specs)
+            with self._dat_open_ctx():
+                self._files[key] = DatRecorder(
+                    self.data_dir, fname, "Avida data", specs)
         self._files[key].record(self.update, self.data)
 
     def _action_PrintInstructionAbundanceHistogram(self, args):
@@ -1049,6 +1062,83 @@ class World:
                 np.zeros((0, self.params.max_memory), np.int8),
                 np.zeros(0, np.int32), np.zeros(0, np.int32))
 
+    # ---- crash safety: native checkpoints + preemption (utils/checkpoint) --
+
+    def _ckpt_base(self) -> str | None:
+        d = str(self.cfg.get("TPU_CKPT_DIR", "-") or "-")
+        return None if d in ("-", "") else d
+
+    def _install_preempt_handlers(self):
+        """SIGTERM/SIGINT set a flag that World.run checks at update-chunk
+        boundaries (clean preemption: drain, final checkpoint, return).
+        Returns the displaced handlers for restoration; no-op off the
+        main thread (signal.signal raises ValueError there)."""
+        import signal
+        saved = {}
+
+        def trip(signum, frame):
+            if self._preempt and signum == signal.SIGINT:
+                # second Ctrl-C: the user wants OUT now, not a graceful
+                # boundary stop -- escalate (the run loop's finally still
+                # closes the .dat/telemetry writers)
+                raise KeyboardInterrupt
+            self._preempt = True
+
+        for s in (signal.SIGTERM, signal.SIGINT):
+            try:
+                saved[s] = signal.signal(s, trip)
+            except ValueError:
+                pass
+        return saved
+
+    def save_checkpoint(self, base_dir: str | None = None,
+                        audit: bool = True) -> str:
+        """Write one native checkpoint generation (bit-exact run state:
+        full PopulationState, PRNG keys, host counters, event cursors,
+        systematics tables).  Atomic: tmp dir + fsync + rename; rolling
+        retention via TPU_CKPT_KEEP.  Returns the generation path."""
+        from avida_tpu.utils import checkpoint as ckpt_mod
+        base = base_dir or self._ckpt_base()
+        if base is None:
+            raise ValueError(
+                "no checkpoint directory (set TPU_CKPT_DIR or pass one)")
+        # the systematics snapshot must be current: ingest any deferred
+        # newborn drain (host sync) before serializing
+        self._flush_newborn_drain()
+        if audit:
+            from avida_tpu.utils.audit import check_invariants
+            check_invariants(self.params, self.state,
+                             where=f"checkpoint save (update {self.update})")
+        return ckpt_mod.save_checkpoint(base, self)
+
+    def resume(self, ckpt_dir: str | None = None, audit: bool = True) -> int:
+        """Restore this world from the newest VALID checkpoint generation
+        and position the run loop to continue bit-exactly (the run PRNG
+        stream is a pure function of the restored key and update number).
+        Corrupt generations fall back to the previous retained one with a
+        runlog warning.  Returns the restored update number."""
+        from avida_tpu.utils import checkpoint as ckpt_mod
+        base = ckpt_dir or self._ckpt_base()
+        if base is None:
+            raise ValueError(
+                "no checkpoint directory (set TPU_CKPT_DIR or pass one)")
+        update = ckpt_mod.restore_checkpoint(base, self)
+        # output continuity: files the resumed run opens extend the
+        # preempted run's rows instead of truncating them -- after
+        # trimming any rows PAST the restored update (a crash that
+        # outran the last auto-save, or a fallback to an older
+        # generation, leaves newer rows that would otherwise duplicate)
+        self._dat_append = True
+        output_mod.trim_dat_rows(self.data_dir, update)
+        from avida_tpu.observability.runlog import trim_update_records
+        trim_update_records(os.path.join(self.data_dir, "telemetry.jsonl"),
+                            update)
+        if audit:
+            from avida_tpu.utils.audit import check_invariants
+            check_invariants(self.params, self.state,
+                             where=f"checkpoint restore (update {update})")
+        return update
+
     def run(self, max_updates: int | None = None):
         if self.state is None:
             # fire begin events (Inject) before the loop
@@ -1056,68 +1146,113 @@ class World:
             if self.state is None:
                 self.inject()
         start_insts = self._cum_insts
+        ckpt_base = self._ckpt_base()
+        ckpt_every = int(self.cfg.get("TPU_CKPT_EVERY", 0))
+        audit_every = int(self.cfg.get("TPU_AUDIT_EVERY", 0))
+        self.preempted = False
+        self._preempt = False
+        handlers = self._install_preempt_handlers() if ckpt_base else {}
+        last_ckpt = self.update
+        last_audit = self.update
         # event-free stretches run as one device program; anything needing
         # per-update host work (systematics, generation triggers,
         # telemetry phase fencing) forces single stepping
         can_chunk = (not self._revert_on and self.telemetry is None and
                      not any(ev.trigger in ("generation", "births")
                              for ev in self.events))
-        while not self._exit:
-            if max_updates is not None and self.update >= max_updates:
-                break
-            if self._nb_pending is not None and self._events_fire_now():
-                # report/event boundary: the phylogeny must be current
-                # before any Print action reads it -- the ONE host sync
-                # point of the pipelined loop
-                self._flush_newborn_drain()
-            if self.telemetry is not None:
-                # event dispatch covers the .dat writes and their device
-                # readbacks -- the "host I/O" share of the next record
-                with self.telemetry.timeline.phase("events_io"):
+        try:
+            while not self._exit and not self._preempt:
+                if max_updates is not None and self.update >= max_updates:
+                    break
+                if self._nb_pending is not None and self._events_fire_now():
+                    # report/event boundary: the phylogeny must be current
+                    # before any Print action reads it -- the ONE host sync
+                    # point of the pipelined loop
+                    self._flush_newborn_drain()
+                if self.telemetry is not None:
+                    # event dispatch covers the .dat writes and their device
+                    # readbacks -- the "host I/O" share of the next record
+                    with self.telemetry.timeline.phase("events_io"):
+                        self.process_events()
+                else:
                     self.process_events()
-            else:
-                self.process_events()
-            if self._exit:
-                break
-            stretch = 1
-            if can_chunk:
-                due = self._next_event_due()
-                if max_updates is not None:
-                    due = min(due, max_updates)
-                cap_stretch = 128.0 if self.systematics is None else 8.0
-                gap = int(max(1.0, min(due - self.update, cap_stretch)))
-                # power-of-two stretch buckets: at most 8 compiled variants
-                # of the scanned update program instead of one per distinct
-                # gap length
-                stretch = 1 << (gap.bit_length() - 1)
-            if stretch > 1:
-                self._pending_exec.append(self.run_updates(stretch))
-                if self.systematics is not None:
-                    # zero-sync pipeline: snapshot this chunk's newborn
-                    # records device-side (async copies), then ingest the
-                    # PREVIOUS chunk's snapshot while this chunk is still
-                    # running on device -- host phylogeny bookkeeping
-                    # overlaps device compute instead of fencing it
-                    prev, self._nb_pending = (self._nb_pending,
-                                              self._snapshot_newborns())
-                    if prev is not None:
-                        self._feed_systematics(prev)
-            else:
-                # queue the device vector; host-sync at report boundaries
-                self._flush_newborn_drain()
-                self._pending_exec.append(self.run_update())
-                self.update += 1
-            if len(self._pending_exec) >= 256:
-                self._flush_exec()
-            if self.systematics is not None and self.update % 100 == 0:
-                self._flush_newborn_drain()
-                self.systematics.prune_extinct(keep_ancestry=True)
-        self._flush_newborn_drain()
-        for f in self._files.values():
-            f.close()
-        self._files = {}
-        if self.telemetry is not None:
-            self.telemetry.close()
+                if self._exit:
+                    break
+                stretch = 1
+                if can_chunk:
+                    due = self._next_event_due()
+                    if max_updates is not None:
+                        due = min(due, max_updates)
+                    cap_stretch = 128.0 if self.systematics is None else 8.0
+                    gap = int(max(1.0, min(due - self.update, cap_stretch)))
+                    # power-of-two stretch buckets: at most 8 compiled
+                    # variants of the scanned update program instead of one
+                    # per distinct gap length
+                    stretch = 1 << (gap.bit_length() - 1)
+                if stretch > 1:
+                    self._pending_exec.append(self.run_updates(stretch))
+                    if self.systematics is not None:
+                        # zero-sync pipeline: snapshot this chunk's newborn
+                        # records device-side (async copies), then ingest
+                        # the PREVIOUS chunk's snapshot while this chunk is
+                        # still running on device -- host phylogeny
+                        # bookkeeping overlaps device compute instead of
+                        # fencing it
+                        prev, self._nb_pending = (self._nb_pending,
+                                                  self._snapshot_newborns())
+                        if prev is not None:
+                            self._feed_systematics(prev)
+                else:
+                    # queue the device vector; host-sync at report boundaries
+                    self._flush_newborn_drain()
+                    self._pending_exec.append(self.run_update())
+                    self.update += 1
+                if len(self._pending_exec) >= 256:
+                    self._flush_exec()
+                if self.systematics is not None and self.update % 100 == 0:
+                    self._flush_newborn_drain()
+                    self.systematics.prune_extinct(keep_ancestry=True)
+                # robustness hooks, both at update-chunk boundaries: the
+                # periodic invariant audit and the rolling auto-save
+                if audit_every and self.update - last_audit >= audit_every:
+                    from avida_tpu.utils.audit import check_invariants
+                    check_invariants(self.params, self.state,
+                                     where=f"update {self.update}")
+                    last_audit = self.update
+                if ckpt_base and ckpt_every \
+                        and self.update - last_ckpt >= ckpt_every:
+                    self.save_checkpoint(ckpt_base)
+                    last_ckpt = self.update
+            # orderly exit (normal or preempted): the phylogeny drain and,
+            # on preemption, the final checkpoint both need a consistent
+            # host view -- neither runs after an exception (the state may
+            # be mid-mutation), but the finally below still closes writers
+            self._flush_newborn_drain()
+            if self._preempt and ckpt_base and self.state is not None:
+                self.save_checkpoint(ckpt_base)
+            self.preempted = self._preempt
+        finally:
+            import signal as _signal
+            for s, h in handlers.items():
+                try:
+                    _signal.signal(s, h)
+                except (ValueError, OSError):
+                    pass
+            # .dat handles and the telemetry recorder are flushed/closed on
+            # ANY exit path -- exception, KeyboardInterrupt, preemption or
+            # normal return -- so a crash never loses the buffered tail of
+            # telemetry.jsonl or a half-written .dat row
+            for f in self._files.values():
+                try:
+                    f.close()
+                except Exception:
+                    pass
+            self._files = {}
+            if self.telemetry is not None:
+                try:
+                    self.telemetry.close()
+                except Exception:
+                    pass
         return self._flush_exec() - start_insts
 
     @property

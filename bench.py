@@ -136,7 +136,8 @@ def kernel_facts(params, st):
 
     @jax.jit
     def util_fn(st):
-        _, granted, _ = schedule_phase(params, st, jax.random.key(17))
+        from avida_tpu.ops.update import scheduler_probe
+        _, granted, _ = scheduler_probe(params, st, seed=17)
         gp = granted[st.lane_perm] if params.lane_perm_k > 0 else granted
         return sched_ops.block_utilization(gp, block)
 
@@ -191,9 +192,45 @@ def main():
     if sharded:
         line["sharded"] = True
     line.update(kernel_facts(params, st))
+    if os.environ.get("BENCH_CKPT", "0") == "1":
+        line.update(ckpt_audit_overhead(params, st))
     if os.environ.get("BENCH_PHASES", "1") != "0":
         line["phases"] = phase_breakdown(world)
     print(json.dumps(line))
+
+
+def ckpt_audit_overhead(params, st):
+    """BENCH_CKPT=1: wall cost of the robustness hooks on the final bench
+    state -- one native checkpoint generation write (ckpt_save_ms: host
+    gather + CRC + fsync'd atomic publish, utils/checkpoint.py) and one
+    full invariant audit (audit_ms: utils/audit.py, compiled cost after a
+    warmup pass).  Rides the headline JSON line so checkpoint overhead
+    shows up in the perf trajectory without perturbing the headline
+    numbers (measured after them)."""
+    import shutil
+    import tempfile
+
+    from avida_tpu.core.state import state_field_names
+    from avida_tpu.utils import checkpoint as ckpt_mod
+    from avida_tpu.utils.audit import audit_state
+
+    jax.block_until_ready(audit_state(params, st))        # compile warmup
+    t0 = time.perf_counter()
+    jax.block_until_ready(audit_state(params, st))
+    audit_ms = (time.perf_counter() - t0) * 1e3
+
+    arrays = {f"state.{name}": np.asarray(getattr(st, name))
+              for name in state_field_names()}
+    tmp = tempfile.mkdtemp(prefix="bench-ckpt-")
+    try:
+        t0 = time.perf_counter()
+        ckpt_mod.write_generation(tmp, 0, arrays,
+                                  host={"bench": True}, keep=1)
+        ckpt_ms = (time.perf_counter() - t0) * 1e3
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {"ckpt_save_ms": round(ckpt_ms, 2),
+            "audit_ms": round(audit_ms, 2)}
 
 
 def phase_breakdown(world, reps=2, seed=100):

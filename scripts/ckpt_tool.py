@@ -1,0 +1,90 @@
+"""Checkpoint directory inspector/verifier (utils/checkpoint.py format).
+
+Usage:
+    python scripts/ckpt_tool.py <ckpt_dir>            # list generations
+    python scripts/ckpt_tool.py <ckpt_dir> --verify   # full CRC sweep
+    python scripts/ckpt_tool.py <ckpt_dir> --manifest # dump newest manifest
+
+List mode shows, per generation: update number, save time, array count,
+total bytes and a cheap manifest-presence status.  --verify re-reads
+every array and sidecar, checking each CRC32 against the manifest -- the
+same validation World.resume runs, usable from an ops shell to answer
+"can this run be resumed, and from which generation?" without loading
+jax or touching the device.  Exit status: 0 when at least one generation
+verifies, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _repo_path():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+
+
+def _dir_bytes(path: str) -> int:
+    return sum(os.path.getsize(os.path.join(path, f))
+               for f in os.listdir(path)
+               if os.path.isfile(os.path.join(path, f)))
+
+
+def main() -> int:
+    _repo_path()
+    from avida_tpu.utils.checkpoint import (CheckpointError, MANIFEST,
+                                            list_generations,
+                                            verify_generation)
+
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    if not args:
+        print(__doc__)
+        return 1
+    base = args[0]
+    do_verify = "--verify" in sys.argv
+    do_manifest = "--manifest" in sys.argv
+
+    gens = list_generations(base)
+    if not gens:
+        print(f"no checkpoint generations under {base!r}")
+        return 1
+
+    any_ok = False
+    for path in gens:
+        name = os.path.basename(path)
+        mpath = os.path.join(path, MANIFEST)
+        try:
+            if do_verify:
+                manifest = verify_generation(path)
+                status = "OK (verified)"
+            else:
+                with open(mpath) as f:
+                    manifest = json.load(f)
+                status = "present"
+            any_ok = True
+            saved = time.strftime("%Y-%m-%d %H:%M:%S",
+                                  time.localtime(manifest.get("saved_at", 0)))
+            print(f"{name}: update {manifest.get('update')}, saved {saved}, "
+                  f"{len(manifest.get('arrays', {}))} arrays, "
+                  f"{_dir_bytes(path) / 1e6:.2f} MB, {status}")
+        except (CheckpointError, OSError, json.JSONDecodeError) as e:
+            print(f"{name}: CORRUPT -- {e}")
+
+    if do_manifest and any_ok:
+        for path in reversed(gens):
+            try:
+                manifest = verify_generation(path) if do_verify else \
+                    json.load(open(os.path.join(path, MANIFEST)))
+            except Exception:
+                continue
+            print(json.dumps(manifest, indent=1))
+            break
+    return 0 if any_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -102,8 +102,12 @@ def test_tasks_exe_baseline_reset_on_load(tmp_path):
     w.events = []
     w.inject()
     w.run(max_updates=5)
-    # give the population distinctive lifetime task-execution totals
+    # give the population distinctive lifetime task-execution totals.
+    # Materialize the host copy NOW: update_scan donates the state
+    # buffers, so the device array backing `fake` is dead after the next
+    # w.run() (the documented donation caveat, ops/update.py).
     fake = jnp.ones_like(w.state.task_exe_total) * 7
+    fake_np = np.asarray(fake)
     w.state = w.state.replace(task_exe_total=fake)
     w._summary_cache_update = None
     w.update = 5
@@ -118,7 +122,7 @@ def test_tasks_exe_baseline_reset_on_load(tmp_path):
     w._action_PrintTasksExeData([])            # refreshes _task_exe_prev
     w._action_LoadPopulation([spop_path])
     totals = np.asarray(w.state.task_exe_total)
-    np.testing.assert_array_equal(totals, np.asarray(fake))   # sidecar round-trip
+    np.testing.assert_array_equal(totals, fake_np)   # sidecar round-trip
     w._summary_cache_update = None
     w._action_PrintTasksExeData([])
     rows = [l.split() for l in
@@ -135,10 +139,107 @@ def test_tasks_exe_baseline_reset_on_load(tmp_path):
     w2.update = 5
     w2._action_LoadPopulation([spop_path])
     np.testing.assert_array_equal(np.asarray(w2.state.task_exe_total),
-                                  np.asarray(fake))
+                                  fake_np)
     w2._action_PrintTasksExeData([])
     rows2 = [l.split() for l in
              open(os.path.join(str(tmp_path / "w2"), "tasks_exe.dat"))
              if l.strip() and not l.startswith("#")]
     last2 = [int(x) for x in rows2[-1][1:]]
     assert all(v == 0 for v in last2), last2
+
+
+def test_empty_population_spop_roundtrip(tmp_path):
+    """SavePopulation with ZERO live organisms writes a header-only file;
+    loading it must yield a clean empty world that keeps running (no
+    parse error, no stale population) -- regression for the empty-file
+    edge of the .spop round trip."""
+    from avida_tpu.utils import spop
+
+    w = _world(tmp_path, seed=31)
+    w.events = []
+    w.inject()
+    w.run(max_updates=3)
+    w._action_KillProb(["1.0"])          # extinction event
+    assert w.num_organisms == 0
+    path = os.path.join(str(tmp_path), "empty.spop")
+    spop.save_population(path, w.params, w.state, w.update)
+    assert os.path.exists(path)
+
+    import jax
+    orgs = spop.load_population(path, w.params, jax.random.key(0))
+    assert orgs == []
+    w2 = _world(tmp_path / "w2", seed=32)
+    w2.events = []
+    w2.update = 3
+    w2._action_LoadPopulation([path])
+    assert w2.num_organisms == 0
+    # the empty world still runs (no stale state, no crash)
+    w2.run(max_updates=5)
+    assert w2.num_organisms == 0
+
+
+def test_spop_fidelity_limits(tmp_path):
+    """Executable documentation of exactly which fields survive a .spop
+    round trip (see utils/spop.py header): genome/alive/genome_len are
+    exact; merit comes back as the PER-GENOTYPE MEAN; resources restart
+    at initial levels; CPU state is rebuilt by gest_offset fast-forward
+    rather than preserved.  Future native-checkpoint changes must not
+    silently alter this reference-parity contract."""
+    import jax
+    import jax.numpy as jnp
+
+    from avida_tpu.utils import spop
+
+    w = _world(tmp_path, seed=41)
+    w.events = []
+    w.inject()
+    w.run(max_updates=18)
+    st = w.state
+    alive = np.asarray(st.alive)
+    assert alive.sum() > 2
+
+    # craft distinct per-organism merits so genotype averaging is visible
+    n = w.params.num_cells
+    crafted = jnp.where(st.alive,
+                        jnp.arange(n, dtype=jnp.float32) + 1.0, st.merit)
+    w.state = st = st.replace(merit=crafted)
+
+    path = os.path.join(str(tmp_path), "fidelity.spop")
+    spop.save_population(path, w.params, st, w.update)
+    orgs = spop.load_population(path, w.params, jax.random.key(0))
+    st2 = spop.restore_population(w.params, orgs, jax.random.key(1))
+
+    # exact: occupancy, genome identity, genome length
+    np.testing.assert_array_equal(np.asarray(st2.alive), alive)
+    np.testing.assert_array_equal(np.asarray(st2.genome)[alive],
+                                  np.asarray(st.genome)[alive])
+    np.testing.assert_array_equal(np.asarray(st2.genome_len)[alive],
+                                  np.asarray(st.genome_len)[alive])
+
+    # lossy by design: merit is genotype-averaged on restore
+    genomes = np.asarray(st.genome)
+    lens = np.asarray(st.genome_len)
+    groups = {}
+    for c in np.nonzero(alive)[0]:
+        groups.setdefault(genomes[c, :lens[c]].tobytes(), []).append(c)
+    crafted_np = np.asarray(crafted)
+    restored = np.asarray(st2.merit)
+    saw_averaging = False
+    for cells in groups.values():
+        mean = np.float32(crafted_np[cells].mean())
+        for c in cells:
+            np.testing.assert_allclose(restored[c], mean, rtol=1e-5)
+        if len(cells) > 1:
+            saw_averaging = True
+            assert not np.allclose(crafted_np[cells], crafted_np[cells][0])
+    assert saw_averaging, "need a multi-member genotype to show averaging"
+
+    # not in the format: resource pools restart at initial levels
+    np.testing.assert_array_equal(
+        np.asarray(st2.resources),
+        np.asarray(w.params.res_initial, np.float32))
+    # CPU state is rebuilt (fresh CPU + fast-forward), not copied: the
+    # restored lifetime cycle counter only covers the current gestation
+    offsets = np.asarray(st.time_used) - np.asarray(st.gestation_start)
+    assert (np.asarray(st2.time_used)[alive]
+            <= np.maximum(offsets[alive], 0) + 1).all()

@@ -1,0 +1,435 @@
+"""Native checkpoint + preemption + state auditor (utils/checkpoint.py,
+utils/audit.py, World run hardening).
+
+Fast tier: the pure-host generation store (atomic manifest + CRC32,
+fault injection by byte flip / truncation, fallback ordering, rolling
+retention) and the .spop symbol-encoding satellite -- no jit involved.
+
+Slow tier: end-to-end bit-exact resume through the SIGTERM preemption
+path (XLA engine, systematics on) and through the Pallas kernel path
+with budget-aware lane packing; corrupt-checkpoint fallback on a real
+world; the invariant auditor on evolved state with injected NaN merit
+and a clobbered lane permutation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from avida_tpu.utils import checkpoint as ckpt_mod
+
+
+# ---------------------------------------------------------------------------
+# fast: generation store fault injection (no jax compilation)
+# ---------------------------------------------------------------------------
+
+def _arrays():
+    rng = np.random.default_rng(3)
+    return {
+        "state.a": np.arange(37, dtype=np.int32),
+        "state.b": rng.random((5, 9)).astype(np.float32),
+        "state.c": rng.integers(0, 2, 64).astype(bool),
+    }
+
+
+def test_generation_write_verify_roundtrip(tmp_path):
+    base = str(tmp_path / "ck")
+    arrays = _arrays()
+    host = {"update": 12, "avida_time": 1.5, "gen_next": [None, 3.0]}
+    path = ckpt_mod.write_generation(base, 12, arrays, host, keep=2)
+    assert os.path.basename(path) == "ckpt-000000000012"
+    manifest, back, files = ckpt_mod.read_generation(path)
+    assert manifest["update"] == 12
+    assert manifest["host"] == json.loads(json.dumps(host))
+    for name, arr in arrays.items():
+        np.testing.assert_array_equal(back[name], arr)
+        assert back[name].dtype == arr.dtype
+    # no stray tmp dirs survive a successful publish
+    assert not [d for d in os.listdir(base) if d.startswith(".tmp-")]
+
+
+def test_byte_flip_detected(tmp_path):
+    base = str(tmp_path / "ck")
+    path = ckpt_mod.write_generation(base, 1, _arrays(), {}, keep=2)
+    target = os.path.join(path, "state.b.npy")
+    blob = bytearray(open(target, "rb").read())
+    blob[len(blob) // 2] ^= 0x01          # single-bit flip in the payload
+    open(target, "wb").write(bytes(blob))
+    with pytest.raises(ckpt_mod.CheckpointError, match="CRC mismatch"):
+        ckpt_mod.verify_generation(path)
+
+
+def test_truncation_detected(tmp_path):
+    base = str(tmp_path / "ck")
+    path = ckpt_mod.write_generation(base, 1, _arrays(), {}, keep=2)
+    target = os.path.join(path, "state.a.npy")
+    blob = open(target, "rb").read()
+    open(target, "wb").write(blob[: len(blob) // 2])
+    with pytest.raises(ckpt_mod.CheckpointError, match="CRC mismatch"):
+        ckpt_mod.verify_generation(path)
+    # a missing array file is caught too
+    os.remove(target)
+    with pytest.raises(ckpt_mod.CheckpointError, match="missing"):
+        ckpt_mod.verify_generation(path)
+
+
+def test_truncated_manifest_detected(tmp_path):
+    base = str(tmp_path / "ck")
+    path = ckpt_mod.write_generation(base, 1, _arrays(), {}, keep=2)
+    mpath = os.path.join(path, ckpt_mod.MANIFEST)
+    blob = open(mpath, "rb").read()
+    open(mpath, "wb").write(blob[: len(blob) // 3])
+    with pytest.raises(ckpt_mod.CheckpointError, match="manifest"):
+        ckpt_mod.verify_generation(path)
+
+
+def test_fallback_to_previous_generation(tmp_path):
+    base = str(tmp_path / "ck")
+    ckpt_mod.write_generation(base, 10, _arrays(), {"u": 10}, keep=3)
+    newest = ckpt_mod.write_generation(base, 20, _arrays(), {"u": 20}, keep=3)
+    target = os.path.join(newest, "state.c.npy")
+    blob = bytearray(open(target, "rb").read())
+    blob[-1] ^= 0xFF
+    open(target, "wb").write(bytes(blob))
+
+    skipped = []
+    path, manifest = ckpt_mod.latest_valid(
+        base, on_skip=lambda p, e: skipped.append(p))
+    assert manifest["update"] == 10
+    assert skipped == [newest]
+
+
+def test_rolling_retention(tmp_path):
+    base = str(tmp_path / "ck")
+    for u in (1, 2, 3, 4):
+        ckpt_mod.write_generation(base, u, _arrays(), {}, keep=2)
+    names = sorted(os.path.basename(p)
+                   for p in ckpt_mod.list_generations(base))
+    assert names == ["ckpt-000000000003", "ckpt-000000000004"]
+
+
+def test_stale_tmp_swept(tmp_path):
+    base = str(tmp_path / "ck")
+    os.makedirs(os.path.join(base, ".tmp-ckpt-000000000099.1234"))
+    ckpt_mod.write_generation(base, 5, _arrays(), {}, keep=2)
+    assert not [d for d in os.listdir(base) if d.startswith(".tmp-")]
+
+
+# ---------------------------------------------------------------------------
+# fast: .spop sequence symbol encoding satellite (a-z then A-Z, cap 52)
+# ---------------------------------------------------------------------------
+
+def test_spop_symbol_encoding_roundtrip():
+    from avida_tpu.utils.spop import _seq_to_string, _string_to_seq
+    ops = np.arange(52, dtype=np.int8)
+    s = _seq_to_string(ops)
+    assert s == ("abcdefghijklmnopqrstuvwxyz"
+                 "ABCDEFGHIJKLMNOPQRSTUVWXYZ")
+    np.testing.assert_array_equal(_string_to_seq(s), ops)
+    with pytest.raises(ValueError, match="52"):
+        _seq_to_string(np.asarray([52], np.int32))
+    with pytest.raises(ValueError, match="symbol"):
+        _string_to_seq("ab{c")
+
+
+# ---------------------------------------------------------------------------
+# slow: end-to-end world tests
+# ---------------------------------------------------------------------------
+
+_NB_SCRATCH = ("nb_genome", "nb_len", "nb_cell", "nb_parent", "nb_update")
+
+
+def _assert_states_equal(sa, sb):
+    """Bit-exact PopulationState comparison.  The newborn ring-buffer
+    record rows are compared only up to nb_count (zero after the run-end
+    drain): rows past the cursor are dead scratch whose stale contents
+    depend on drain/chunk boundaries, which resume legitimately
+    re-chunks -- every live field must match exactly."""
+    for name in sa.__dataclass_fields__:
+        va, vb = np.asarray(getattr(sa, name)), np.asarray(getattr(sb, name))
+        if name in _NB_SCRATCH:
+            cnt = int(np.asarray(sa.nb_count))
+            va, vb = va[:cnt], vb[:cnt]
+        np.testing.assert_array_equal(va, vb, err_msg=f"field {name}")
+
+
+def _xla_world(tmpdir, ckpt=None, every=0, seed=11):
+    from avida_tpu.config import AvidaConfig
+    from avida_tpu.world import World
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 8
+    cfg.WORLD_Y = 8
+    cfg.TPU_MAX_MEMORY = 256
+    cfg.RANDOM_SEED = seed
+    cfg.AVE_TIME_SLICE = 100
+    cfg.TPU_MAX_STEPS_PER_UPDATE = 100
+    if ckpt:
+        cfg.set("TPU_CKPT_DIR", str(ckpt))
+    if every:
+        cfg.set("TPU_CKPT_EVERY", every)
+    w = World(cfg=cfg, data_dir=str(tmpdir))
+    w.events = []
+    return w
+
+
+@pytest.mark.slow
+def test_sigterm_preempt_resume_bit_exact(tmp_path):
+    """Run N updates uninterrupted; separately, SIGTERM the run at ~N/2
+    (the preemption path: flag at the chunk boundary, drain, final
+    checkpoint, clean return), resume a FRESH world from the checkpoint
+    and continue to N.  Final PopulationState, host counters and the
+    systematics tables must match the uninterrupted run's exactly."""
+    from avida_tpu.config.events import parse_event_line
+    from avida_tpu.core.state import state_field_names
+
+    wa = _xla_world(tmp_path / "a")
+    wa.inject()
+    wa.run(max_updates=20)
+
+    ckdir = tmp_path / "ck"
+    wb = _xla_world(tmp_path / "b", ckpt=ckdir)
+    wb._action_SendTerm = \
+        lambda args: os.kill(os.getpid(), signal.SIGTERM)
+    wb.events = [parse_event_line("u 9 SendTerm")]
+    wb.inject()
+    wb.run(max_updates=20)
+    assert wb.preempted
+    assert wb.update < 20
+    gens = ckpt_mod.list_generations(str(ckdir))
+    assert len(gens) == 1
+
+    # the manifest covers EVERY PopulationState field (format versioning:
+    # adding a field must change the manifest field set), with the live
+    # state's exact shapes and dtypes
+    from avida_tpu.core.state import state_array_specs
+    manifest = ckpt_mod.verify_generation(gens[0])
+    saved = {k for k in manifest["arrays"] if k.startswith("state.")}
+    assert saved == {f"state.{f}" for f in state_field_names()}
+    for field, (shape, dtype) in state_array_specs(wb.state).items():
+        spec = manifest["arrays"][f"state.{field}"]
+        assert tuple(spec["shape"]) == shape, field
+        assert spec["dtype"] == dtype, field
+
+    wc = _xla_world(tmp_path / "c", ckpt=ckdir)
+    assert wc.resume() == wb.update
+    wc.run(max_updates=20)
+    assert not wc.preempted
+    _assert_states_equal(wa.state, wc.state)
+    assert int(np.asarray(wa._total_births)) == int(np.asarray(wc._total_births))
+    assert wa.systematics.num_genotypes == wc.systematics.num_genotypes
+    assert sorted(g.sequence.tobytes()
+                  for g in wa.systematics.live_genotypes()) \
+        == sorted(g.sequence.tobytes()
+                  for g in wc.systematics.live_genotypes())
+
+
+@pytest.mark.slow
+def test_auto_save_and_corrupt_fallback(tmp_path, capsys):
+    """TPU_CKPT_EVERY auto-saves rolling generations; byte-flipping the
+    newest makes resume fall back to the previous retained one with a
+    runlog warning."""
+    ckdir = tmp_path / "ck"
+    w = _xla_world(tmp_path / "a", ckpt=ckdir, every=6)
+    w.inject()
+    w.run(max_updates=20)
+    gens = ckpt_mod.list_generations(str(ckdir))
+    assert len(gens) == 2          # TPU_CKPT_KEEP default
+    updates = [ckpt_mod.verify_generation(g)["update"] for g in gens]
+    assert updates == sorted(updates)
+
+    target = os.path.join(gens[-1], "state.merit.npy")
+    blob = bytearray(open(target, "rb").read())
+    blob[len(blob) // 2] ^= 0x10
+    open(target, "wb").write(bytes(blob))
+
+    w2 = _xla_world(tmp_path / "b", ckpt=ckdir)
+    assert w2.resume() == updates[0]
+    err = capsys.readouterr().err
+    assert "checkpoint_corrupt" in err
+    assert "checkpoint_restored" in err
+    # and the fallback world keeps evolving
+    w2.run(max_updates=updates[0] + 4)
+    assert w2.update == updates[0] + 4
+
+
+@pytest.mark.slow
+def test_pallas_lane_packed_resume_bit_exact(tmp_path):
+    """Bit-exact resume through the Pallas kernel path with budget-aware
+    lane packing active (lane_perm refreshed every update): save at
+    mid-run via World.save_checkpoint, resume a fresh world, finish, and
+    match the uninterrupted kernel run exactly -- including
+    lane_perm/lane_inv."""
+    from avida_tpu.config import AvidaConfig
+    from avida_tpu.ops.update import use_pallas_path
+    from avida_tpu.world import World
+
+    def mk(tmpdir, ckpt=None):
+        cfg = AvidaConfig()
+        cfg.WORLD_X = 8
+        cfg.WORLD_Y = 8
+        cfg.TPU_MAX_MEMORY = 200
+        cfg.RANDOM_SEED = 11
+        cfg.COPY_MUT_PROB = 0.0
+        cfg.DIVIDE_INS_PROB = 0.0
+        cfg.DIVIDE_DEL_PROB = 0.0
+        cfg.SLICING_METHOD = 0
+        cfg.AVE_TIME_SLICE = 100
+        cfg.TPU_MAX_STEPS_PER_UPDATE = 100
+        cfg.TPU_USE_PALLAS = 1        # interpret mode on CPU
+        cfg.set("TPU_SYSTEMATICS", 0)
+        if ckpt:
+            cfg.set("TPU_CKPT_DIR", str(ckpt))
+        w = World(cfg=cfg, data_dir=str(tmpdir))
+        w.events = []
+        return w
+
+    wa = mk(tmp_path / "a")
+    assert use_pallas_path(wa.params) and wa.params.lane_perm_k == 1
+    wa.inject()
+    wa.run(max_updates=8)
+    assert not np.array_equal(np.asarray(wa.state.lane_perm),
+                              np.arange(wa.params.num_cells))
+
+    ckdir = tmp_path / "ck"
+    wb = mk(tmp_path / "b", ckpt=ckdir)
+    wb.inject()
+    wb.run(max_updates=4)
+    wb.save_checkpoint()
+
+    wc = mk(tmp_path / "c", ckpt=ckdir)
+    assert wc.resume() == 4
+    wc.run(max_updates=8)
+    _assert_states_equal(wa.state, wc.state)
+
+
+@pytest.mark.slow
+def test_auditor_on_evolved_state(tmp_path):
+    """audit_state passes on healthy evolved state and names the exact
+    invariant for injected corruption: NaN merit, a clobbered lane
+    permutation, a negative resource pool."""
+    import jax.numpy as jnp
+
+    from avida_tpu.utils.audit import (StateInvariantError, audit_state,
+                                       check_invariants)
+
+    w = _xla_world(tmp_path)
+    w.inject()
+    w.run(max_updates=12)
+    st = w.state
+    counts = check_invariants(w.params, st)
+    assert counts and all(v == 0 for v in counts.values())
+    assert len(counts) >= 15
+
+    cell = int(np.nonzero(np.asarray(st.alive))[0][0])
+    with pytest.raises(StateInvariantError, match="merit_finite") as ei:
+        check_invariants(w.params, st.replace(
+            merit=st.merit.at[cell].set(jnp.nan)))
+    assert ei.value.violations == {"merit_finite": 1}
+
+    with pytest.raises(StateInvariantError, match="lane_perm_bijective"):
+        check_invariants(w.params, st.replace(
+            lane_perm=st.lane_perm.at[0].set(st.lane_perm[1])))
+
+    if st.resources.shape[0]:
+        bad = st.replace(resources=st.resources.at[0].set(-1.0))
+        assert int(audit_state(w.params, bad)["resources_nonneg"]) == 1
+
+    # save-path integration: a corrupt state refuses to checkpoint
+    w.state = st.replace(merit=st.merit.at[cell].set(jnp.inf))
+    with pytest.raises(StateInvariantError):
+        w.save_checkpoint(str(tmp_path / "ck"))
+
+
+def test_datfile_append_on_resume(tmp_path):
+    """Inside utils/output.append_existing(), reopening an existing .dat
+    file appends (no truncation, no duplicate header); fresh files still
+    get their header.  World.resume arms this so a resumed run extends
+    the preempted run's rows."""
+    from avida_tpu.utils import output as output_mod
+
+    path = str(tmp_path / "x.dat")
+    f = output_mod.DatFile(path, "T", ["col a"])
+    f.write_row([1, 2.5])
+    f.close()
+
+    with output_mod.append_existing():
+        f2 = output_mod.DatFile(path, "T", ["col a"])
+        f2.write_row([2, 3.5])
+        f2.close()
+        fresh = output_mod.DatFile(str(tmp_path / "y.dat"), "T", ["col a"])
+        fresh.close()
+
+    lines = open(path).read().splitlines()
+    assert lines.count("# T") == 1                 # single header block
+    rows = [l for l in lines if l and not l.startswith("#")]
+    assert rows == ["1 2.5 ", "2 3.5 "]
+    assert open(str(tmp_path / "y.dat")).read().startswith("# T")
+
+    # outside the context, the historical truncate-on-open contract holds
+    f3 = output_mod.DatFile(path, "T", ["col a"])
+    f3.close()
+    rows = [l for l in open(path).read().splitlines()
+            if l and not l.startswith("#")]
+    assert rows == []
+
+
+def test_trim_stale_rows_on_resume(tmp_path):
+    """Rows PAST the restored update are trimmed before append-mode
+    reopening (a crash that outran the last auto-save would otherwise
+    duplicate those updates after resume); non-numeric rows and headers
+    are kept; telemetry.jsonl gets the analogous treatment including a
+    torn tail line."""
+    from avida_tpu.observability.runlog import trim_update_records
+    from avida_tpu.utils import output as output_mod
+
+    d = str(tmp_path)
+    with open(os.path.join(d, "average.dat"), "w") as f:
+        f.write("# header\n\n5 1.0 \n10 2.0 \n15 3.0 \n20 4.0 \n")
+    with open(os.path.join(d, "notes.txt"), "w") as f:
+        f.write("15 not a dat file\n")
+    output_mod.trim_dat_rows(d, 10)
+    rows = [l.split()[0] for l in open(os.path.join(d, "average.dat"))
+            if l.strip() and not l.startswith("#")]
+    # STRICT cutoff: the resumed run re-fires events at the restored
+    # update, so the row labeled 10 itself must go too
+    assert rows == ["5"]
+    assert open(os.path.join(d, "notes.txt")).read() == "15 not a dat file\n"
+
+    tj = os.path.join(d, "telemetry.jsonl")
+    with open(tj, "w") as f:
+        f.write(json.dumps({"record": "meta", "seed": 1}) + "\n")
+        f.write(json.dumps({"record": "update", "update": 9}) + "\n")
+        f.write(json.dumps({"record": "update", "update": 11}) + "\n")
+        f.write('{"record": "update", "upda')        # torn tail
+    trim_update_records(tj, 10)
+    recs = [json.loads(l) for l in open(tj)]
+    assert [r.get("update") for r in recs] == [None, 9]
+    trim_update_records(os.path.join(d, "missing.jsonl"), 10)   # no-op
+
+
+def test_same_update_resave_keeps_a_recoverable_generation(tmp_path):
+    """A same-update re-save must never pass through a state with zero
+    recoverable generations: the old generation is moved aside before
+    the new one is renamed in, and restore_candidates() still finds the
+    aside if a crash lands inside that window."""
+    base = str(tmp_path / "ck")
+    ckpt_mod.write_generation(base, 7, _arrays(), {"v": 1}, keep=2)
+    path = ckpt_mod.write_generation(base, 7, _arrays(), {"v": 2}, keep=2)
+    assert ckpt_mod.verify_generation(path)["host"] == {"v": 2}
+    assert len(ckpt_mod.list_generations(base)) == 1
+
+    # simulate the crash window: published generation moved aside, new
+    # one never renamed in
+    aside = os.path.join(base, ".old-ckpt-000000000007.999")
+    os.rename(path, aside)
+    assert ckpt_mod.list_generations(base) == []
+    found, manifest = ckpt_mod.latest_valid(base)
+    assert found == aside and manifest["host"] == {"v": 2}
+    # ...and the next successful save sweeps the aside
+    ckpt_mod.write_generation(base, 8, _arrays(), {}, keep=2)
+    assert not [d for d in os.listdir(base) if d.startswith(".old-")]
