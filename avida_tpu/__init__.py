@@ -24,4 +24,14 @@ Layer map (mirrors SURVEY.md §1, re-architected):
 
 __version__ = "0.1.0"
 
-from avida_tpu.world import World  # noqa: E402,F401
+
+def __getattr__(name):
+    # lazy re-export (PEP 562): importing World pulls in jax/flax and the
+    # whole engine, which `python -m avida_tpu --status DIR` -- the
+    # outside-the-process heartbeat reader -- must never pay for.  Plain
+    # `import avida_tpu` stays lightweight; `avida_tpu.World` and
+    # `from avida_tpu import World` resolve on first touch.
+    if name == "World":
+        from avida_tpu.world import World
+        return World
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -34,6 +34,15 @@ TPU-build extras (no reference equivalent):
                      checkpoint and exits 0, so a preempt/restart cycle
                      of `--resume` runs is bit-exact with an
                      uninterrupted run.
+  --trace            enable the device-side flight recorder
+                     (observability/tracer.py): structured events
+                     recorded inside the jitted update, drained to
+                     {"record":"trace"} runlog lines at chunk
+                     boundaries, plus the metrics.prom heartbeat.
+                     Shorthand for -set TPU_TRACE 1.
+  --status DIR       print the last heartbeat of the run writing to
+                     data dir DIR (reads DIR/metrics.prom; no JAX
+                     import, works while the run is live) and exit.
 """
 
 from __future__ import annotations
@@ -58,13 +67,23 @@ def main(argv=None):
     p.add_argument("--profile-dir", default=None)
     p.add_argument("--resume", nargs="?", const="", default=None,
                    metavar="DIR")
+    p.add_argument("--trace", action="store_true")
+    p.add_argument("--status", default=None, metavar="DIR")
     args = p.parse_args(argv)
+
+    if args.status is not None:
+        # outside-the-process observability: read the metrics.prom
+        # heartbeat only -- no World, no JAX device init
+        from avida_tpu.observability.exporter import status_main
+        return status_main(args.status)
 
     overrides = list(map(tuple, args.overrides))
     if args.seed is not None:
         overrides.append(("RANDOM_SEED", args.seed))
     if args.telemetry:
         overrides.append(("TPU_TELEMETRY", 1))
+    if args.trace:
+        overrides.append(("TPU_TRACE", 1))
     if args.profile_dir:
         overrides.append(("TPU_TELEMETRY", 1))
         overrides.append(("TPU_PROFILE_DIR", args.profile_dir))

@@ -262,6 +262,30 @@ class AvidaConfig:
     # updates inside World.run (0 = only at checkpoint save/load).  A
     # violation raises StateInvariantError naming the broken invariant.
     TPU_AUDIT_EVERY: int = 0
+    # Device-side flight recorder (observability/tracer.py): 1 = record
+    # structured events (births/deaths, first task triggers, scheduler
+    # stalls, state anomalies) into fixed-capacity ring buffers INSIDE
+    # the jitted update, drained to {"record":"trace"} runlog lines only
+    # at update-chunk boundaries (no mid-chunk host sync).  Opt-in: 0
+    # (default) adds no state and traces the identical update program
+    # (scripts/check_jaxpr.py digest unchanged); 1 leaves the evolved
+    # trajectory bit-identical (the ring is append-only side state).
+    TPU_TRACE: int = 0
+    # Ring capacity in events.  Overflow drops the OLDEST events and
+    # counts the drops (reported on the drain record) -- it never forces
+    # an early host sync.  Size for the busiest expected window: roughly
+    # (births + deaths + first-task triggers) per update x updates per
+    # chunk (<= 128).
+    TPU_TRACE_CAP: int = 4096
+    # Emit a scheduler-stall event when the lockstep block utilization of
+    # the granted budget vector falls below this fraction.
+    TPU_TRACE_STALL_UTIL: float = 0.25
+    # Prometheus-style metrics textfile (observability/exporter.py):
+    # 1 = rewrite DATA_DIR/metrics.prom atomically at every update-chunk
+    # boundary (tmp + rename, like checkpoints) so an external scraper /
+    # `python -m avida_tpu --status DIR` can watch a live run.  Implied
+    # by TPU_TRACE=1.
+    TPU_METRICS: int = 0
 
     extras: dict = field(default_factory=dict)
 

@@ -20,7 +20,6 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
-from avida_tpu.models import heads as hw
 
 
 class WorldParams(struct.PyTreeNode):
@@ -105,6 +104,13 @@ class WorldParams(struct.PyTreeNode):
     # systematics: device-side newborn ring buffer (chunked-run phylogeny
     # ingestion; 0 = off)
     nb_cap: int = struct.field(pytree_node=False, default=0)
+    # flight recorder (observability/tracer.py): capacity of the in-state
+    # event ring (0 = recorder off -- no ring arrays, no emission traced,
+    # update_step jaxpr unchanged; see TPU_TRACE / TPU_TRACE_CAP)
+    trace_cap: int = struct.field(pytree_node=False, default=0)
+    # emit a scheduler-stall event when the lockstep block utilization of
+    # the granted budget vector drops below this fraction
+    trace_stall_util: float = struct.field(pytree_node=False, default=0.25)
     # intra-organism threads (cAvidaConfig.h:558-564)
     max_cpu_threads: int = struct.field(pytree_node=False, default=1)
     thread_slicing_method: int = struct.field(pytree_node=False, default=0)
@@ -351,6 +357,9 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         thread_slicing_method=int(cfg.THREAD_SLICING_METHOD),
         nb_cap=2 * cfg.WORLD_X * cfg.WORLD_Y
         if cfg.get("TPU_SYSTEMATICS", 1) else 0,
+        trace_cap=int(cfg.get("TPU_TRACE_CAP", 4096))
+        if int(cfg.get("TPU_TRACE", 0)) else 0,
+        trace_stall_util=float(cfg.get("TPU_TRACE_STALL_UTIL", 0.25)),
         generation_inc_method=cfg.GENERATION_INC_METHOD,
         num_reactions=len(environment.reactions),
         task_logic_mask=tt(env_tables["task_logic_mask"]),
@@ -553,6 +562,24 @@ class PopulationState(struct.PyTreeNode):
     nb_count: jax.Array        # int32[] records written (may exceed CAP =
                                # overflow; the host detects and falls back)
 
+    # --- flight recorder event ring (observability/tracer.py; the five
+    # fields are None when trace_cap == 0 -- None is an EMPTY pytree, so
+    # the disabled recorder contributes no jaxpr inputs and update_step
+    # traces to the byte-identical program, scripts/check_jaxpr.py).
+    # Append-only side state written inside the jitted update
+    # (ops/update.trace_pre_phase/trace_post_phase): slot i % trace_cap
+    # holds event number i, so overflow drops the OLDEST events and the
+    # host recovers the drop count from the monotone cursor
+    # (tr_count - trace_cap).  Nothing in the engine reads these back --
+    # the evolved trajectory is bit-identical with the recorder on or
+    # off (tests/test_tracer.py). ---
+    tr_update: jax.Array       # int32[TCAP] update_no of event
+    tr_cell: jax.Array         # int32[TCAP] cell index (-1 = world-level)
+    tr_code: jax.Array         # int32[TCAP] event code (tracer.EVENT_CODES)
+    tr_payload: jax.Array      # int32[TCAP] code-specific payload
+    tr_count: jax.Array        # int32[]    events written since last drain
+                               #            (may exceed TCAP = overflow)
+
     # --- experimental hardware (hw_type 3): spatial behaviour state ---
     facing: jax.Array          # int32[N]  ring direction 0-7 (cell facing;
                                # ref cPopulationCell rotation state)
@@ -629,7 +656,8 @@ def zeros_population(n: int, L: int, R: int, n_global_res: int = 0,
                      n_spatial_res: int = 0, n_demes: int = 1,
                      smt: bool = False, num_registers: int = 3,
                      nb_cap: int = 0, n_deme_res: int = 0,
-                     max_threads: int = 1) -> PopulationState:
+                     max_threads: int = 1,
+                     trace_cap: int = 0) -> PopulationState:
     i32 = partial(jnp.zeros, dtype=jnp.int32)
     f32 = partial(jnp.zeros, dtype=jnp.float32)
     T = 2 if smt else 0          # SMT thread axis (host, parasite)
@@ -669,6 +697,11 @@ def zeros_population(n: int, L: int, R: int, n_global_res: int = 0,
         nb_genome=jnp.zeros((nb_cap, L), jnp.int8), nb_len=i32(nb_cap),
         nb_cell=i32(nb_cap), nb_parent=i32(nb_cap), nb_update=i32(nb_cap),
         nb_count=jnp.zeros((), jnp.int32),
+        tr_update=i32(trace_cap) if trace_cap else None,
+        tr_cell=i32(trace_cap) if trace_cap else None,
+        tr_code=i32(trace_cap) if trace_cap else None,
+        tr_payload=i32(trace_cap) if trace_cap else None,
+        tr_count=jnp.zeros((), jnp.int32) if trace_cap else None,
         facing=i32(n), forage_target=jnp.full(n, -1, jnp.int32),
         off_start=i32(n), off_len=i32(n),
         off_tape=jnp.zeros((n, L), jnp.uint8),
@@ -708,6 +741,13 @@ def make_cell_inputs(key: jax.Array, n: int) -> jax.Array:
     return tops[None, :] + low
 
 
+# flight-recorder ring leaves (observability/tracer.py) -- the single
+# spelling authority: the tracer's snapshot, the checkpoint loader's
+# config-dependent-field reconciliation, and WORLD_LEVEL_FIELDS below
+# all derive from this tuple
+TRACE_RING_FIELDS = ("tr_update", "tr_cell", "tr_code", "tr_payload",
+                     "tr_count")
+
 # world-level / cell-bound fields that are NOT per-organism rows
 # (lane_perm/lane_inv are [N]-shaped but index kernel SLOTS, a world-level
 # indirection -- seeding an organism must not reset its entries)
@@ -717,6 +757,7 @@ WORLD_LEVEL_FIELDS = frozenset({
     "deme_birth_count", "deme_age", "germ_mem", "germ_len", "deme_resources",
     "lane_perm", "lane_inv",
     "nb_genome", "nb_len", "nb_cell", "nb_parent", "nb_update", "nb_count",
+    *TRACE_RING_FIELDS,
 })
 
 
@@ -735,10 +776,14 @@ def state_array_specs(st: PopulationState) -> dict:
     """{field: (shape tuple, dtype str)} for every leaf of `st`.  The
     checkpoint format test cross-checks written manifests against this
     (tests/test_native_checkpoint.py), so shape/dtype drift between the
-    live state and the on-disk schema fails loudly."""
+    live state and the on-disk schema fails loudly.  Fields that are
+    None (the flight-recorder ring with the recorder off -- empty
+    pytrees, not arrays) have no on-disk representation and are
+    omitted, matching the checkpoint writer."""
     return {name: (tuple(getattr(st, name).shape),
                    str(getattr(st, name).dtype))
-            for name in state_field_names()}
+            for name in state_field_names()
+            if getattr(st, name) is not None}
 
 
 def seed_organism(params: WorldParams, st: PopulationState,
@@ -802,7 +847,8 @@ def init_population(params: WorldParams, seed_genome: np.ndarray,
                           num_registers=params.num_registers,
                           nb_cap=params.nb_cap,
                           n_deme_res=params.num_deme_res,
-                          max_threads=params.max_cpu_threads)
+                          max_threads=params.max_cpu_threads,
+                          trace_cap=params.trace_cap)
     k_inputs, key = jax.random.split(key)
     st = st.replace(inputs=make_cell_inputs(k_inputs, n),
                     deme_resources=jnp.broadcast_to(

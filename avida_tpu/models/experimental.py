@@ -22,15 +22,16 @@ from __future__ import annotations
 
 import numpy as np
 
-from avida_tpu.models.heads import (
-    InstSpec, MOD_HEAD, MOD_LABEL, MOD_NONE, MOD_REG,
-    HEAD_IP, HEAD_FLOW,
-    SEM_ADD, SEM_DEC, SEM_GET_HEAD, SEM_H_ALLOC, SEM_H_COPY, SEM_H_DIVIDE,
-    SEM_H_SEARCH, SEM_IF_LABEL, SEM_IF_LESS, SEM_IF_N_EQU, SEM_INC, SEM_IO,
-    SEM_JMP_HEAD, SEM_MOV_HEAD, SEM_NAND, SEM_POP, SEM_PUSH, SEM_SET_FLOW,
-    SEM_SHIFT_L, SEM_SHIFT_R, SEM_SUB, SEM_SWAP, SEM_SWAP_STK,
-    NUM_SEMANTIC_OPS as _HEADS_OPS,
-)
+from avida_tpu.models.heads import (InstSpec, MOD_HEAD, MOD_LABEL, MOD_NONE,
+                                    MOD_REG, HEAD_IP, SEM_ADD, SEM_DEC,
+                                    SEM_GET_HEAD, SEM_H_ALLOC, SEM_H_COPY,
+                                    SEM_H_DIVIDE, SEM_H_SEARCH, SEM_IF_LABEL,
+                                    SEM_IF_LESS, SEM_IF_N_EQU, SEM_INC, SEM_IO,
+                                    SEM_JMP_HEAD, SEM_MOV_HEAD, SEM_NAND,
+                                    SEM_POP, SEM_PUSH, SEM_SET_FLOW,
+                                    SEM_SHIFT_L, SEM_SHIFT_R, SEM_SUB,
+                                    SEM_SWAP, SEM_SWAP_STK,
+                                    NUM_SEMANTIC_OPS as _HEADS_OPS)
 
 NUM_REGISTERS = 8        # rAX..rHX (cHardwareExperimental.h:66)
 NUM_NOPS = 8             # nop-A..nop-H

@@ -19,6 +19,12 @@ actually executed:
                  (one JSON object per update: phases, counters, metadata)
   harness.py  -- the unified profiling CLI (replaces
                  scripts/profile_update.py) + bench.py's `phases` hook
+  tracer.py   -- `FlightRecorder`: host drain of the device-side event
+                 ring (births/deaths, first task triggers, scheduler
+                 stalls, anomalies recorded INSIDE the jitted update;
+                 TPU_TRACE=1) into {"record": "trace"} runlog lines
+  exporter.py -- `MetricsExporter`: atomic metrics.prom heartbeat +
+                 `python -m avida_tpu --status DIR`
 
 Everything is opt-in (TPU_TELEMETRY=1 / `python -m avida_tpu --telemetry`)
 and zero-cost when disabled: the production update program traces to the
@@ -26,15 +32,29 @@ identical jaxpr whether or not this package is imported
 (tests/test_telemetry.py), and no files are written.
 """
 
-from avida_tpu.observability.counters import (budget_block, budget_tail,
-                                              dispatch_init, update_counters)
-from avida_tpu.observability.harness import profile_phases
-from avida_tpu.observability.runlog import TelemetryRecorder, TelemetryWriter
-from avida_tpu.observability.staged import StagedUpdate
-from avida_tpu.observability.timeline import Timeline
+# lazy barrel (PEP 562): most submodules import jax at module scope, but
+# `python -m avida_tpu --status DIR` reaches exporter.py through this
+# package and must stay jax-free (the whole point of the outside-the-
+# process heartbeat reader) -- resolve names on first touch instead
+_EXPORTS = {
+    "budget_block": "counters", "budget_tail": "counters",
+    "dispatch_init": "counters", "update_counters": "counters",
+    "MetricsExporter": "exporter",
+    "profile_phases": "harness",
+    "TelemetryRecorder": "runlog", "TelemetryWriter": "runlog",
+    "StagedUpdate": "staged",
+    "Timeline": "timeline",
+    "EVENT_CODES": "tracer", "FlightRecorder": "tracer",
+}
 
-__all__ = [
-    "Timeline", "StagedUpdate", "TelemetryRecorder", "TelemetryWriter",
-    "profile_phases", "budget_block", "budget_tail", "dispatch_init",
-    "update_counters",
-]
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    return getattr(
+        importlib.import_module(f"avida_tpu.observability.{mod}"), name)

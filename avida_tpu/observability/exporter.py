@@ -1,0 +1,236 @@
+"""Prometheus-style metrics export: make a long run observable from outside.
+
+World.run rewrites `DATA_DIR/metrics.prom` at every update-chunk boundary
+(atomic tmp + rename, the same publish discipline as native checkpoints)
+whenever TPU_METRICS=1 or the flight recorder (TPU_TRACE=1) is on.  The
+file is the textfile-collector flavor of the Prometheus exposition
+format: `# HELP` / `# TYPE` comment pairs followed by `name value` lines,
+so a node-exporter textfile collector (or any scraper that can read a
+file) picks a live run up with zero integration work.
+
+`python -m avida_tpu --status DIR` is the human side of the same file:
+it prints the last heartbeat (update number, organisms, births, trace
+drops, how stale the heartbeat is) without touching the running process.
+
+The export reads a handful of device scalars the driver already
+maintains (_avida_time, _total_births, _prev_alive) plus host counters.
+On the live path the readback is DEFERRED one chunk (capture refs at
+boundary N, publish at boundary N+1 when that chunk has finished) so it
+never fences the dispatch pipeline -- the same deferral the systematics
+newborn drain and the flight-recorder drain use; only the final
+exit/preempt heartbeat syncs.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+METRICS_FILE = "metrics.prom"
+
+_HELP = {
+    "avida_update": ("counter", "updates completed by the run"),
+    "avida_organisms": ("gauge", "living organisms at the last boundary"),
+    "avida_births_total": ("counter", "cumulative births"),
+    "avida_deaths_last_update": ("gauge", "deaths in the last update"),
+    "avida_generation_avg": ("gauge", "population average generation"),
+    "avida_time": ("counter", "avida time (sum of 1/ave_gestation)"),
+    "avida_insts_total": ("counter", "organism instructions executed"),
+    "avida_preempted": ("gauge", "1 after a SIGTERM/SIGINT preemption"),
+    "avida_trace_events_total": ("counter",
+                                 "flight-recorder events drained"),
+    "avida_trace_dropped_total": ("counter",
+                                  "flight-recorder events dropped "
+                                  "(ring overflow, oldest first)"),
+    "avida_trace_code_total": ("counter",
+                               "flight-recorder events by code name"),
+    "avida_heartbeat_timestamp_seconds": ("gauge",
+                                          "unix time of the last export"),
+}
+
+
+def render_metrics(world) -> str:
+    """The exposition text for a world's current host-visible state.
+    This is the SYNCHRONOUS flavor -- `_flush_exec()` and the
+    `np.asarray` readbacks fence any chunk still in flight -- so
+    World.run uses it only for the exit/preempt final heartbeat; live
+    chunk boundaries go through `MetricsExporter.export_deferred`, which
+    never blocks the dispatch pipeline."""
+    tracer = getattr(world, "tracer", None)
+    organisms = (int(np.asarray(world._prev_alive))
+                 if world._prev_alive is not None
+                 else (int(np.asarray(world.state.alive).sum())
+                       if world.state is not None else 0))
+    values = {
+        "avida_update": int(world.update),
+        "avida_organisms": organisms,
+        "avida_births_total": int(np.asarray(world._total_births)),
+        "avida_deaths_last_update": int(np.asarray(world._deaths_this)),
+        "avida_generation_avg": round(
+            float(np.asarray(world._last_ave_gen)), 4),
+        "avida_time": round(float(np.asarray(world._avida_time)), 6),
+        "avida_insts_total": int(world._flush_exec()),
+        "avida_preempted": int(bool(world.preempted or world._preempt)),
+        "avida_heartbeat_timestamp_seconds": round(time.time(), 3),
+    }
+    trace = None
+    if tracer is not None:
+        trace = (int(tracer.events_total), int(tracer.dropped_total),
+                 dict(tracer.code_totals))
+    return _render(values, trace)
+
+
+def _render(values: dict, trace) -> str:
+    """Exposition text from a resolved values dict (+ optional trace
+    counter triple (events_total, dropped_total, code_totals))."""
+    if trace is not None:
+        events_total, dropped_total, _ = trace
+        values = dict(values,
+                      avida_trace_events_total=events_total,
+                      avida_trace_dropped_total=dropped_total)
+    lines = []
+    for name, value in values.items():
+        kind, help_ = _HELP[name]
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name} {value}")
+    if trace is not None:
+        kind, help_ = _HELP["avida_trace_code_total"]
+        lines.append(f"# HELP avida_trace_code_total {help_}")
+        lines.append(f"# TYPE avida_trace_code_total {kind}")
+        for code, count in sorted(trace[2].items()):
+            lines.append(f'avida_trace_code_total{{code="{code}"}} {count}')
+    return "\n".join(lines) + "\n"
+
+
+def write_metrics(path: str, text: str, durable: bool = True):
+    """Atomic publish: a scraper never sees a half-written file.
+    `durable=False` skips the fsync -- the live chunk-boundary path uses
+    it so a per-update boundary (event-forced stretch=1) never pays disk
+    flush latency; the rename alone keeps the file torn-proof, and the
+    final exit/preempt heartbeat republishes durably anyway."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+        if durable:
+            f.flush()
+            os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_metrics(path: str) -> dict:
+    """Parse an exposition file back into {name or name{labels}: float}."""
+    out = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            name, _, value = line.rpartition(" ")
+            try:
+                out[name] = float(value)
+            except ValueError:
+                continue
+    return out
+
+
+def format_status(metrics: dict, now: float | None = None) -> str:
+    """Human-readable heartbeat digest of a metrics.prom dict."""
+    now = time.time() if now is None else now
+    hb = metrics.get("avida_heartbeat_timestamp_seconds")
+    age = f"{now - hb:.1f}s ago" if hb else "unknown"
+    lines = [
+        f"update      {int(metrics.get('avida_update', 0))}",
+        f"organisms   {int(metrics.get('avida_organisms', 0))}",
+        f"births      {int(metrics.get('avida_births_total', 0))}",
+        f"insts       {int(metrics.get('avida_insts_total', 0))}",
+        f"generation  {metrics.get('avida_generation_avg', 0.0):.2f}",
+        f"heartbeat   {age}",
+    ]
+    if "avida_trace_events_total" in metrics:
+        lines.append(
+            f"trace       "
+            f"{int(metrics['avida_trace_events_total'])} events, "
+            f"{int(metrics.get('avida_trace_dropped_total', 0))} dropped")
+    if metrics.get("avida_preempted"):
+        lines.append("preempted   yes (resume with --resume)")
+    return "\n".join(lines)
+
+
+def status_main(data_dir: str) -> int:
+    """`python -m avida_tpu --status DIR`: print the last heartbeat."""
+    path = os.path.join(data_dir, METRICS_FILE)
+    if not os.path.exists(path):
+        print(f"no {METRICS_FILE} under {data_dir!r} (run with "
+              f"TPU_METRICS=1 or TPU_TRACE=1)")
+        return 1
+    print(format_status(read_metrics(path)))
+    return 0
+
+
+class MetricsExporter:
+    """Owns the metrics.prom path for one World.  `export()` republishes
+    synchronously (run exit / preemption -- the values must be final);
+    `export_deferred()` is the live chunk-boundary path and never fences
+    the device."""
+
+    def __init__(self, world, path: str | None = None):
+        self.world = world
+        self.path = path or os.path.join(world.data_dir, METRICS_FILE)
+        self._pending = None
+
+    def export(self, world=None):
+        write_metrics(self.path, render_metrics(world or self.world))
+
+    def export_deferred(self, world=None):
+        """Chunk-boundary publish with the same one-chunk deferral as the
+        newborn/trace drains: capture the boundary's device scalars by
+        REFERENCE now (no readback -- resolving them would fence the
+        chunk just dispatched), publish the PREVIOUS boundary's capture,
+        whose chunk has long finished, so `np.asarray` there is a free
+        readback.  The heartbeat therefore lags live state by exactly one
+        chunk, inside the "within one chunk" freshness contract."""
+        w = world or self.world
+        prev, self._pending = self._pending, self._snapshot(w)
+        if prev is not None:
+            write_metrics(self.path, self._render_snapshot(prev),
+                          durable=False)
+
+    @staticmethod
+    def _snapshot(w) -> dict:
+        tracer = getattr(w, "tracer", None)
+        return {
+            "update": int(w.update),
+            "organisms": w._prev_alive,      # device refs: reassigned
+            "births": w._total_births,       # (not mutated) each chunk,
+            "deaths": w._deaths_this,        # so holding them is safe
+            "gen": w._last_ave_gen,
+            "time": w._avida_time,
+            # last host-flushed total: draining _pending_exec here would
+            # be the very fence this path exists to avoid
+            "insts": int(w._cum_insts),
+            "preempted": int(bool(w.preempted or w._preempt)),
+            "trace": ((int(tracer.events_total), int(tracer.dropped_total),
+                       dict(tracer.code_totals))
+                      if tracer is not None else None),
+        }
+
+    @staticmethod
+    def _render_snapshot(snap: dict) -> str:
+        values = {
+            "avida_update": snap["update"],
+            "avida_organisms": (int(np.asarray(snap["organisms"]))
+                                if snap["organisms"] is not None else 0),
+            "avida_births_total": int(np.asarray(snap["births"])),
+            "avida_deaths_last_update": int(np.asarray(snap["deaths"])),
+            "avida_generation_avg": round(
+                float(np.asarray(snap["gen"])), 4),
+            "avida_time": round(float(np.asarray(snap["time"])), 6),
+            "avida_insts_total": snap["insts"],
+            "avida_preempted": snap["preempted"],
+            "avida_heartbeat_timestamp_seconds": round(time.time(), 3),
+        }
+        return _render(values, snap["trace"])

@@ -6,7 +6,13 @@ runner the telemetry path uses -- scheduler draw, pack / kernel / unpack
 (Pallas path) or the XLA while_loop, birth flush -- plus the fused whole
 update for comparison.  Run on TPU:
 
-    python -m avida_tpu.observability.harness [world_side]
+    python -m avida_tpu.observability.harness [world_side] [reps] [--trace]
+
+`--trace` re-profiles with the flight recorder armed (params.trace_cap >
+0), so the phase table grows the `trace` row (the in-update ring-append
+cost) and a `trace_drain` line (the HOST cost of draining a full ring at
+a chunk boundary, measured by `measure_trace_drain` below -- bench.py's
+BENCH_TRACE=1 reports the same number as `trace_drain_ms`).
 
 bench.py calls `profile_phases` after its headline measurement to attach
 a `phases` breakdown to its JSON line.
@@ -62,6 +68,47 @@ def profile_phases(params, st, neighbors, key, reps=3, warmup=1,
     return {name: ms / reps for name, ms in acc.items()}, st, granted_total
 
 
+def measure_trace_drain(cap=4096, n_updates=16, reps=5):
+    """Host cost (ms) of one flight-recorder chunk-boundary drain at its
+    worst case: a FULL ring of `cap` events spread over `n_updates`
+    update labels, written as {"record": "trace"} lines to a throwaway
+    runlog.  Pure host work (numpy gather + JSONL append) -- measures the
+    per-boundary price of TPU_TRACE=1 beyond the in-update ring appends
+    (the `trace` phase in profile_phases)."""
+    import shutil
+    import tempfile
+    import time
+
+    import numpy as np
+
+    from avida_tpu.observability.tracer import EV_BIRTH, FlightRecorder
+
+    class _Stub:                      # the drain only touches data_dir
+        telemetry = None
+        _dat_append = False
+
+    stub = _Stub()
+    stub.data_dir = tempfile.mkdtemp(prefix="trace-drain-")
+    rec = FlightRecorder(stub)
+    ev = np.arange(cap, dtype=np.int32)
+    snap = {"tr_update": ev % max(n_updates, 1),
+            "tr_cell": ev % 997,
+            "tr_code": np.full(cap, EV_BIRTH, np.int32),
+            "tr_payload": ev,
+            "tr_count": np.int32(cap),
+            "update_at": n_updates, "host_events": []}
+    try:
+        rec.drain(dict(snap))          # warm the writer/open path
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            rec.drain(dict(snap))
+        ms = (time.perf_counter() - t0) * 1e3 / reps
+    finally:
+        rec.close()
+        shutil.rmtree(stub.data_dir, ignore_errors=True)
+    return ms
+
+
 def _timeit_chain(fn, st, key, u0, reps):
     """Mean wall time of the FUSED update over a chain of evolving states
     (distinct inputs per call; one fence at the end of the chain)."""
@@ -82,9 +129,21 @@ def main(argv=None):
     from bench import build
     from avida_tpu.ops.update import update_step, use_pallas_path
 
+    trace = "--trace" in argv
+    argv = [a for a in argv if not a.startswith("--")]
     world = int(argv[0]) if argv else 320
     reps = int(argv[1]) if len(argv) > 1 else 5
     params, st, neighbors, key = build(world, world, 256, seed=100)
+    if trace:
+        # arm the flight recorder: ring fields on the state, trace_pre/
+        # trace_post phases in the staged run (ops/update.py)
+        cap = 4096
+        params = params.replace(trace_cap=cap)
+        st = st.replace(tr_update=jnp.zeros(cap, jnp.int32),
+                        tr_cell=jnp.zeros(cap, jnp.int32),
+                        tr_code=jnp.zeros(cap, jnp.int32),
+                        tr_payload=jnp.zeros(cap, jnp.int32),
+                        tr_count=jnp.zeros((), jnp.int32))
     n = params.num_cells
     cap = params.max_steps_per_update or "uncapped"
     path = "pallas" if use_pallas_path(params) else "xla_while_loop"
@@ -112,6 +171,9 @@ def main(argv=None):
         st, k_run, 100, reps)
     print(f"{'full_step':12s} {t_full * 1e3:8.2f} ms   "
           f"({per_update / t_full / 1e6:.1f} M inst/s end-to-end fused)")
+    if trace:
+        print(f"{'trace_drain':12s} {measure_trace_drain():8.2f} ms   "
+              f"(host drain of a full 4096-event ring per chunk boundary)")
     return 0
 
 

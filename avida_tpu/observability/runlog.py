@@ -64,8 +64,9 @@ def trim_update_records(path: str, max_update: int):
     otherwise appear twice).  STRICT cutoff: update records are labeled
     with the index of the update being executed, so a checkpoint at
     update N owns records 0..N-1 and the resumed run re-emits from N.
-    Meta/event records carry no update number and are kept.  Atomic
-    rewrite; missing file is a no-op."""
+    Flight-recorder {"record": "trace"} lines carry the same per-update
+    labeling and trim identically.  Meta/event records carry no update
+    number and are kept.  Atomic rewrite; missing file is a no-op."""
     if not os.path.exists(path):
         return
     kept = []
@@ -77,7 +78,7 @@ def trim_update_records(path: str, max_update: int):
             except json.JSONDecodeError:
                 dropped += 1          # torn tail line from the crash
                 continue
-            if rec.get("record") == "update" \
+            if rec.get("record") in ("update", "trace") \
                     and int(rec.get("update", -1)) >= max_update:
                 dropped += 1
                 continue

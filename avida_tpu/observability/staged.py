@@ -25,7 +25,8 @@ import jax.numpy as jnp
 from avida_tpu.observability import counters as counters_mod
 from avida_tpu.ops.update import (bank_phase, birth_phase, interpret_phase,
                                   perm_phase, resource_phase, schedule_phase,
-                                  static_cap, use_pallas_path)
+                                  static_cap, trace_post_phase,
+                                  trace_pre_phase, use_pallas_path)
 
 
 class StagedUpdate:
@@ -82,6 +83,15 @@ class StagedUpdate:
                 self._interpret = jax.jit(
                     lambda st, k, g, mk: interpret_phase(
                         params, st, k, g, mk, cap))
+        # flight recorder (same phase functions the fused update_step
+        # gates on the static trace_cap -- staged stays bit-identical
+        # with the recorder on)
+        self.trace = int(getattr(params, "trace_cap", 0)) > 0
+        if self.trace:
+            self._trace_pre = jax.jit(
+                lambda st, g, u: trace_pre_phase(params, st, g, u))
+            self._trace_post = jax.jit(
+                lambda st, snap, u: trace_post_phase(params, st, snap, u))
         self._bank = jax.jit(
             lambda st, budgets, e0: bank_phase(params, st, budgets, e0))
         self._birth = jax.jit(
@@ -101,6 +111,10 @@ class StagedUpdate:
         budgets, granted, max_k = tl.run("schedule", self._schedule,
                                          st, k_budget)
         st = tl.run("schedule", self._perm, st, granted, update_no)
+        tsnap = None
+        if self.trace:
+            st, tsnap = tl.run("trace", self._trace_pre, st, granted,
+                               update_no)
         executed0 = st.insts_executed
         if self.pallas:
             packed = tl.run("pack", self._pack, st, granted)
@@ -113,4 +127,6 @@ class StagedUpdate:
         st, executed = tl.run("bank", self._bank, st, budgets, executed0)
         st = tl.run("birth_flush", self._birth, st, k_birth, k_steps,
                     update_no)
+        if self.trace:
+            st = tl.run("trace", self._trace_post, st, tsnap, update_no)
         return st, executed, dispatch, granted, alive_before

@@ -1026,3 +1026,17 @@ def flush_injections(params, st, key, neighbors):
         inject_pending=jnp.where(pend, False, st.inject_pending),
     )
     return st
+
+
+def birth_death_masks(alive_before, st, update_no):
+    """Per-cell (born, died) masks for the flight recorder
+    (observability/tracer.py; called from ops/update.trace_post_phase).
+    born = alive newborns the flush placed this update; died = cells
+    alive at the update's start that are now empty OR now hold this
+    update's newborn (the occupant was overwritten -- the reference's
+    birth-displacement death).  Matches the birth/death accounting the
+    telemetry counters and count.dat use (births = post-flush survivors;
+    deaths = alive_before + births - alive_after)."""
+    born = st.alive & (st.birth_update == update_no)
+    died = alive_before & (~st.alive | born)
+    return born, died

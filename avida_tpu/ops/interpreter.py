@@ -49,6 +49,7 @@ from avida_tpu.models.heads import (
     SEM_IF_MATE_MALE, SEM_IF_MATE_FEMALE,
     HEAD_IP, HEAD_READ, HEAD_WRITE, HEAD_FLOW, MAX_LABEL_SIZE,
 )
+from avida_tpu.core.state import WORLD_LEVEL_FIELDS as _WORLD_LEVEL_FIELDS
 from avida_tpu.ops import tasks as tasks_ops
 
 # packed-tape layout
@@ -869,13 +870,14 @@ def _exp_spatial(params, st, sem, operand, val, regs, setreg):
 
 
 # world-level / cell-bound fields that do NOT travel with a moving organism
-_NON_ORG_FIELDS = frozenset({
-    "inputs", "resources", "res_grid", "grad_peak",
-    "bc_mem", "bc_len", "bc_merit", "bc_valid",
-    "deme_birth_count", "deme_age", "germ_mem", "germ_len", "deme_resources",
-    "lane_perm", "lane_inv",
-    "nb_genome", "nb_len", "nb_cell", "nb_parent", "nb_update", "nb_count",
-})
+# cell-bound / world-level state that must NOT relocate with a moving
+# organism: the cell input stream plus every WORLD_LEVEL field (resource
+# pools, birth chamber, deme state, lane permutation, the newborn and
+# flight-recorder ring buffers) -- deriving from the state module's
+# authority keeps future world-level fields out of the move gather
+# automatically (a [CAP]-shaped ring with CAP == N would otherwise be
+# silently permuted)
+_NON_ORG_FIELDS = frozenset({"inputs"}) | _WORLD_LEVEL_FIELDS
 
 
 def _apply_attacks(params, st, pre, atk_ok, atk_tgt):
@@ -1320,3 +1322,21 @@ def _thread_substep(params, st, key, exec_mask, charge_time, rep,
         cur_thread=jnp.where(div, 0, st2.cur_thread),
         main_tid=jnp.where(div, 0, st2.main_tid),
     )
+
+
+def anomaly_masks(params, st):
+    """Audit-adjacent per-cell anomaly masks for the flight recorder
+    (observability/tracer.py; ops/update.trace_post_phase).  These mirror
+    the cheapest-to-explain invariants the auditor (utils/audit.py)
+    checks wholesale -- non-finite/negative merit on a living organism
+    and an instruction pointer outside [0, mem_len) after _adjust
+    semantics -- but attribute them to the CELL at the update they first
+    appear (trace_post_phase diffs these masks against the pre-update
+    snapshot), so a tripped audit at update N has per-cell forensics in
+    the runlog instead of only an aggregate count.  Returns
+    (bad_merit, bad_head, head_payload)."""
+    mlen = jnp.maximum(st.mem_len, 1)
+    bad_merit = st.alive & (~jnp.isfinite(st.merit) | (st.merit < 0))
+    ip = st.heads[:, 0]
+    bad_head = st.alive & ((ip < 0) | (ip >= mlen))
+    return bad_merit, bad_head, ip

@@ -26,16 +26,19 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from avida_tpu.models.transsmt import (
-    HEAD_FLOW, HEAD_IP, HEAD_READ, HEAD_WRITE, MAX_LABEL_SIZE,
-    SEM_ADD, SEM_DEC, SEM_DIV, SEM_DIVIDE, SEM_HEAD_MOVE, SEM_HEAD_POP,
-    SEM_HEAD_PUSH, SEM_IF_EQU, SEM_IF_GTR, SEM_IF_LESS, SEM_IF_NEQU,
-    SEM_INC, SEM_INJECT, SEM_IO, SEM_MOD, SEM_MULT, SEM_NAND, SEM_NOP,
-    SEM_PUSH_COMP, SEM_PUSH_NEXT, SEM_PUSH_PREV, SEM_READ, SEM_SEARCH,
-    SEM_SET_MEMORY, SEM_SHIFT_L, SEM_SHIFT_R, SEM_SUB, SEM_VAL_COPY,
-    SEM_VAL_DELETE, SEM_WRITE,
-    STACK_AX, STACK_BX,
-)
+from avida_tpu.models.transsmt import (HEAD_FLOW, HEAD_IP, HEAD_READ,
+                                       HEAD_WRITE, MAX_LABEL_SIZE, SEM_ADD,
+                                       SEM_DEC, SEM_DIV, SEM_DIVIDE,
+                                       SEM_HEAD_MOVE, SEM_HEAD_POP,
+                                       SEM_HEAD_PUSH, SEM_IF_EQU, SEM_IF_GTR,
+                                       SEM_IF_LESS, SEM_IF_NEQU, SEM_INC,
+                                       SEM_INJECT, SEM_IO, SEM_MOD, SEM_MULT,
+                                       SEM_NAND, SEM_PUSH_COMP, SEM_PUSH_NEXT,
+                                       SEM_PUSH_PREV, SEM_READ, SEM_SEARCH,
+                                       SEM_SET_MEMORY, SEM_SHIFT_L,
+                                       SEM_SHIFT_R, SEM_SUB, SEM_VAL_COPY,
+                                       SEM_VAL_DELETE, SEM_WRITE, STACK_AX,
+                                       STACK_BX)
 from avida_tpu.ops import tasks as tasks_ops
 
 MIN_INJECT_SIZE = 8      # nHardwareTransSMT MIN_INJECT_SIZE

@@ -167,6 +167,102 @@ def perm_phase(params, st, granted, update_no):
     return st.replace(lane_perm=p, lane_inv=inv)
 
 
+def _trace_append(params, st, mask, cells, code, payloads, update_no):
+    """Append one event per True lane of `mask` to the flight-recorder
+    ring (st.tr_*).  Slot = event_number % trace_cap, so overflow
+    overwrites the OLDEST events; the monotone tr_count cursor lets the
+    host recover the drop count -- no early sync, ever.  Masked-off
+    lanes scatter to index `cap`, which mode="drop" discards.  Pure
+    append-only side state: nothing downstream reads the ring, so the
+    evolved trajectory is independent of what lands here."""
+    cap = int(params.trace_cap)
+    m = mask.astype(jnp.int32)
+    offs = jnp.cumsum(m) - 1
+    total = m.sum()
+    # a single batch wider than the ring would scatter the same slot
+    # twice in one .at[].set (nondeterministic winner): pre-drop the
+    # batch's own oldest events so only the newest `cap` write -- the
+    # same drop-oldest semantics, decided before the scatter
+    keep = mask & (offs >= total - cap)
+    pos = jnp.where(keep, (st.tr_count + offs) % cap, cap).astype(jnp.int32)
+    return st.replace(
+        tr_update=st.tr_update.at[pos].set(update_no, mode="drop"),
+        tr_cell=st.tr_cell.at[pos].set(cells, mode="drop"),
+        tr_code=st.tr_code.at[pos].set(jnp.int32(code), mode="drop"),
+        tr_payload=st.tr_payload.at[pos].set(
+            payloads.astype(jnp.int32), mode="drop"),
+        tr_count=st.tr_count + total,
+    )
+
+
+def trace_pre_phase(params, st, granted, update_no):
+    """Flight recorder, first half (after schedule/perm, before the cycle
+    loop): emit the scheduler-stall event and snapshot what the post-
+    update emission diffs against.  Returns (st, snapshot dict).  Only
+    traced when params.trace_cap > 0 -- with the recorder off update_step
+    never calls this and its jaxpr is unchanged (scripts/check_jaxpr.py)."""
+    from avida_tpu.observability import tracer
+    from avida_tpu.ops.interpreter import anomaly_masks
+    n = granted.shape[0]
+    if use_pallas_path(params):
+        block = pallas_cycles.block_dims(params, n)[0]
+        g = granted[st.lane_perm] if int(params.lane_perm_k) > 0 else granted
+    else:
+        block = n                 # the XLA while_loop is one global block
+        g = granted
+    util = sched_ops.block_utilization(g, block)
+    st = _trace_append(
+        params, st,
+        (util < params.trace_stall_util)[None],
+        jnp.full((1,), -1, jnp.int32),
+        tracer.EV_SCHED_STALL,
+        jnp.round(util * 1e4).astype(jnp.int32)[None],
+        update_no)
+    bad_merit, bad_head, _ = anomaly_masks(params, st)
+    snap = {"alive": st.alive, "genotype_id": st.genotype_id,
+            "task_seen": st.task_exe_total > 0,
+            "bad_merit": bad_merit, "bad_head": bad_head}
+    return st, snap
+
+
+def trace_post_phase(params, st, snap, update_no):
+    """Flight recorder, second half (after the birth flush): births and
+    deaths (with ancestry payloads), first-time task triggers at the
+    cell, and audit-adjacent anomalies.  Append-only ring writes; see
+    trace_pre_phase for the disabled-path guarantee."""
+    from avida_tpu.observability import tracer
+    from avida_tpu.ops.interpreter import anomaly_masks
+    n = st.alive.shape[0]
+    cells = jnp.arange(n, dtype=jnp.int32)
+
+    born, died = birth_ops.birth_death_masks(snap["alive"], st, update_no)
+    st = _trace_append(params, st, born, cells, tracer.EV_BIRTH,
+                       st.parent_id, update_no)
+    st = _trace_append(params, st, died, cells, tracer.EV_DEATH,
+                       snap["genotype_id"], update_no)
+
+    # first execution of a task at this cell (task_exe_total is the
+    # per-cell lifetime counter, never reset): payload = bitmask of the
+    # newly first-executed task columns (capped at 31 bits)
+    new_task = (st.task_exe_total > 0) & ~snap["task_seen"]
+    R = min(int(params.num_reactions), 31)
+    bits = (new_task[:, :R].astype(jnp.int32)
+            * (jnp.int32(1) << jnp.arange(R, dtype=jnp.int32))[None, :]
+            ).sum(axis=1)
+    st = _trace_append(params, st, new_task[:, :R].any(axis=1), cells,
+                       tracer.EV_TASK_FIRST, bits, update_no)
+
+    # rising edge only (diff vs the pre-update masks): a persistent
+    # anomaly is one event at the update it appears, not one per update
+    bad_merit, bad_head, ip = anomaly_masks(params, st)
+    st = _trace_append(params, st, bad_merit & ~snap["bad_merit"], cells,
+                       tracer.EV_ANOM_MERIT, jnp.ones(n, jnp.int32),
+                       update_no)
+    st = _trace_append(params, st, bad_head & ~snap["bad_head"], cells,
+                       tracer.EV_ANOM_HEAD, ip, update_no)
+    return st
+
+
 def interpret_phase(params, st, k_steps, granted, max_k, cap, counters=None):
     """Run the update's lockstep cycles (Pallas kernel or XLA while_loop)
     plus the end-of-update offspring materialization.
@@ -290,6 +386,11 @@ def update_step(params, st, key, neighbors, update_no):
 
     st = perm_phase(params, st, granted, update_no)
 
+    # flight recorder (observability/tracer.py): Python-level gate on the
+    # static trace_cap, so the disabled path traces the IDENTICAL program
+    if params.trace_cap:
+        st, tsnap = trace_pre_phase(params, st, granted, update_no)
+
     executed0 = st.insts_executed
 
     st, _ = interpret_phase(params, st, k_steps, granted, max_k, cap)
@@ -297,6 +398,9 @@ def update_step(params, st, key, neighbors, update_no):
     st, executed = bank_phase(params, st, budgets, executed0)
 
     st = birth_phase(params, st, k_birth, k_steps, neighbors, update_no)
+
+    if params.trace_cap:
+        st = trace_post_phase(params, st, tsnap, update_no)
 
     return st, executed
 

@@ -23,7 +23,6 @@ migration (cMultiProcessWorld.cc:227-258).
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -56,7 +55,10 @@ _FIELD_SPECS = {"res_grid": P(None, CELL_AXIS), "resources": P(),
                 "germ_mem": P(), "germ_len": P(),
                 "deme_resources": P(),
                 "nb_genome": P(), "nb_len": P(), "nb_cell": P(),
-                "nb_parent": P(), "nb_update": P(), "nb_count": P()}
+                "nb_parent": P(), "nb_update": P(), "nb_count": P(),
+                # flight-recorder event ring: world-level, replicated
+                "tr_update": P(), "tr_cell": P(), "tr_code": P(),
+                "tr_payload": P(), "tr_count": P()}
 
 
 def shard_population(st, mesh: Mesh):
@@ -71,7 +73,7 @@ def shard_population(st, mesh: Mesh):
     placed = {
         name: jax.device_put(
             a, NamedSharding(mesh, _FIELD_SPECS.get(name, P(CELL_AXIS))))
-        for name, a in fields.items()
+        for name, a in fields.items() if a is not None
     }
     return st.replace(**placed)
 

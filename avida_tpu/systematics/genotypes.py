@@ -17,7 +17,7 @@ which is exactly the information order the flush-births scatter defines.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 

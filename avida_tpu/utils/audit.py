@@ -50,7 +50,9 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-import numpy as np
+
+
+from avida_tpu.observability.tracer import DEVICE_MAX_CODE as _TRACE_MAX_CODE
 
 
 class StateInvariantError(AssertionError):
@@ -132,6 +134,19 @@ def audit_state(params, st):
                              | (st.off_start < 0) | (st.off_start >= L)))
     checks["nb_count_nonneg"] = jnp.where(st.nb_count < 0, 1, 0
                                           ).astype(jnp.int32)
+
+    if st.tr_count is not None:
+        # flight-recorder ring (observability/tracer.py): the cursor is
+        # monotone-nonnegative, and every LIVE slot (index < min(count,
+        # cap) -- rows past the cursor are drain scratch) holds a known
+        # event code and an in-range cell (-1 = world-level event)
+        cap = st.tr_code.shape[0]
+        live = jnp.arange(cap) < jnp.clip(st.tr_count, 0, cap)
+        checks["trace_cursor_nonneg"] = jnp.where(
+            st.tr_count < 0, 1, 0).astype(jnp.int32)
+        checks["trace_ring_valid"] = rows(
+            live & ((st.tr_code < 1) | (st.tr_code > _TRACE_MAX_CODE)
+                    | (st.tr_cell < -1) | (st.tr_cell >= n)))
     return checks
 
 

@@ -310,6 +310,9 @@ def _host_snapshot(world) -> dict:
             "task_prev": np.asarray(tel._task_prev, np.int64).tolist(),
             "updates_run": int(tel._updates_run),
         }
+    trc = getattr(world, "tracer", None)
+    if trc is not None:
+        host["tracer"] = trc.to_snapshot()
     return host
 
 
@@ -348,6 +351,11 @@ def _host_restore(world, host: dict):
         # mirroring the .dat append mode -- not truncated by mode "w"
         if os.path.exists(os.path.join(world.data_dir, "telemetry.jsonl")):
             tel._log_opened = True
+    trc = getattr(world, "tracer", None)
+    if trc is not None:
+        # restore drain counters + arm runlog append (resume continuity)
+        trc.from_snapshot(host.get("tracer") or {})
+        world._trace_pending = None
 
 
 def save_checkpoint(base_dir: str, world) -> str:
@@ -362,8 +370,14 @@ def save_checkpoint(base_dir: str, world) -> str:
     st = world.state
     if st is None:
         raise CheckpointError("no population state to checkpoint")
+    # None-valued fields (the flight-recorder ring with the recorder
+    # off) are empty pytrees with no on-disk representation; with the
+    # recorder ON the ring IS serialized -- drained (cursor 0) because
+    # World.save_checkpoint flushes the trace first, so a restored ring
+    # never replays stale events
     arrays = {_STATE_PREFIX + name: np.asarray(getattr(st, name))
-              for name in state_field_names()}
+              for name in state_field_names()
+              if getattr(st, name) is not None}
     arrays["prng.key"] = np.asarray(jax.random.key_data(world.key))
     arrays["prng.run_key"] = np.asarray(jax.random.key_data(world._run_key))
     host = _host_snapshot(world)
@@ -378,20 +392,39 @@ def save_checkpoint(base_dir: str, world) -> str:
 
 def _build_state(world, arrays: dict):
     """Reassemble a PopulationState from a generation's array dict,
-    checking field-set and world-shape compatibility."""
+    checking field-set and world-shape compatibility.  The flight-
+    recorder ring fields are config-dependent (None when the recorder is
+    off) and reconciled to THIS world's TPU_TRACE config rather than
+    failing the field-set check: every checkpoint's ring is drained
+    (cursor 0), so seeding a fresh empty ring on a cap change loses
+    nothing."""
     import jax.numpy as jnp
-    from avida_tpu.core.state import PopulationState, state_field_names
+    from avida_tpu.core.state import (TRACE_RING_FIELDS, PopulationState,
+                                      state_field_names)
 
     fields = list(state_field_names())
     have = {k[len(_STATE_PREFIX):] for k in arrays if k.startswith(_STATE_PREFIX)}
-    missing = [f for f in fields if f not in have]
+    missing = [f for f in fields if f not in have
+               and f not in TRACE_RING_FIELDS]
     extra = sorted(have - set(fields))
     if missing or extra:
         raise CheckpointMismatchError(
             f"checkpoint state fields do not match this build "
             f"(missing {missing[:4]}, unknown {extra[:4]})")
-    st = PopulationState(**{
-        name: jnp.asarray(arrays[_STATE_PREFIX + name]) for name in fields})
+    vals = {name: (jnp.asarray(arrays[_STATE_PREFIX + name])
+                   if _STATE_PREFIX + name in arrays else None)
+            for name in fields}
+    cap = int(world.params.trace_cap)
+    if cap == 0:
+        for name in TRACE_RING_FIELDS:
+            vals[name] = None
+    elif vals["tr_code"] is None or vals["tr_code"].shape[0] != cap:
+        vals.update(tr_update=jnp.zeros(cap, jnp.int32),
+                    tr_cell=jnp.zeros(cap, jnp.int32),
+                    tr_code=jnp.zeros(cap, jnp.int32),
+                    tr_payload=jnp.zeros(cap, jnp.int32),
+                    tr_count=jnp.zeros((), jnp.int32))
+    st = PopulationState(**vals)
     p = world.params
     if st.alive.shape != (p.num_cells,) \
             or st.tape.shape != (p.num_cells, p.max_memory):

@@ -21,7 +21,7 @@ from avida_tpu.config import (AvidaConfig, load_avida_cfg, load_instset,
                               pred_look_instset,
                               load_organism, load_environment, load_events)
 from avida_tpu.config.environment import default_logic9_environment
-from avida_tpu.config.events import Event, parse_event_line
+from avida_tpu.config.events import parse_event_line
 from avida_tpu.core.state import (init_population, make_world_params,
                                   PopulationState)
 from avida_tpu.ops import birth as birth_ops
@@ -229,6 +229,25 @@ class World:
                 self, profile_dir=(pdir if pdir not in ("-", "") else None),
                 profile_updates=int(cfg.get("TPU_PROFILE_UPDATES", 3)))
 
+        # device-side flight recorder (observability/tracer.py): with
+        # TPU_TRACE=1 the jitted update appends structured events to
+        # in-state ring buffers, drained to {"record":"trace"} runlog
+        # lines only at update-chunk boundaries.  With it off (default)
+        # the ring fields are None (empty pytrees) and update_step traces
+        # the byte-identical program (scripts/check_jaxpr.py).
+        self.tracer = None
+        self._trace_pending = None   # deferred ring snapshot (run pipeline)
+        if self.params.trace_cap:
+            from avida_tpu.observability.tracer import FlightRecorder
+            self.tracer = FlightRecorder(self)
+
+        # metrics.prom heartbeat (observability/exporter.py): rewritten
+        # atomically at chunk boundaries; implied by the flight recorder
+        self.exporter = None
+        if int(cfg.get("TPU_METRICS", 0)) or self.tracer is not None:
+            from avida_tpu.observability.exporter import MetricsExporter
+            self.exporter = MetricsExporter(self)
+
         # offspring reversion/sterilization via the batched Test CPU
         # (cHardwareBase::Divide_TestFitnessMeasures cc:866); fitness
         # lookups memoize per genotype (systematics/test_metrics.py)
@@ -304,7 +323,6 @@ class World:
         st = self.state
         full = jnp.ones(n, bool)
         self.key, k = jax.random.split(self.key)
-        from avida_tpu.core.state import make_cell_inputs
         from avida_tpu.ops.demes import _clone_reset
         genome_t = jnp.broadcast_to(jnp.asarray(gm)[None, :], (n, L))
         updates = _clone_reset(
@@ -858,6 +876,16 @@ class World:
         sterilize = u[1] < probs[cat, 1]
         # fatal reversions with no parent genome left are refused outright
         kill_fallback = want_revert & ~parent_ok & (cat == 0)
+        if self.tracer is not None:
+            # host-side flight-recorder events: reversion/sterilization
+            # firings (merged into the next drain's per-update records)
+            from avida_tpu.observability.tracer import EV_REVERT, EV_STERILIZE
+            for c, pc in zip(cells[revert], parents[revert]):
+                self.tracer.record_host_event(self.update, int(c),
+                                              EV_REVERT, int(pc))
+            for c, cc in zip(cells[sterilize], cat[sterilize]):
+                self.tracer.record_host_event(self.update, int(c),
+                                              EV_STERILIZE, int(cc))
         if not (revert.any() or sterilize.any() or kill_fallback.any()):
             return
         new_st = st
@@ -960,6 +988,20 @@ class World:
         snap, self._nb_pending = self._nb_pending, None
         if snap is not None:
             self._feed_systematics(snap)
+
+    def _flush_trace(self):
+        """Drain the deferred flight-recorder snapshot AND the live ring
+        NOW (a host sync point): run exit, preemption, checkpoint save --
+        the runlog must hold every event up to the boundary before the
+        state (with its zeroed cursor) is serialized or the process
+        exits."""
+        if self.tracer is None:
+            return
+        prev, self._trace_pending = self._trace_pending, None
+        if prev is not None:
+            self.tracer.drain(prev)
+        if self.state is not None:
+            self.tracer.drain(self.tracer.snapshot(self))
 
     def _events_fire_now(self) -> bool:
         """Does any event fire at the CURRENT update?  (Generation/births
@@ -1103,8 +1145,11 @@ class World:
             raise ValueError(
                 "no checkpoint directory (set TPU_CKPT_DIR or pass one)")
         # the systematics snapshot must be current: ingest any deferred
-        # newborn drain (host sync) before serializing
+        # newborn drain (host sync) before serializing; likewise the
+        # flight-recorder ring drains to the runlog first, so the saved
+        # cursor is 0 and a resume never replays stale events
         self._flush_newborn_drain()
+        self._flush_trace()
         if audit:
             from avida_tpu.utils.audit import check_invariants
             check_invariants(self.params, self.state,
@@ -1207,6 +1252,20 @@ class World:
                     self._flush_newborn_drain()
                     self._pending_exec.append(self.run_update())
                     self.update += 1
+                if self.tracer is not None:
+                    # flight-recorder drain, same deferred pipeline as the
+                    # newborn snapshot: copy this boundary's ring device-
+                    # side (async), host-ingest the PREVIOUS boundary's
+                    # snapshot while the next chunk runs
+                    prev_t, self._trace_pending = (self._trace_pending,
+                                                   self.tracer.snapshot(self))
+                    if prev_t is not None:
+                        self.tracer.drain(prev_t)
+                if self.exporter is not None:
+                    # deferred (publishes the PREVIOUS boundary's values):
+                    # a synchronous export here would fence the chunk
+                    # just dispatched and defeat the zero-sync pipeline
+                    self.exporter.export_deferred(self)
                 if len(self._pending_exec) >= 256:
                     self._flush_exec()
                 if self.systematics is not None and self.update % 100 == 0:
@@ -1228,9 +1287,12 @@ class World:
             # host view -- neither runs after an exception (the state may
             # be mid-mutation), but the finally below still closes writers
             self._flush_newborn_drain()
+            self._flush_trace()
             if self._preempt and ckpt_base and self.state is not None:
                 self.save_checkpoint(ckpt_base)
             self.preempted = self._preempt
+            if self.exporter is not None and self.state is not None:
+                self.exporter.export(self)    # final heartbeat (preempted=1)
         finally:
             import signal as _signal
             for s, h in handlers.items():
@@ -1253,6 +1315,16 @@ class World:
                     self.telemetry.close()
                 except Exception:
                     pass
+            if self.tracer is not None:
+                try:
+                    self.tracer.close()
+                except Exception:
+                    pass
+            # a SECOND run() on this world must extend its own .dat files,
+            # not truncate them: every file handle was just closed, so any
+            # reopen (same action, same path) now arms append mode --
+            # single header, continuous rows (the PR-4 known wart)
+            self._dat_append = True
         return self._flush_exec() - start_insts
 
     @property

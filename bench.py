@@ -31,8 +31,7 @@ BASELINE_INST_PER_SEC = 1e8
 
 def build(world_x, world_y, max_memory, seed):
     from avida_tpu.config import AvidaConfig
-    from avida_tpu.core.state import (init_population, make_world_params,
-                                      zeros_population, make_cell_inputs)
+    from avida_tpu.core.state import zeros_population, make_cell_inputs
     from avida_tpu.ops import birth as birth_ops
     from avida_tpu.world import World, default_ancestor
 
@@ -128,7 +127,7 @@ def kernel_facts(params, st):
     under the CURRENT lane permutation (1.0 = no lockstep tail waste)."""
     from avida_tpu.ops import scheduler as sched_ops
     from avida_tpu.ops.pallas_cycles import block_dims, kernel_shards
-    from avida_tpu.ops.update import schedule_phase, use_pallas_path
+    from avida_tpu.ops.update import use_pallas_path
 
     pallas = bool(use_pallas_path(params))
     block = block_dims(params, params.num_cells)[0] if pallas \
@@ -194,6 +193,9 @@ def main():
     line.update(kernel_facts(params, st))
     if os.environ.get("BENCH_CKPT", "0") == "1":
         line.update(ckpt_audit_overhead(params, st))
+    if os.environ.get("BENCH_TRACE", "0") == "1":
+        line.update(trace_overhead_fields(world if on_tpu else 30,
+                                          updates=64 if on_tpu else 16))
     if os.environ.get("BENCH_PHASES", "1") != "0":
         line["phases"] = phase_breakdown(world)
     print(json.dumps(line))
@@ -219,8 +221,11 @@ def ckpt_audit_overhead(params, st):
     jax.block_until_ready(audit_state(params, st))
     audit_ms = (time.perf_counter() - t0) * 1e3
 
+    # None-valued fields (the flight-recorder ring with TPU_TRACE off)
+    # have no on-disk representation (utils/checkpoint.save_checkpoint)
     arrays = {f"state.{name}": np.asarray(getattr(st, name))
-              for name in state_field_names()}
+              for name in state_field_names()
+              if getattr(st, name) is not None}
     tmp = tempfile.mkdtemp(prefix="bench-ckpt-")
     try:
         t0 = time.perf_counter()
@@ -231,6 +236,59 @@ def ckpt_audit_overhead(params, st):
         shutil.rmtree(tmp, ignore_errors=True)
     return {"ckpt_save_ms": round(ckpt_ms, 2),
             "audit_ms": round(audit_ms, 2)}
+
+
+def trace_overhead_fields(world, updates=64, seed=100):
+    """BENCH_TRACE=1: the observability tax in the perf trajectory.  The
+    SAME world is run end-to-end through World.run three ways -- plain,
+    with the flight recorder (TPU_TRACE=1), and with full telemetry
+    (TPU_TELEMETRY=1, which forces per-update phase fencing) -- each
+    timed over `updates` updates after a short warm run so compile time
+    stays out of the comparison.  Emits:
+
+      trace_drain_ms          host cost of draining a FULL 4096-event
+                              ring at one chunk boundary
+                              (observability/harness.measure_trace_drain)
+      trace_overhead_pct      wall overhead of TPU_TRACE=1 vs plain (the
+                              in-update ring appends + boundary drains)
+      telemetry_overhead_pct  wall overhead of TPU_TELEMETRY=1 vs plain
+                              (staged phase fencing; the price of the
+                              full per-update runlog)
+
+    Measured after -- and without perturbing -- the headline numbers."""
+    import shutil
+    import tempfile
+
+    from avida_tpu.observability.harness import measure_trace_drain
+    from avida_tpu.world import World
+
+    # warm segment == timed segment length: the chunked plain path
+    # compiles one scanned program per power-of-two stretch bucket, and
+    # the event cadence is periodic, so an equal-length warm run visits
+    # the same buckets the timed segment will -- otherwise their compiles
+    # land inside the plain timing and the overhead pcts go negative
+    warm = updates
+
+    def timed_run(extra):
+        d = tempfile.mkdtemp(prefix="bench-trace-")
+        try:
+            w = World(overrides=[("WORLD_X", world), ("WORLD_Y", world),
+                                 ("RANDOM_SEED", seed)] + extra,
+                      data_dir=d)
+            w.run(max_updates=warm)               # compile + ramp
+            t0 = time.perf_counter()
+            w.run(max_updates=warm + updates)
+            return time.perf_counter() - t0
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+    t_plain = timed_run([])
+    t_trace = timed_run([("TPU_TRACE", 1)])
+    t_tel = timed_run([("TPU_TELEMETRY", 1)])
+    pct = lambda t: round((t - t_plain) / t_plain * 100, 2)  # noqa: E731
+    return {"trace_drain_ms": round(measure_trace_drain(), 3),
+            "trace_overhead_pct": pct(t_trace),
+            "telemetry_overhead_pct": pct(t_tel)}
 
 
 def phase_breakdown(world, reps=2, seed=100):

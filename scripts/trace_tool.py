@@ -1,0 +1,238 @@
+"""Runlog <-> Chrome/Perfetto trace converter (flight recorder + Timeline).
+
+Usage:
+    python scripts/trace_tool.py to-chrome telemetry.jsonl [-o trace.json]
+    python scripts/trace_tool.py from-chrome trace.json    [-o trace.jsonl]
+    python scripts/trace_tool.py summary   telemetry.jsonl
+
+`to-chrome` renders a run's telemetry.jsonl -- the per-update phase
+wall-time records ({"record": "update"}, PR 1's Timeline) and the
+flight-recorder event records ({"record": "trace"}, observability/
+tracer.py) -- as a Chrome trace-event JSON that chrome://tracing and
+ui.perfetto.dev open directly:
+
+  - each update becomes a frame on a synthetic timeline whose clock is
+    the SUM of recorded update wall times (host gaps between updates are
+    not update work and are excluded, matching the runlog's own wall_ms
+    semantics); phase brackets (schedule / pack / kernel / birth_flush /
+    events_io ...) are complete events ("ph": "X") on per-phase rows;
+  - flight-recorder events (births, deaths, first task triggers,
+    scheduler stalls, anomalies) are instant events ("ph": "i") at their
+    update's frame start, one Chrome thread row per event code, with
+    cell/payload in args.
+
+Runs without phase records (TPU_TRACE=1 but telemetry off) still
+convert: updates with only trace events get a nominal frame length so
+the event timeline stays ordered and zoomable.
+
+`from-chrome` inverts the instant events back into {"record": "trace"}
+JSONL (grouped per update, sorted) -- the same shape the FlightRecorder
+drain appends, including the ring-overflow "dropped" counter (carried
+through the trace as "trace_dropped" markers) -- so a trace.json edited
+or filtered in the Perfetto UI can be re-ingested by runlog tooling.
+Phase rows do not round-trip (the runlog's per-update phase dict is the
+source of truth for those).
+
+`summary` prints per-code event totals and the per-update event rate,
+the quick "what happened in this run" view.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _repo_path():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+
+
+_repo_path()
+
+from avida_tpu.observability.tracer import EVENT_CODES  # noqa: E402
+
+_CODE_BY_NAME = {name: code for code, name in EVENT_CODES.items()}
+
+# Chrome trace tid layout: update frames on tid 1, one row per event
+# code from 10, one row per phase name from 100
+_PHASE_TID = 1
+_EVENT_TID_BASE = 10
+_PHASE_ROW_BASE = 100
+
+# nominal frame for updates that carry events but no phase record
+# (telemetry off): 1 ms keeps the timeline ordered and zoomable
+_NOMINAL_MS = 1.0
+
+
+def read_runlog(path: str):
+    """(updates, traces, meta, drops): per-update phase records,
+    per-update flight-recorder event lists, the meta record (or {}),
+    and per-update ring-overflow drop counts."""
+    updates, traces, meta = {}, {}, {}
+    drops = {}
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue                    # torn tail from a crash
+            kind = rec.get("record")
+            if kind == "update":
+                updates[int(rec["update"])] = rec
+            elif kind == "trace":
+                u = int(rec["update"])
+                traces.setdefault(u, []).extend(rec.get("events", []))
+                if rec.get("dropped"):
+                    drops[u] = drops.get(u, 0) + int(rec["dropped"])
+            elif kind == "meta":
+                meta = rec
+    return updates, traces, meta, drops
+
+
+def to_chrome(path: str) -> dict:
+    """Chrome trace-event dict for a telemetry.jsonl runlog."""
+    updates, traces, meta, drops = read_runlog(path)
+    events = []
+    pid = 1
+    events.append({"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                   "args": {"name": "avida-tpu run"}})
+    events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                   "tid": _PHASE_TID, "args": {"name": "updates"}})
+    tids = {}
+    for code in sorted(EVENT_CODES):
+        tid = _EVENT_TID_BASE + code
+        tids[code] = tid
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": tid,
+                       "args": {"name": f"trace:{EVENT_CODES[code]}"}})
+    phase_tids = {}
+    for rec in updates.values():
+        for phase in (rec.get("phases") or {}):
+            if phase not in phase_tids:
+                tid = _PHASE_ROW_BASE + len(phase_tids)
+                phase_tids[phase] = tid
+                events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                               "tid": tid,
+                               "args": {"name": f"phase:{phase}"}})
+
+    cursor_us = 0.0
+    for u in sorted(set(updates) | set(traces)):
+        rec = updates.get(u)
+        wall_ms = float(rec.get("wall_ms", _NOMINAL_MS)) if rec \
+            else _NOMINAL_MS
+        start_us = cursor_us
+        events.append({
+            "name": f"update {u}", "ph": "X", "pid": pid, "tid": _PHASE_TID,
+            "ts": start_us, "dur": wall_ms * 1e3,
+            "args": (rec or {}).get("counters", {}),
+        })
+        t = start_us
+        for phase, ms in ((rec or {}).get("phases") or {}).items():
+            events.append({"name": phase, "ph": "X", "pid": pid,
+                           "tid": phase_tids[phase], "ts": t, "dur": ms * 1e3,
+                           "args": {"update": u}})
+            t += ms * 1e3
+        if u in drops:
+            events.append({"name": "trace_dropped", "ph": "i", "pid": pid,
+                           "tid": _PHASE_TID, "ts": start_us, "s": "t",
+                           "args": {"update": u, "dropped": drops[u]}})
+        for cell, code, payload in traces.get(u, ()):
+            events.append({
+                "name": EVENT_CODES.get(code, f"code{code}"), "ph": "i",
+                "pid": pid, "tid": tids.get(code, _EVENT_TID_BASE),
+                "ts": start_us, "s": "t",
+                "args": {"update": u, "cell": cell, "payload": payload},
+            })
+        cursor_us += wall_ms * 1e3
+    out = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if meta:
+        out["otherData"] = {k: meta[k] for k in
+                            ("seed", "world", "platform", "interpret_path")
+                            if k in meta}
+    return out
+
+
+def from_chrome(path: str):
+    """Invert a to-chrome trace.json's instant events back into
+    {"record": "trace"} JSONL records (list of dicts, update-sorted)."""
+    with open(path) as f:
+        doc = json.load(f)
+    per_update = {}
+    drops = {}
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") != "i":
+            continue
+        args = ev.get("args") or {}
+        if "update" not in args:
+            continue
+        u = int(args["update"])
+        if ev.get("name") == "trace_dropped":
+            drops[u] = drops.get(u, 0) + int(args.get("dropped", 0))
+            continue
+        code = _CODE_BY_NAME.get(ev.get("name"))
+        if code is None:
+            continue
+        per_update.setdefault(u, []).append(
+            [int(args.get("cell", -1)), code, int(args.get("payload", 0))])
+    recs = []
+    for u in sorted(set(per_update) | set(drops)):
+        rec = {"record": "trace", "update": u,
+               "events": per_update.get(u, [])}
+        if u in drops:
+            rec["dropped"] = drops[u]
+        recs.append(rec)
+    return recs
+
+
+def summary(path: str) -> str:
+    updates, traces, _, drops = read_runlog(path)
+    totals = {}
+    for evs in traces.values():
+        for _, code, _ in evs:
+            name = EVENT_CODES.get(code, f"code{code}")
+            totals[name] = totals.get(name, 0) + 1
+    n_ev = sum(totals.values())
+    span = (max(traces) - min(traces) + 1) if traces else 0
+    lines = [f"updates with phase records: {len(updates)}",
+             f"updates with trace events:  {len(traces)} (span {span})",
+             f"events total:               {n_ev}"]
+    if drops:
+        lines.append(f"events dropped (overflow):  {sum(drops.values())}")
+    for name in sorted(totals, key=totals.get, reverse=True):
+        lines.append(f"  {name:<12} {totals[name]}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("mode", choices=["to-chrome", "from-chrome", "summary"])
+    p.add_argument("path")
+    p.add_argument("-o", "--out", default=None)
+    args = p.parse_args(argv)
+
+    if args.mode == "summary":
+        print(summary(args.path))
+        return 0
+    if args.mode == "to-chrome":
+        doc = to_chrome(args.path)
+        out = args.out or os.path.splitext(args.path)[0] + ".trace.json"
+        with open(out, "w") as f:
+            json.dump(doc, f)
+        print(f"{out}: {len(doc['traceEvents'])} trace events "
+              f"(open in chrome://tracing or ui.perfetto.dev)")
+        return 0
+    recs = from_chrome(args.path)
+    out = args.out or os.path.splitext(args.path)[0] + ".trace.jsonl"
+    with open(out, "w") as f:
+        for rec in recs:
+            f.write(json.dumps(rec) + "\n")
+    print(f"{out}: {len(recs)} trace records")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

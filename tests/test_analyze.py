@@ -6,7 +6,6 @@ _analyze_detail_all): LOAD a .spop, RECALCULATE, DETAIL, TRACE, knockouts.
 
 import os
 
-import numpy as np
 import pytest
 
 from avida_tpu.analyze.analyzer import Analyzer, AnalyzeGenotype
@@ -147,7 +146,6 @@ def test_align_map_lineage_recombine(setup, tmp_path):
 def test_analyze_modularity(tmp_path):
     """ANALYZE_MODULARITY (cModularityAnalysis::CalcFunctionalModularity):
     knockout-based task-site attribution on a task-performing genotype."""
-    import numpy as np
     from avida_tpu.analyze.analyzer import Analyzer, AnalyzeGenotype
     from avida_tpu.config.instset import default_instset
     from avida_tpu.config.environment import default_logic9_environment

@@ -18,7 +18,6 @@ from avida_tpu.config.instset import default_instset
 from avida_tpu.config.environment import default_logic9_environment
 from avida_tpu.core.state import make_world_params, zeros_population
 from avida_tpu.ops.interpreter import micro_step, random_inst, extract_offspring
-from avida_tpu.world import World, default_ancestor
 
 
 def _params(instset=None, **cfg_kw):

@@ -65,8 +65,7 @@ def test_predicate_gated_replication():
 
 def test_predicate_action_via_world(tmp_path):
     """End-to-end: the predicate action + sat-deme-predicate event."""
-    import shutil
-    from avida_tpu.world import World, parse_event_line
+    from avida_tpu.world import World
     d = tmp_path / "cfg"
     d.mkdir()
     (d / "avida.cfg").write_text(

@@ -12,9 +12,7 @@ Round-4 fix for parsed-but-ignored config vars (VERDICT r3 weak #5):
 
 from __future__ import annotations
 
-import jax
 import numpy as np
-import pytest
 
 from avida_tpu.config import AvidaConfig
 from avida_tpu.world import World

@@ -12,7 +12,6 @@ Covers the round-4 cHardwareExperimental core (VERDICT r3 directive #3):
 from __future__ import annotations
 
 import numpy as np
-import pytest
 
 from avida_tpu.config import AvidaConfig
 from avida_tpu.world import World

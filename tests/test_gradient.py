@@ -10,7 +10,6 @@ plateau depletion).
 
 from __future__ import annotations
 
-import os
 
 import numpy as np
 

@@ -8,7 +8,6 @@ from __future__ import annotations
 import numpy as np
 import jax
 import jax.numpy as jnp
-import pytest
 
 from avida_tpu.config import AvidaConfig
 from avida_tpu.config.instset import heads_sex_instset

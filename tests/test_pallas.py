@@ -14,15 +14,13 @@ on TPU (bench.py measures through it).
 
 from __future__ import annotations
 
-import dataclasses
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from avida_tpu.config import AvidaConfig
-from avida_tpu.ops.update import update_step, use_pallas_path
+from avida_tpu.ops.update import use_pallas_path
 from avida_tpu.world import World
 
 pytestmark = pytest.mark.slow

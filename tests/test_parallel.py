@@ -58,8 +58,7 @@ def _state_arrays(st):
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
 def test_sharded_matches_unsharded_bitexact():
-    from avida_tpu.parallel import (make_mesh, replicate, shard_neighbors,
-                                    shard_population)
+    from avida_tpu.parallel import make_mesh, shard_neighbors, shard_population
 
     # 8x16 world: 16 rows over 8 devices = 2-row bands per device
     params, st0, neighbors = _build(8, 16)

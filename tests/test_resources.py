@@ -4,7 +4,6 @@ Scenario model: the reference's resources_9r consistency test (logic-9 with
 nine depletable pools) and spatial_res_100u (diffusing grid resource).
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

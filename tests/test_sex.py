@@ -17,7 +17,7 @@ import pytest
 from avida_tpu.config import AvidaConfig, heads_sex_instset
 from avida_tpu.core.state import make_world_params, zeros_population
 from avida_tpu.ops import birth as birth_ops
-from avida_tpu.world import World, default_ancestor
+from avida_tpu.world import World
 
 pytestmark = pytest.mark.slow
 

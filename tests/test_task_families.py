@@ -9,7 +9,6 @@ from __future__ import annotations
 
 import numpy as np
 import jax.numpy as jnp
-import pytest
 
 from avida_tpu.config.environment import (LOGIC_TASKS, Environment, Reaction,
                                           Process, load_environment)

@@ -1,0 +1,489 @@
+"""Flight recorder + metrics exporter (observability/tracer.py,
+observability/exporter.py, ops/update.trace_pre_phase/trace_post_phase).
+
+The contract under test, in order of importance:
+
+ - OFF is free: the default config carries no ring arrays (None fields,
+   empty pytrees) -- the jaxpr gate itself is tests/test_jaxpr_snapshot.
+ - ON is invisible to evolution: bit-identical trajectories with
+   TPU_TRACE=1 vs off, on the XLA path and the lane-packed Pallas path
+   (slow tier).
+ - Overflow drops the OLDEST events and counts the drops; it never
+   forces an early sync.
+ - A SIGTERM-preempted run's checkpoint + runlog hold the drained trace
+   up to the last chunk boundary, and the resumed run continues
+   bit-exactly with the recorder still on (slow tier).
+ - metrics.prom / --status reflect a LIVE run within one chunk of real
+   time (polled from a second thread while the run owns the device).
+
+Satellite regressions ride along: runlog trim edge cases (torn tail,
+strict cutoff, header-only file), the run()-twice .dat truncation wart,
+and the scripts/trace_tool.py Chrome-trace round-trip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+# ring rows are drain scratch past the cursor (zero after any boundary
+# drain), exactly like the newborn ring: compare only live rows
+_SCRATCH_ROWS = ("nb_genome", "nb_len", "nb_cell", "nb_parent", "nb_update",
+                 "tr_update", "tr_cell", "tr_code", "tr_payload")
+
+
+def _assert_states_equal(sa, sb):
+    for name in sa.__dataclass_fields__:
+        va, vb = np.asarray(getattr(sa, name)), np.asarray(getattr(sb, name))
+        if name in _SCRATCH_ROWS:
+            cnt_field = "nb_count" if name.startswith("nb_") else "tr_count"
+            cnt = int(np.asarray(getattr(sa, cnt_field)))
+            va, vb = va[:cnt], vb[:cnt]
+        np.testing.assert_array_equal(va, vb, err_msg=f"field {name}")
+
+
+def _world(tmpdir, seed=11, trace=0, pallas=False, extra=()):
+    from avida_tpu.config import AvidaConfig
+    from avida_tpu.world import World
+
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 8
+    cfg.WORLD_Y = 8
+    cfg.TPU_MAX_MEMORY = 256
+    cfg.RANDOM_SEED = seed
+    cfg.AVE_TIME_SLICE = 100
+    cfg.TPU_MAX_STEPS_PER_UPDATE = 100
+    if trace:
+        cfg.set("TPU_TRACE", 1)
+        cfg.set("TPU_TRACE_CAP", 512)
+    if pallas:
+        cfg.TPU_USE_PALLAS = 1        # interpret mode on CPU
+        cfg.COPY_MUT_PROB = 0.0
+        cfg.DIVIDE_INS_PROB = 0.0
+        cfg.DIVIDE_DEL_PROB = 0.0
+        cfg.SLICING_METHOD = 0
+        cfg.set("TPU_SYSTEMATICS", 0)
+    for k, v in extra:
+        cfg.set(k, v)
+    w = World(cfg=cfg, data_dir=str(tmpdir))
+    w.events = []
+    return w
+
+
+def _trace_records(data_dir):
+    recs = []
+    path = os.path.join(str(data_dir), "telemetry.jsonl")
+    if not os.path.exists(path):
+        return recs
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("record") == "trace":
+                recs.append(rec)
+    return recs
+
+
+# ---------------------------------------------------------------- off path
+
+def test_disabled_world_has_no_ring_and_no_trace_output(tmp_path):
+    """TPU_TRACE=0 (default): no ring arrays on the state (None fields,
+    empty pytrees -- the jaxpr-identity precondition), no tracer, no
+    trace records, no metrics.prom."""
+    w = _world(tmp_path)
+    w.inject()
+    w.run(max_updates=3)
+    assert w.params.trace_cap == 0
+    assert w.state.tr_update is None and w.state.tr_count is None
+    assert w.tracer is None and w.exporter is None
+    assert _trace_records(tmp_path) == []
+    assert not os.path.exists(os.path.join(str(tmp_path), "metrics.prom"))
+
+
+# ------------------------------------------------------------- ring units
+
+def test_ring_order_overflow_semantics():
+    from avida_tpu.observability.tracer import ring_order
+
+    assert ring_order(3, 8).tolist() == [0, 1, 2]
+    assert ring_order(8, 8).tolist() == list(range(8))
+    # 11 events in a cap-8 ring: survivors are events 3..10 at slots 3..7,0..2
+    assert ring_order(11, 8).tolist() == [3, 4, 5, 6, 7, 0, 1, 2]
+
+
+def test_trace_append_drops_oldest_keeps_cursor():
+    """Device-side append: slot i %% cap, monotone cursor, masked lanes
+    scattered to the dropped index -- overflow keeps the NEWEST events."""
+    import jax.numpy as jnp
+    from types import SimpleNamespace
+
+    from avida_tpu.core.state import zeros_population
+    from avida_tpu.ops.update import _trace_append
+
+    cap = 4
+    params = SimpleNamespace(trace_cap=cap)
+    st = zeros_population(6, 8, 2, trace_cap=cap)
+    cells = jnp.arange(6, dtype=jnp.int32)
+    mask = jnp.asarray([True, False, True, True, True, True])
+    st = _trace_append(params, st, mask, cells, 2, cells * 10, jnp.int32(7))
+    assert int(st.tr_count) == 5                 # cursor counts ALL events
+    # events are cells 0,2,3,4,5; cap 4 keeps the newest four: 2,3,4,5
+    # at slots 1,2,3,0 (event numbers 1..4 mod 4)
+    assert np.asarray(st.tr_cell).tolist() == [5, 2, 3, 4]
+    assert np.asarray(st.tr_payload).tolist() == [50, 20, 30, 40]
+    assert np.asarray(st.tr_update).tolist() == [7] * 4
+    assert np.asarray(st.tr_code).tolist() == [2] * 4
+
+
+def test_drain_reports_drop_count(tmp_path):
+    """FlightRecorder.drain on an overflowed snapshot: newest events
+    land per-update in the runlog, the window's first record carries
+    the drop count, totals accumulate."""
+    from types import SimpleNamespace
+
+    from avida_tpu.observability.tracer import EV_BIRTH, FlightRecorder
+
+    stub = SimpleNamespace(telemetry=None, _dat_append=False,
+                           data_dir=str(tmp_path))
+    rec = FlightRecorder(stub)
+    cap, count = 8, 13                 # 5 dropped (events 0..4)
+    ev = np.arange(count, dtype=np.int32)
+    kept = ev[count - cap:]
+    ring = np.zeros(cap, np.int32)
+    for i in kept:
+        ring[i % cap] = i
+    rec.drain({"tr_update": ring // 6, "tr_cell": ring,
+               "tr_code": np.full(cap, EV_BIRTH, np.int32),
+               "tr_payload": ring, "tr_count": np.int32(count),
+               "update_at": 3, "host_events": []})
+    rec.close()
+    assert rec.dropped_total == 5
+    assert rec.events_total == cap
+    recs = _trace_records(tmp_path)
+    assert recs[0]["dropped"] == 5
+    assert all("dropped" not in r for r in recs[1:])
+    # chronological within the window, grouped per update
+    drained = [e[0] for r in recs for e in r["events"]]
+    assert sorted(drained) == kept.tolist()
+    assert [r["update"] for r in recs] == sorted({int(u) for u in kept // 6})
+
+
+@pytest.mark.slow
+def test_ring_overflow_in_live_run(tmp_path):
+    """A cap-4 ring under a guaranteed one-event-per-update load (stall
+    threshold > 1 always fires) overflows inside chunked stretches:
+    drops are counted, never synced early, and the run is unperturbed."""
+    w = _world(tmp_path / "t", trace=1,
+               extra=[("TPU_TRACE_CAP", 4), ("TPU_TRACE_STALL_UTIL", 1.1)])
+    w.inject()
+    w.run(max_updates=24)
+    assert w.params.trace_cap == 4
+    assert w.tracer.events_total + w.tracer.dropped_total >= 24
+    recs = _trace_records(tmp_path / "t")
+    assert sum(r.get("dropped", 0) for r in recs) == w.tracer.dropped_total
+
+    # same run, big ring: identical trajectory (drops are accounting,
+    # not behavior)
+    w2 = _world(tmp_path / "big", trace=1,
+                extra=[("TPU_TRACE_STALL_UTIL", 1.1)])
+    w2.inject()
+    w2.run(max_updates=24)
+    assert w2.tracer.dropped_total == 0
+    _assert_states_equal(w.state, w2.state)
+
+
+# ------------------------------------------------------------ bit-exactness
+
+@pytest.mark.slow
+def test_trace_bit_exact_xla(tmp_path):
+    """TPU_TRACE=1 leaves the evolved trajectory bit-identical on the
+    XLA path, and every update up to the final boundary has its trace
+    record drained to the runlog (stall threshold 1.1 guarantees at
+    least one event per update)."""
+    wa = _world(tmp_path / "off", seed=23)
+    wa.inject()
+    wa.run(max_updates=20)
+
+    wb = _world(tmp_path / "on", seed=23, trace=1,
+                extra=[("TPU_TRACE_STALL_UTIL", 1.1)])
+    wb.inject()
+    wb.run(max_updates=20)
+    assert wb.tracer.events_total >= 20
+
+    for name in wa.state.__dataclass_fields__:
+        if name.startswith("tr_"):
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(getattr(wa.state, name)),
+            np.asarray(getattr(wb.state, name)), err_msg=f"field {name}")
+    assert {r["update"] for r in _trace_records(tmp_path / "on")} \
+        == set(range(20))
+
+
+@pytest.mark.slow
+def test_trace_bit_exact_pallas_lane_packed(tmp_path):
+    """Same guarantee through the Pallas kernel path with lane packing
+    active (the ring is WORLD_LEVEL: excluded from the lane permutation
+    and the move gather)."""
+    from avida_tpu.ops.update import use_pallas_path
+
+    wa = _world(tmp_path / "off", seed=31, pallas=True)
+    assert use_pallas_path(wa.params) and wa.params.lane_perm_k == 1
+    wa.inject()
+    wa.run(max_updates=12)
+
+    wb = _world(tmp_path / "on", seed=31, trace=1, pallas=True)
+    wb.inject()
+    wb.run(max_updates=12)
+
+    for name in wa.state.__dataclass_fields__:
+        if name.startswith("tr_"):
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(getattr(wa.state, name)),
+            np.asarray(getattr(wb.state, name)), err_msg=f"field {name}")
+
+
+@pytest.mark.slow
+def test_sigterm_preempt_keeps_drained_trace(tmp_path):
+    """SIGTERM mid-run with the recorder on: the final checkpoint and
+    the runlog contain the drained trace up to the last chunk boundary
+    (one stall event per update guaranteed), the checkpoint serializes
+    the ring DRAINED (cursor 0), and a fresh world resumes + finishes
+    bit-exactly with the recorder still on."""
+    from avida_tpu.config.events import parse_event_line
+    from avida_tpu.utils import checkpoint as ckpt_mod
+
+    trace_extra = [("TPU_TRACE_STALL_UTIL", 1.1)]
+    wa = _world(tmp_path / "a", trace=1, extra=trace_extra)
+    wa.inject()
+    wa.run(max_updates=20)
+
+    ckdir = tmp_path / "ck"
+    wb = _world(tmp_path / "b", trace=1,
+                extra=trace_extra + [("TPU_CKPT_DIR", str(ckdir))])
+    wb._action_SendTerm = lambda args: os.kill(os.getpid(), signal.SIGTERM)
+    wb.events = [parse_event_line("u 9 SendTerm")]
+    wb.inject()
+    wb.run(max_updates=20)
+    assert wb.preempted and wb.update < 20
+
+    # every update that ran is in the runlog -- nothing lost past the
+    # last boundary, nothing invented beyond it
+    assert {r["update"] for r in _trace_records(tmp_path / "b")} \
+        == set(range(wb.update))
+
+    # the checkpoint's ring is drained: cursor 0, host counters carried
+    gens = ckpt_mod.list_generations(str(ckdir))
+    manifest, arrays, _ = ckpt_mod.read_generation(gens[-1])
+    assert int(arrays["state.tr_count"]) == 0
+    host = manifest["host"]
+    assert host["tracer"]["events_total"] == wb.tracer.events_total
+    assert host["tracer"]["events_total"] >= wb.update
+
+    wc = _world(tmp_path / "c", trace=1,
+                extra=trace_extra + [("TPU_CKPT_DIR", str(ckdir))])
+    assert wc.resume() == wb.update
+    wc.run(max_updates=20)
+    _assert_states_equal(wa.state, wc.state)
+    # runlog continuity across the preempt/resume: updates 0..19, each
+    # exactly once (b owns 0..update-1, the resumed c re-emits from
+    # update on)
+    seen = sorted(r["update"] for r in
+                  _trace_records(tmp_path / "b")
+                  + _trace_records(tmp_path / "c"))
+    assert seen == list(range(20))
+
+
+# ------------------------------------------------------- metrics exporter
+
+def test_metrics_prom_written_and_parsed(tmp_path):
+    """TPU_METRICS=1 alone (no tracer) publishes the heartbeat; values
+    round-trip through the parser and the --status formatter."""
+    from avida_tpu.observability.exporter import (METRICS_FILE,
+                                                  format_status,
+                                                  read_metrics, status_main)
+
+    w = _world(tmp_path, extra=[("TPU_METRICS", 1)])
+    w.inject()
+    w.run(max_updates=6)
+    assert w.tracer is None
+    path = os.path.join(str(tmp_path), METRICS_FILE)
+    m = read_metrics(path)
+    assert m["avida_update"] == 6
+    assert m["avida_organisms"] >= 1
+    assert m["avida_heartbeat_timestamp_seconds"] <= time.time()
+    out = format_status(m)
+    assert "update      6" in out
+    assert status_main(str(tmp_path)) == 0
+    assert status_main(str(tmp_path / "nonexistent")) == 1
+
+
+def test_metrics_live_polling_between_chunks(tmp_path):
+    """The acceptance check: metrics.prom reflects a LIVE run within one
+    chunk of real time.  The run owns the main thread; a poller thread
+    watches the file and must observe an intermediate update count
+    strictly between 0 and the final one (i.e. the heartbeat is
+    published at chunk boundaries, not only at exit)."""
+    from avida_tpu.observability.exporter import METRICS_FILE, read_metrics
+
+    # chunked run (no telemetry): stretches of up to 8 updates between
+    # boundaries, heartbeat republished at each boundary (stall_util 1.1
+    # matches the run()-twice test so the two share one compiled program)
+    w = _world(tmp_path, trace=1, extra=[("TPU_TRACE_STALL_UTIL", 1.1)])
+    path = os.path.join(str(tmp_path), METRICS_FILE)
+    seen, stop = set(), threading.Event()
+
+    def poll():
+        while not stop.is_set():
+            if os.path.exists(path):
+                try:
+                    seen.add(int(read_metrics(path)["avida_update"]))
+                except (KeyError, ValueError, OSError):
+                    pass                       # mid-replace race: retry
+            time.sleep(0.002)
+
+    t = threading.Thread(target=poll, daemon=True)
+    t.start()
+    try:
+        w.inject()
+        w.run(max_updates=40)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    final = int(read_metrics(path)["avida_update"])
+    assert final == 40
+    live = {u for u in seen if 0 < u < 40}
+    assert live, f"poller saw no intermediate heartbeat (seen={seen})"
+
+
+# ------------------------------------------------- runlog trim satellites
+
+def _write_runlog(path, lines):
+    with open(path, "w") as f:
+        for rec in lines:
+            f.write((rec if isinstance(rec, str) else json.dumps(rec))
+                    + "\n")
+
+
+def test_trim_drops_torn_tail(tmp_path):
+    """A partial JSON line (crash mid-write) is dropped by the trim."""
+    from avida_tpu.observability.runlog import trim_update_records
+
+    path = str(tmp_path / "telemetry.jsonl")
+    _write_runlog(path, [{"record": "meta", "seed": 1},
+                         {"record": "update", "update": 0},
+                         {"record": "trace", "update": 0, "events": []}])
+    with open(path, "a") as f:
+        f.write('{"record": "update", "upd')      # torn tail, no newline
+    trim_update_records(path, 5)
+    recs = [json.loads(x) for x in open(path)]
+    assert [r["record"] for r in recs] == ["meta", "update", "trace"]
+
+
+def test_trim_strict_cutoff_reemits_restored_update(tmp_path):
+    """A checkpoint at update N owns records 0..N-1: trim drops update
+    AND trace records >= N (the resumed run re-emits its own), keeps
+    meta/event records regardless."""
+    from avida_tpu.observability.runlog import trim_update_records
+
+    path = str(tmp_path / "telemetry.jsonl")
+    _write_runlog(path, [{"record": "meta"},
+                         {"record": "update", "update": 3},
+                         {"record": "trace", "update": 3, "events": [[0, 1, 0]]},
+                         {"record": "event", "event": "checkpoint_saved"},
+                         {"record": "update", "update": 4},
+                         {"record": "trace", "update": 4, "events": []}])
+    trim_update_records(path, 4)
+    recs = [json.loads(x) for x in open(path)]
+    assert [r.get("update") for r in recs] == [None, 3, 3, None]
+    assert recs[3]["record"] == "event"
+
+
+def test_trim_header_only_file(tmp_path):
+    """Only the meta header: trim is a no-op that keeps the file intact
+    (and a missing file stays a no-op)."""
+    from avida_tpu.observability.runlog import trim_update_records
+
+    path = str(tmp_path / "telemetry.jsonl")
+    _write_runlog(path, [{"record": "meta", "seed": 9}])
+    before = open(path).read()
+    trim_update_records(path, 0)
+    assert open(path).read() == before
+    trim_update_records(str(tmp_path / "absent.jsonl"), 0)   # no raise
+
+
+# ------------------------------------------------- run()-twice satellite
+
+def test_run_twice_appends_dat_files(tmp_path):
+    """The PR-4 wart: a second run() on the same World must EXTEND its
+    own .dat files (single header, continuous rows), not truncate them.
+    Also covers the trace runlog: records from both segments survive."""
+    from avida_tpu.config.events import parse_event_line
+
+    w = _world(tmp_path, trace=1, extra=[("TPU_TRACE_STALL_UTIL", 1.1)])
+    w.events = [parse_event_line("u 0:2:end PrintAverageData average.dat")]
+    w.inject()
+    w.run(max_updates=6)
+    w.run(max_updates=12)
+
+    lines = open(os.path.join(str(tmp_path), "average.dat")).readlines()
+    rows = [ln for ln in lines if ln.strip() and not ln.startswith("#")]
+    updates = [int(float(r.split()[0])) for r in rows]
+    assert updates == list(range(0, 12, 2))    # continuous, no restart at 6
+    # single header block: the second run() appended instead of rewriting
+    assert sum(1 for ln in lines if ln.startswith("#  1:")) == 1
+
+    assert {r["update"] for r in _trace_records(tmp_path)} == set(range(12))
+
+
+# ------------------------------------------------------ trace_tool round-trip
+
+def test_trace_tool_chrome_roundtrip(tmp_path):
+    """to-chrome followed by from-chrome reproduces the per-update event
+    lists exactly; phase records become duration events."""
+    import trace_tool
+
+    path = str(tmp_path / "telemetry.jsonl")
+    _write_runlog(path, [
+        {"record": "meta", "seed": 5, "platform": "cpu"},
+        {"record": "update", "update": 0, "wall_ms": 2.0,
+         "phases": {"schedule": 0.5, "while_loop": 1.0}, "counters": {}},
+        {"record": "trace", "update": 0,
+         "events": [[3, 1, 7], [-1, 4, 9000]], "dropped": 4},
+        {"record": "trace", "update": 2, "events": [[5, 2, 11]]},
+    ])
+    doc = trace_tool.to_chrome(path)
+    kinds = {e["ph"] for e in doc["traceEvents"]}
+    assert {"X", "i", "M"} <= kinds
+    insts = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert len(insts) == 4            # 3 events + 1 trace_dropped marker
+
+    # each phase gets its own named row; phase brackets land on it
+    names = {e["args"]["name"]: e["tid"] for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    for phase in ("schedule", "while_loop"):
+        row = names[f"phase:{phase}"]
+        assert any(e["ph"] == "X" and e["name"] == phase
+                   and e["tid"] == row for e in doc["traceEvents"])
+
+    out = str(tmp_path / "trace.json")
+    with open(out, "w") as f:
+        json.dump(doc, f)
+    recs = trace_tool.from_chrome(out)
+    assert recs == [
+        {"record": "trace", "update": 0,
+         "events": [[3, 1, 7], [-1, 4, 9000]], "dropped": 4},
+        {"record": "trace", "update": 2, "events": [[5, 2, 11]]},
+    ]
+
+    s = trace_tool.summary(path)
+    assert "events total:               3" in s

@@ -9,13 +9,11 @@ default_transsmt_100u and parasite tests.
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from avida_tpu.config import AvidaConfig, transsmt_instset
 from avida_tpu.config.events import parse_event_line
-from avida_tpu.world import World, default_ancestor, default_parasite
+from avida_tpu.world import World, default_ancestor
 
 import pytest  # noqa: E402
 

@@ -8,7 +8,6 @@ feeding merit.
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from avida_tpu.config import AvidaConfig, default_instset
 from avida_tpu.config.environment import default_logic9_environment
