@@ -43,6 +43,24 @@ TPU-build extras (no reference equivalent):
   --status DIR       print the last heartbeat of the run writing to
                      data dir DIR (reads DIR/metrics.prom; no JAX
                      import, works while the run is live) and exit.
+  --max-age SEC      with --status: exit 2 when the heartbeat is
+                     missing or older than SEC seconds (0 fresh,
+                     1 no metrics file) -- consumable by external
+                     watchdogs and cron.
+  --supervise        run under the self-healing supervisor
+                     (service/supervisor.py): the remaining arguments
+                     become the child run's command line (needs -d DIR
+                     and -set TPU_CKPT_DIR DIR).  The supervisor
+                     watchdogs the heartbeat, SIGKILLs hung runs,
+                     restarts with backoff + --resume, rolls back past
+                     audit violations and degrades Pallas failures to
+                     the XLA path.  --fault-plan gives boot i the i-th
+                     '/'-separated TPU_FAULT spec (chaos testing;
+                     utils/faultinject.py).
+
+Failure-classified exit codes (consumed by the supervisor):
+  65  a state-invariant audit violation escaped the run
+  66  --resume found checkpoints but no valid generation
 """
 
 from __future__ import annotations
@@ -54,6 +72,13 @@ import time
 
 
 def main(argv=None):
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if "--supervise" in argv:
+        # dispatched before anything heavy is imported: the supervisor
+        # must never load jax (it has to outlive a wedged child runtime)
+        from avida_tpu.service.supervisor import supervise_main
+        return supervise_main(argv)
+
     p = argparse.ArgumentParser(prog="avida_tpu", add_help=True)
     p.add_argument("-c", "--config-dir", default=None)
     p.add_argument("-s", "--seed", type=int, default=None)
@@ -69,13 +94,14 @@ def main(argv=None):
                    metavar="DIR")
     p.add_argument("--trace", action="store_true")
     p.add_argument("--status", default=None, metavar="DIR")
+    p.add_argument("--max-age", type=float, default=None, metavar="SEC")
     args = p.parse_args(argv)
 
     if args.status is not None:
         # outside-the-process observability: read the metrics.prom
         # heartbeat only -- no World, no JAX device init
         from avida_tpu.observability.exporter import status_main
-        return status_main(args.status)
+        return status_main(args.status, max_age=args.max_age)
 
     overrides = list(map(tuple, args.overrides))
     if args.seed is not None:
@@ -101,24 +127,49 @@ def main(argv=None):
         az.run_file(path)
         return 0
 
+    from avida_tpu.service import EXIT_AUDIT, EXIT_CKPT
+    from avida_tpu.utils.audit import StateInvariantError
+    from avida_tpu.utils.checkpoint import (CheckpointError,
+                                            CheckpointMismatchError)
+
     if args.resume is not None:
         # restart-loop friendly: a preemptible job launches with ONE fixed
         # command line including --resume; on the very first boot the
         # checkpoint directory is empty, which means "start fresh", not
         # "crash" (generations that exist but fail verification still
-        # raise -- that needs a human)
-        from avida_tpu.utils.checkpoint import list_generations
+        # fail -- classified exit 66 so a supervisor can tell "nothing
+        # resumable" from a generic crash)
+        from avida_tpu.utils.checkpoint import restore_candidates
         base = args.resume or world._ckpt_base()
-        if base and not list_generations(base):
+        if base and not restore_candidates(base):
             print(f"[avida-tpu] no checkpoint under {base}; starting fresh",
                   file=sys.stderr)
         else:
-            at = world.resume(args.resume or None)
+            try:
+                at = world.resume(args.resume or None)
+            except CheckpointMismatchError:
+                raise
+            except CheckpointError as e:
+                print(f"[avida-tpu] resume failed: {e}", file=sys.stderr)
+                return EXIT_CKPT
+            except StateInvariantError as e:
+                # restore-time audit tripped: the restored generation is
+                # internally corrupt (CRC-valid but bad state, e.g. saved
+                # with TPU_CKPT_AUDIT=0) -- classified exit so the
+                # supervisor quarantines it instead of blindly retrying
+                print(f"[avida-tpu] {e}", file=sys.stderr)
+                return EXIT_AUDIT
             if args.verbose:
                 print(f"resumed at update {at}", file=sys.stderr)
 
     t0 = time.time()
-    world.run(max_updates=args.updates)
+    try:
+        world.run(max_updates=args.updates)
+    except StateInvariantError as e:
+        # corruption caught by the auditor: exit with the classified
+        # code so the supervisor rolls back instead of blindly retrying
+        print(f"[avida-tpu] {e}", file=sys.stderr)
+        return EXIT_AUDIT
     dt = time.time() - t0
     if world.preempted:
         # preemption is a CLEAN exit: the final checkpoint is on disk and

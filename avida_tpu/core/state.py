@@ -111,6 +111,11 @@ class WorldParams(struct.PyTreeNode):
     # emit a scheduler-stall event when the lockstep block utilization of
     # the granted budget vector drops below this fraction
     trace_stall_util: float = struct.field(pytree_node=False, default=0.25)
+    # deterministic device-side fault injection (utils/faultinject.py
+    # `nan:` kind): (leaf_name, cell, update) -- () = off, and the
+    # update_step jaxpr is unchanged (same static-gate discipline as
+    # trace_cap; chaos tests only, never set in production)
+    fault_nan: tuple = struct.field(pytree_node=False, default=())
     # intra-organism threads (cAvidaConfig.h:558-564)
     max_cpu_threads: int = struct.field(pytree_node=False, default=1)
     thread_slicing_method: int = struct.field(pytree_node=False, default=0)
@@ -220,6 +225,14 @@ def _migration_cdf(cfg):
             row.append(acc)
         rows.append(tuple(row))
     return tuple(rows)
+
+
+def _fault_nan_param(cfg) -> tuple:
+    """Static fault-injection flag for the `nan:` TPU_FAULT kind (the
+    host-side kinds never touch params).  () in every production
+    configuration."""
+    from avida_tpu.utils.faultinject import nan_param
+    return nan_param(cfg)
 
 
 def make_world_params(cfg, instset, environment) -> WorldParams:
@@ -360,6 +373,7 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         trace_cap=int(cfg.get("TPU_TRACE_CAP", 4096))
         if int(cfg.get("TPU_TRACE", 0)) else 0,
         trace_stall_util=float(cfg.get("TPU_TRACE_STALL_UTIL", 0.25)),
+        fault_nan=_fault_nan_param(cfg),
         generation_inc_method=cfg.GENERATION_INC_METHOD,
         num_reactions=len(environment.reactions),
         task_logic_mask=tt(env_tables["task_logic_mask"]),

@@ -82,6 +82,24 @@ def render_metrics(world) -> str:
     return _render(values, trace)
 
 
+def render_families(families) -> str:
+    """Generic exposition renderer: families is an iterable of
+    (name, kind, help, value) where value is a scalar or a
+    {'label="x"': value} dict (one sample line per label set).  Shared
+    by the run heartbeat below and the supervisor's own counter file
+    (service/supervisor.py)."""
+    lines = []
+    for name, kind, help_, value in families:
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {kind}")
+        if isinstance(value, dict):
+            for label, v in sorted(value.items()):
+                lines.append(f"{name}{{{label}}} {v}")
+        else:
+            lines.append(f"{name} {value}")
+    return "\n".join(lines) + "\n"
+
+
 def _render(values: dict, trace) -> str:
     """Exposition text from a resolved values dict (+ optional trace
     counter triple (events_total, dropped_total, code_totals))."""
@@ -90,19 +108,14 @@ def _render(values: dict, trace) -> str:
         values = dict(values,
                       avida_trace_events_total=events_total,
                       avida_trace_dropped_total=dropped_total)
-    lines = []
-    for name, value in values.items():
-        kind, help_ = _HELP[name]
-        lines.append(f"# HELP {name} {help_}")
-        lines.append(f"# TYPE {name} {kind}")
-        lines.append(f"{name} {value}")
+    families = [(name, *_HELP[name], value)
+                for name, value in values.items()]
     if trace is not None:
-        kind, help_ = _HELP["avida_trace_code_total"]
-        lines.append(f"# HELP avida_trace_code_total {help_}")
-        lines.append(f"# TYPE avida_trace_code_total {kind}")
-        for code, count in sorted(trace[2].items()):
-            lines.append(f'avida_trace_code_total{{code="{code}"}} {count}')
-    return "\n".join(lines) + "\n"
+        families.append(
+            ("avida_trace_code_total", *_HELP["avida_trace_code_total"],
+             {f'code="{code}"': count
+              for code, count in trace[2].items()}))
+    return render_families(families)
 
 
 def write_metrics(path: str, text: str, durable: bool = True):
@@ -160,14 +173,34 @@ def format_status(metrics: dict, now: float | None = None) -> str:
     return "\n".join(lines)
 
 
-def status_main(data_dir: str) -> int:
-    """`python -m avida_tpu --status DIR`: print the last heartbeat."""
+def status_main(data_dir: str, max_age: float | None = None) -> int:
+    """`python -m avida_tpu --status DIR [--max-age SEC]`: print the
+    last heartbeat.  Exit status is machine-consumable so external
+    watchdogs/cron can alert on it: 0 = heartbeat present (and fresh,
+    when --max-age is given), 1 = no metrics file, 2 = heartbeat missing
+    from the file or staler than max_age seconds."""
     path = os.path.join(data_dir, METRICS_FILE)
     if not os.path.exists(path):
         print(f"no {METRICS_FILE} under {data_dir!r} (run with "
               f"TPU_METRICS=1 or TPU_TRACE=1)")
         return 1
-    print(format_status(read_metrics(path)))
+    metrics = read_metrics(path)
+    print(format_status(metrics))
+    sup_path = os.path.join(data_dir, "supervisor.prom")
+    if os.path.exists(sup_path):
+        sup = read_metrics(sup_path)
+        fails = sum(v for k, v in sup.items()
+                    if k.startswith("avida_supervisor_failures_total"))
+        print(f"supervisor  boots {int(sup.get('avida_supervisor_boots_total', 0))}, "
+              f"failures {int(fails)}, "
+              f"budget {int(sup.get('avida_supervisor_retry_budget', 0))}")
+    if max_age is not None:
+        hb = metrics.get("avida_heartbeat_timestamp_seconds")
+        age = None if hb is None else time.time() - hb
+        if age is None or age > max_age:
+            shown = "missing" if age is None else f"{age:.1f}s"
+            print(f"STALE: heartbeat {shown} exceeds --max-age {max_age}s")
+            return 2
     return 0
 
 

@@ -90,6 +90,19 @@ def trim_update_records(path: str, max_update: int):
         os.replace(tmp, path)
 
 
+def append_record(path: str, rec: dict):
+    """Crash-safe single-record append for OUT-OF-PROCESS writers (the
+    run supervisor's {"record": "supervisor"} events): open, append one
+    line, fsync, close -- no handle is held across a child process's
+    lifetime, and a torn tail can only ever be the final line (which
+    every runlog reader already tolerates)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
 def emit_event(world, event: str, **fields):
     """Structured out-of-band run event ({"record": "event", ...}).
 

@@ -92,6 +92,13 @@ class StagedUpdate:
                 lambda st, g, u: trace_pre_phase(params, st, g, u))
             self._trace_post = jax.jit(
                 lambda st, snap, u: trace_post_phase(params, st, snap, u))
+        # chaos-test NaN injection: the fused update_step gates this on
+        # the same static flag -- staged must mirror it or the two paths
+        # diverge under TPU_FAULT (tests assert bit-identity)
+        self.fault = bool(getattr(params, "fault_nan", ()))
+        if self.fault:
+            from avida_tpu.utils.faultinject import nan_phase
+            self._fault = jax.jit(lambda st, u: nan_phase(params, st, u))
         self._bank = jax.jit(
             lambda st, budgets, e0: bank_phase(params, st, budgets, e0))
         self._birth = jax.jit(
@@ -127,6 +134,8 @@ class StagedUpdate:
         st, executed = tl.run("bank", self._bank, st, budgets, executed0)
         st = tl.run("birth_flush", self._birth, st, k_birth, k_steps,
                     update_no)
+        if self.fault:
+            st = tl.run("fault", self._fault, st, update_no)
         if self.trace:
             st = tl.run("trace", self._trace_post, st, tsnap, update_no)
         return st, executed, dispatch, granted, alive_before

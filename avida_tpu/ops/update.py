@@ -399,6 +399,15 @@ def update_step(params, st, key, neighbors, update_no):
 
     st = birth_phase(params, st, k_birth, k_steps, neighbors, update_no)
 
+    if params.fault_nan:
+        # seeded device-side corruption (utils/faultinject.py `nan:`
+        # kind), injected BEFORE the trace emission so the flight
+        # recorder sees the anomaly onset in the same update.  Static
+        # Python gate like trace_cap: with TPU_FAULT unset this traces
+        # the identical program (scripts/check_jaxpr.py digest)
+        from avida_tpu.utils.faultinject import nan_phase
+        st = nan_phase(params, st, update_no)
+
     if params.trace_cap:
         st = trace_post_phase(params, st, tsnap, update_no)
 

@@ -1,0 +1,18 @@
+"""Run-service layer: the self-healing run supervisor.
+
+Host-only (no jax import anywhere in this package): the supervisor is
+the process that must stay alive while the run process crashes, hangs
+or corrupts itself, so it watches entirely from outside -- child exit
+codes, the metrics.prom heartbeat file and the checkpoint directory.
+
+Child exit codes (set by avida_tpu/__main__.py so the supervisor can
+classify failures without parsing tracebacks):
+"""
+
+# sysexits-adjacent, chosen to be distinguishable from Python's generic
+# exit 1 and from signal deaths (negative returncodes)
+EXIT_AUDIT = 65      # StateInvariantError escaped World.run (EX_DATAERR)
+EXIT_CKPT = 66       # no valid checkpoint generation on resume (EX_NOINPUT)
+
+FAILURE_CLASSES = ("crash", "hang", "audit_violation", "corrupt_ckpt",
+                   "preempt")

@@ -1,0 +1,601 @@
+"""Self-healing run supervisor: `python -m avida_tpu --supervise ...`.
+
+The PR-4/PR-5 machinery made a single run crash-SAFE (bit-exact
+checkpoints with CRC fallback, SIGTERM preemption, `--resume`, the
+metrics.prom heartbeat); this module makes it crash-SURVIVING.  The
+supervisor launches the world run as a child process and watches it
+entirely from OUTSIDE -- it never imports jax, so a wedged device
+runtime, an OOM-killed child or a corrupted interpreter state cannot
+take the watchdog down with it:
+
+  * liveness: the age of the `avida_heartbeat_timestamp_seconds` sample
+    in DATA_DIR/metrics.prom (republished by the child at every chunk
+    boundary).  Stale past TPU_WATCHDOG_SEC -> SIGKILL (a hung chunk
+    ignores SIGTERM by definition).  A boot grace period
+    (TPU_SUPERVISE_GRACE_SEC) covers jit compilation before the first
+    heartbeat.
+  * restart: exponential backoff with decorrelated jitter and a capped
+    retry budget (service/backoff.py); the budget refills after
+    TPU_SUPERVISE_HEALTHY_SEC of continuous health.  Every relaunch
+    appends `--resume`, so recovery is bit-exact from the newest
+    CRC-valid generation (utils/checkpoint.py).
+  * failure taxonomy, recorded as {"record": "supervisor"} runlog lines
+    in DATA_DIR/supervisor.jsonl and exported as Prometheus counters in
+    DATA_DIR/supervisor.prom:
+
+      crash            nonzero exit / signal death (incl. SIGKILL)
+      hang             watchdog-killed stale heartbeat
+      audit_violation  StateInvariantError (child exit EXIT_AUDIT) or a
+                       flight-recorder anomaly onset seen in metrics
+      corrupt_ckpt     resume found no valid generation (EXIT_CKPT), or
+                       the child logged a checkpoint_corrupt fallback
+      preempt          clean SIGTERM preemption (exit 0 + heartbeat
+                       preempted=1): relaunched immediately, consuming
+                       NO retry budget -- preemption is routine, the
+                       Avida way (organism death is not an error)
+
+  * recovery policies that close the loop with PR-4/PR-5:
+      - audit_violation -> ROLLBACK: quarantine the newest checkpoint
+        generation (renamed to `.bad-*`, invisible to resume) so the
+        child restarts from the previous good one instead of replaying
+        the corrupt state.
+      - a crash whose stderr tail implicates the Pallas/Mosaic kernel
+        path -> ONE graceful-degradation relaunch with
+        `-set TPU_USE_PALLAS 2` (XLA path) and a loud runlog warning.
+
+Fault injection for the chaos suite rides the same interface: the
+supervisor's `fault_plan` hands boot i the i-th TPU_FAULT spec
+(utils/faultinject.py) via the child environment and strips it from
+every later boot, so an injected failure fires exactly once.
+
+All timing dependencies (clock, sleep, process spawn) are injectable,
+so the policy logic is unit-testable with a fake clock and fake
+children -- no real sleeps, no real processes (tests/test_supervisor.py).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+import time
+
+from avida_tpu.observability.exporter import (METRICS_FILE, read_metrics,
+                                              render_families, write_metrics)
+from avida_tpu.observability.runlog import append_record
+from avida_tpu.service import EXIT_AUDIT, EXIT_CKPT, FAILURE_CLASSES
+from avida_tpu.service.backoff import RetryPolicy
+from avida_tpu.utils.checkpoint import list_generations
+
+RUNLOG_FILE = "supervisor.jsonl"
+SUPERVISOR_METRICS_FILE = "supervisor.prom"
+
+_PALLAS_RE = re.compile(r"pallas|mosaic", re.IGNORECASE)
+_HEARTBEAT = "avida_heartbeat_timestamp_seconds"
+_ANOM_RE = re.compile(r'^avida_trace_code_total\{code="anom_')
+
+
+def classify(exit_code: int, *, watchdog_killed: bool = False,
+             anomaly_killed: bool = False, preempted: bool = False) -> str:
+    """Map one child exit to the failure taxonomy ('success' when the
+    run completed).  Supervisor-initiated kills take precedence over the
+    exit code they caused."""
+    if watchdog_killed:
+        return "hang"
+    if anomaly_killed:
+        return "audit_violation"
+    if exit_code == 0:
+        return "preempt" if preempted else "success"
+    if exit_code == EXIT_AUDIT:
+        return "audit_violation"
+    if exit_code == EXIT_CKPT:
+        return "corrupt_ckpt"
+    return "crash"
+
+
+def pallas_suspect(stderr_tail: str) -> bool:
+    """Does a crash's stderr implicate the Pallas/Mosaic kernel path?"""
+    return bool(_PALLAS_RE.search(stderr_tail))
+
+
+def _anomaly_total(metrics: dict) -> float:
+    """Sum of the flight recorder's anom_* event counters in a parsed
+    metrics.prom dict (0 when tracing is off)."""
+    return sum(v for k, v in metrics.items() if _ANOM_RE.match(k))
+
+
+class SupervisorConfig:
+    """Knobs, all overridable via the environment (documented in the
+    README's supervised-runs section)."""
+
+    def __init__(self, watchdog_sec: float = 120.0,
+                 poll_sec: float | None = None, grace_sec: float = 900.0,
+                 max_retries: int = 8, backoff_base: float = 1.0,
+                 backoff_cap: float = 60.0, healthy_sec: float = 300.0,
+                 seed: int = 0, anomaly_watch: bool = True):
+        self.watchdog_sec = float(watchdog_sec)
+        self.poll_sec = (min(max(self.watchdog_sec / 8, 0.2), 5.0)
+                         if poll_sec is None else float(poll_sec))
+        self.grace_sec = float(grace_sec)
+        self.max_retries = int(max_retries)
+        self.backoff_base = float(backoff_base)
+        self.backoff_cap = float(backoff_cap)
+        self.healthy_sec = float(healthy_sec)
+        self.seed = int(seed)
+        self.anomaly_watch = bool(anomaly_watch)
+
+    @classmethod
+    def from_env(cls, env) -> "SupervisorConfig":
+        def f(name, default):
+            return float(env.get(name, default))
+        return cls(
+            watchdog_sec=f("TPU_WATCHDOG_SEC", 120.0),
+            poll_sec=(float(env["TPU_SUPERVISE_POLL_SEC"])
+                      if "TPU_SUPERVISE_POLL_SEC" in env else None),
+            grace_sec=f("TPU_SUPERVISE_GRACE_SEC", 900.0),
+            max_retries=int(f("TPU_SUPERVISE_MAX_RETRIES", 8)),
+            backoff_base=f("TPU_SUPERVISE_BACKOFF_BASE", 1.0),
+            backoff_cap=f("TPU_SUPERVISE_BACKOFF_CAP", 60.0),
+            healthy_sec=f("TPU_SUPERVISE_HEALTHY_SEC", 300.0),
+            seed=int(f("TPU_SUPERVISE_SEED", 0)),
+            anomaly_watch=bool(int(f("TPU_SUPERVISE_ANOM", 1))),
+        )
+
+
+def _child_setting(argv: list, name: str):
+    """The LAST `-set NAME VALUE` in a child argv (None when absent)."""
+    val = None
+    for i in range(len(argv) - 2):
+        if argv[i] == "-set" and argv[i + 1] == name:
+            val = argv[i + 2]
+    return val
+
+
+def _child_data_dir(argv: list):
+    val = None
+    for i, a in enumerate(argv):
+        if a in ("-d", "--data-dir") and i + 1 < len(argv):
+            val = argv[i + 1]
+    return val
+
+
+class Outcome:
+    """One boot's result: classification + the evidence behind it."""
+
+    def __init__(self, cls: str, exit_code, *, pallas: bool = False,
+                 corrupt_seen: bool = False, update=None):
+        self.cls = cls
+        self.exit_code = exit_code
+        self.pallas = pallas
+        self.corrupt_seen = corrupt_seen
+        self.update = update
+
+
+class Supervisor:
+    def __init__(self, child_argv, *, data_dir=None, ckpt_dir=None,
+                 fault_plan=(), cfg: SupervisorConfig | None = None,
+                 env=None, spawn=None, clock=time.time,
+                 sleep=time.sleep):
+        self.child_argv = list(child_argv)
+        base_env = dict(os.environ if env is None else env)
+        self.cfg = cfg or SupervisorConfig.from_env(base_env)
+        self.data_dir = data_dir or _child_data_dir(self.child_argv)
+        self.ckpt_dir = ckpt_dir or _child_setting(self.child_argv,
+                                                   "TPU_CKPT_DIR")
+        if not self.data_dir:
+            raise ValueError("--supervise needs the child's data dir "
+                             "(-d DIR) to read its heartbeat")
+        if not self.ckpt_dir:
+            raise ValueError("--supervise needs -set TPU_CKPT_DIR DIR in "
+                             "the child args (restart recovery resumes "
+                             "from native checkpoints)")
+        if _child_setting(self.child_argv, "TPU_FAULT") is not None:
+            raise ValueError("pass injected faults via --fault-plan, not "
+                             "-set TPU_FAULT (a fault baked into the child "
+                             "args would re-fire on every restart)")
+        # the heartbeat is the watchdog's only liveness signal -- force
+        # the exporter on (idempotent when the user already set it) and
+        # refuse an explicit opt-out, which would reduce every healthy
+        # boot to a grace-period timeout kill
+        metrics_set = _child_setting(self.child_argv, "TPU_METRICS")
+        if metrics_set is not None and not int(metrics_set):
+            raise ValueError("-set TPU_METRICS 0 disables the heartbeat "
+                             "the supervisor's watchdog lives on; drop it "
+                             "(supervised children always export metrics)")
+        if metrics_set is None and "--trace" not in self.child_argv:
+            self.child_argv += ["-set", "TPU_METRICS", "1"]
+        if "--resume" not in self.child_argv:
+            self.child_argv.append("--resume")
+        self.fault_plan = list(fault_plan)
+        self._base_env = base_env
+        self._base_env.pop("TPU_FAULT", None)
+        self.policy = RetryPolicy(
+            max_retries=self.cfg.max_retries, base=self.cfg.backoff_base,
+            cap=self.cfg.backoff_cap, healthy_sec=self.cfg.healthy_sec,
+            seed=self.cfg.seed)
+        self._spawn = spawn or self._spawn_default
+        self._clock = clock
+        self._sleep = sleep
+        self.boots = 0
+        self.restarts = 0
+        self.failures = {c: 0 for c in FAILURE_CLASSES}
+        self.watchdog_kills = 0
+        self.rollbacks = 0
+        self.pallas_fallbacks = 0
+        self.ckpt_fallbacks = 0
+        self.last_exit_code = 0
+        self._xla_fallback = False
+        self._proc = None
+        self._stop = False
+        self._corrupt_counted = set()   # generation paths already tallied
+        self.runlog_path = os.path.join(self.data_dir, RUNLOG_FILE)
+        self.metrics_path = os.path.join(self.data_dir,
+                                         SUPERVISOR_METRICS_FILE)
+        self.child_log_path = os.path.join(self.data_dir, "supervised.log")
+
+    # ---- plumbing ----
+
+    @staticmethod
+    def _spawn_default(argv, env, log_file):
+        return subprocess.Popen(argv, env=env, stdout=log_file,
+                                stderr=log_file)
+
+    def record(self, event: str, **fields):
+        rec = {"record": "supervisor", "event": event,
+               "time": self._clock(), "boot": self.boots, **fields}
+        try:
+            append_record(self.runlog_path, rec)
+        except OSError:
+            pass                        # logging must not kill recovery
+        detail = " ".join(f"{k}={v}" for k, v in fields.items())
+        print(f"[supervisor] {event}" + (f": {detail}" if detail else ""),
+              file=sys.stderr)
+        self.publish_metrics(child_up=self._proc is not None
+                             and self._proc.poll() is None)
+
+    def publish_metrics(self, child_up: bool = False):
+        fams = [
+            ("avida_supervisor_boots_total", "counter",
+             "child launches (first boot + every restart)", self.boots),
+            ("avida_supervisor_restarts_total", "counter",
+             "relaunches after a failure or preemption", self.restarts),
+            ("avida_supervisor_failures_total", "counter",
+             "classified child failures",
+             {f'class="{c}"': n for c, n in self.failures.items()}),
+            ("avida_supervisor_watchdog_kills_total", "counter",
+             "children SIGKILLed for a stale heartbeat",
+             self.watchdog_kills),
+            ("avida_supervisor_rollbacks_total", "counter",
+             "newest-generation quarantines after audit violations",
+             self.rollbacks),
+            ("avida_supervisor_pallas_fallbacks_total", "counter",
+             "graceful degradations to the XLA path",
+             self.pallas_fallbacks),
+            ("avida_supervisor_ckpt_fallbacks_total", "counter",
+             "corrupt-checkpoint fallbacks observed in child logs",
+             self.ckpt_fallbacks),
+            ("avida_supervisor_retry_budget", "gauge",
+             "failures left before the supervisor gives up",
+             self.policy.budget_left()),
+            ("avida_supervisor_child_up", "gauge",
+             "1 while a child process is running", int(child_up)),
+            ("avida_supervisor_last_exit_code", "gauge",
+             "the previous child's exit code (negative = signal)",
+             self.last_exit_code),
+        ]
+        try:
+            write_metrics(self.metrics_path, render_families(fams),
+                          durable=False)
+        except OSError:
+            pass
+
+    def _read_heartbeat(self):
+        path = os.path.join(self.data_dir, METRICS_FILE)
+        try:
+            return read_metrics(path)
+        except OSError:
+            return None
+
+    def _effective_child_argv(self) -> list:
+        argv = list(self.child_argv)
+        if self._xla_fallback:
+            argv += ["-set", "TPU_USE_PALLAS", "2"]
+        return argv
+
+    def _stderr_tail(self, start: int = 0, nbytes: int = 8192) -> str:
+        """The current boot's log HEAD + TAIL: the head (right after
+        `start`, the log offset at launch) holds the resume-time markers
+        (checkpoint_corrupt fallbacks fire before the first update), the
+        tail holds the death traceback.  Never reads before `start`, so
+        one boot's failure markers cannot be re-classified against a
+        later boot; a long-lived chatty child cannot push the head
+        markers out of the classification window."""
+        try:
+            with open(self.child_log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(start)
+                head = f.read(min(2 * nbytes, size - start))
+                tail_from = max(size - nbytes, start + len(head))
+                tail = b""
+                if tail_from < size:
+                    f.seek(tail_from)
+                    tail = f.read()
+                return (head + b"\n...\n" + tail if tail
+                        else head).decode("utf-8", "replace")
+        except OSError:
+            return ""
+
+    def _kill_child(self, proc):
+        try:
+            proc.kill()
+        except OSError:
+            pass
+        return proc.wait()
+
+    # ---- one boot ----
+
+    def run_once(self) -> Outcome:
+        boot = self.boots
+        self.boots += 1
+        fault = self.fault_plan[boot] if boot < len(self.fault_plan) else None
+        env = dict(self._base_env)
+        if fault:
+            env["TPU_FAULT"] = fault
+        # the child must import avida_tpu the same way we did
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        argv = [sys.executable, "-m", "avida_tpu"] \
+            + self._effective_child_argv()
+        self.record("launch", fault=fault or "",
+                    xla_fallback=self._xla_fallback)
+
+        os.makedirs(self.data_dir, exist_ok=True)
+        # a restarted child inherits the PREVIOUS boot's metrics.prom --
+        # its heartbeat is stale by construction until the child's first
+        # own export, so liveness only switches from the boot-grace
+        # clock to the heartbeat clock once the timestamp ADVANCES
+        hb0 = (self._read_heartbeat() or {}).get(_HEARTBEAT)
+        with open(self.child_log_path, "a") as logf:
+            logf.write(f"--- supervisor boot {boot} ---\n")
+            logf.flush()
+            log_start = logf.tell()
+            proc = self._spawn(argv, env, logf)
+            self._proc = proc
+            t0 = self._clock()
+            watchdog_killed = anomaly_killed = False
+            anom0 = None
+            healthy_since = None
+            while True:
+                rc = proc.poll()
+                if rc is not None:
+                    break
+                now = self._clock()
+                metrics = self._read_heartbeat()
+                hb = None if metrics is None else metrics.get(_HEARTBEAT)
+                if hb is None or (hb0 is not None and hb <= hb0):
+                    if now - t0 > self.cfg.grace_sec:
+                        self.record("watchdog_kill", reason="no heartbeat",
+                                    grace_sec=self.cfg.grace_sec)
+                        rc = self._kill_child(proc)
+                        watchdog_killed = True
+                        break
+                else:
+                    age = now - hb
+                    if age > self.cfg.watchdog_sec:
+                        self.record("watchdog_kill", reason="stale heartbeat",
+                                    age_sec=round(age, 3),
+                                    watchdog_sec=self.cfg.watchdog_sec)
+                        rc = self._kill_child(proc)
+                        watchdog_killed = True
+                        break
+                    if self.cfg.anomaly_watch:
+                        anom = _anomaly_total(metrics)
+                        if anom0 is None:
+                            anom0 = anom
+                        elif anom > anom0:
+                            # flight-recorder anomaly onset: stop the run
+                            # GRACEFULLY (SIGTERM -> final checkpoint) and
+                            # roll back -- by the time a NaN shows up in
+                            # the trace it is already in the state
+                            self.record("anomaly_detected",
+                                        anomalies=anom - anom0)
+                            try:
+                                proc.terminate()
+                            except OSError:
+                                pass
+                            try:
+                                rc = proc.wait(timeout=max(
+                                    self.cfg.watchdog_sec, 30))
+                            except subprocess.TimeoutExpired:
+                                rc = self._kill_child(proc)
+                            anomaly_killed = True
+                            break
+                    if healthy_since is None:
+                        healthy_since = now
+                    elif self.policy.note_healthy(now - healthy_since):
+                        self.record("budget_reset",
+                                    healthy_sec=round(now - healthy_since, 1))
+                        healthy_since = now
+                self._sleep(self.cfg.poll_sec)
+            if rc is None:
+                rc = proc.wait()
+        self._proc = None
+        self.last_exit_code = rc
+
+        tail = self._stderr_tail(start=log_start)
+        metrics = self._read_heartbeat() or {}
+        preempted = bool(metrics.get("avida_preempted", 0)) \
+            or "] preempted at update" in tail
+        cls = classify(rc, watchdog_killed=watchdog_killed,
+                       anomaly_killed=anomaly_killed, preempted=preempted)
+        if watchdog_killed:
+            self.watchdog_kills += 1
+        # CRC/manifest fallbacks the child logged at resume time: count
+        # each corrupt GENERATION once, not once per boot -- the corrupt
+        # generation stays on disk after fallback, so every later resume
+        # re-logs the same path and would otherwise inflate the counter
+        corrupt_paths = set(
+            re.findall(r"checkpoint_corrupt: path=(\S+)", tail))
+        new_corrupt = corrupt_paths - self._corrupt_counted
+        self._corrupt_counted |= new_corrupt
+        out = Outcome(cls, rc,
+                      pallas=(cls == "crash" and pallas_suspect(tail)),
+                      corrupt_seen=bool(new_corrupt),
+                      update=metrics.get("avida_update"))
+        if new_corrupt:
+            # the child survived via CRC fallback -- record the class
+            # even though this boot may otherwise have succeeded
+            self.ckpt_fallbacks += len(new_corrupt)
+            self.failures["corrupt_ckpt"] += len(new_corrupt)
+            self.record("checkpoint_fallback_observed",
+                        paths=sorted(new_corrupt))
+        if cls in self.failures and not (cls == "corrupt_ckpt"
+                                         and out.corrupt_seen):
+            self.failures[cls] += 1
+        self.record("exit", **{"class": cls, "code": rc,
+                               "update": out.update,
+                               "pallas_suspect": out.pallas})
+        return out
+
+    # ---- recovery policies ----
+
+    def _rollback(self):
+        """Audit violation: quarantine the newest generation so --resume
+        restores the previous good one.  The rename prefix `.bad-` is
+        invisible to list_generations/restore_candidates; `ckpt_tool.py
+        --prune` sweeps quarantined generations later.  With fewer than
+        two generations there is nothing to fall back to -- leave the
+        only (audited-at-save, so good) checkpoint in place."""
+        gens = list_generations(self.ckpt_dir)
+        if len(gens) < 2:
+            self.record("rollback_skipped",
+                        reason=f"{len(gens)} generation(s) on disk")
+            return
+        newest = gens[-1]
+        dst = os.path.join(
+            os.path.dirname(newest),
+            f".bad-{os.path.basename(newest)}.{int(self._clock())}")
+        try:
+            os.rename(newest, dst)
+        except OSError as e:
+            self.record("rollback_failed", error=str(e))
+            return
+        self.rollbacks += 1
+        self.record("rollback", quarantined=newest,
+                    resumed_from=os.path.basename(gens[-2]))
+
+    # ---- the supervision loop ----
+
+    def _install_signal_forwarding(self):
+        import signal as _signal
+        saved = {}
+
+        def forward(signum, frame):
+            self._stop = True
+            proc = self._proc
+            if proc is not None:
+                try:
+                    proc.send_signal(_signal.SIGTERM)
+                except OSError:
+                    pass
+
+        for s in (_signal.SIGTERM, _signal.SIGINT):
+            try:
+                saved[s] = _signal.signal(s, forward)
+            except ValueError:
+                pass
+        return saved
+
+    def run(self) -> int:
+        """Supervise to completion.  Returns 0 on run success (or when
+        the supervisor itself was preempted after a clean child
+        checkpoint), 1 when the retry budget is exhausted."""
+        import signal as _signal
+        saved = self._install_signal_forwarding()
+        self.publish_metrics()
+        try:
+            while True:
+                if self._stop:
+                    # preempted while no child was alive (mid-backoff or
+                    # between boots): exit NOW -- launching another boot
+                    # would outlive the cluster's grace window
+                    self.record("supervisor_preempted")
+                    return 0
+                out = self.run_once()
+                if out.cls == "success":
+                    self.record("done", update=out.update)
+                    return 0
+                if self._stop:
+                    # our own SIGTERM, forwarded: the child saved its
+                    # preemption checkpoint; leave cleanly so the next
+                    # supervisor invocation resumes bit-exactly
+                    self.record("supervisor_preempted", update=out.update)
+                    return 0
+                if out.cls == "preempt":
+                    self.restarts += 1
+                    self.record("restart", reason="preempt")
+                    continue
+                if out.cls == "audit_violation":
+                    self._rollback()
+                if out.pallas and not self._xla_fallback:
+                    # graceful degradation: one free retry on the XLA
+                    # path with a LOUD warning -- slower, but alive
+                    self._xla_fallback = True
+                    self.pallas_fallbacks += 1
+                    self.restarts += 1
+                    self.record(
+                        "pallas_fallback",
+                        detail="kernel-path failure: retrying on the XLA "
+                               "path (-set TPU_USE_PALLAS 2); expect "
+                               "reduced throughput")
+                    continue
+                if not self.policy.can_retry():
+                    self.record("giving_up", failures=dict(self.failures),
+                                max_retries=self.cfg.max_retries)
+                    return 1
+                delay = self.policy.next_delay()
+                self.restarts += 1
+                self.record("backoff", delay_sec=round(delay, 3),
+                            budget_left=self.policy.budget_left())
+                # chunked so a SIGTERM mid-backoff is honored within a
+                # second, not after the full (up to backoff_cap) sleep
+                remaining = delay
+                while remaining > 0 and not self._stop:
+                    step = min(remaining, 0.5)
+                    self._sleep(step)
+                    remaining -= step
+        finally:
+            for s, h in saved.items():
+                try:
+                    _signal.signal(s, h)
+                except (ValueError, OSError):
+                    pass
+            self.publish_metrics()
+
+
+def supervise_main(argv: list) -> int:
+    """CLI entry (dispatched from avida_tpu/__main__.py before any jax
+    import): strip the supervisor's own flags, everything else is the
+    child command line."""
+    argv = list(argv)
+    argv.remove("--supervise")
+    fault_plan = ()
+    if "--fault-plan" in argv:
+        i = argv.index("--fault-plan")
+        if i + 1 >= len(argv):
+            print("--fault-plan needs an argument "
+                  "(per-boot TPU_FAULT specs separated by '/')",
+                  file=sys.stderr)
+            return 2
+        fault_plan = tuple(argv[i + 1].split("/"))
+        del argv[i:i + 2]
+    try:
+        sup = Supervisor(argv, fault_plan=fault_plan)
+    except ValueError as e:
+        print(f"[supervisor] {e}", file=sys.stderr)
+        return 2
+    return sup.run()

@@ -55,6 +55,14 @@ class CheckpointMismatchError(CheckpointError):
     an older generation cannot help, so this is never swallowed."""
 
 
+class CheckpointManifestError(CheckpointError):
+    """manifest.json is torn or unreadable (truncated mid-write by a
+    crash, or a JSON decode failure) -- distinguished from a payload CRC
+    mismatch so tooling (scripts/ckpt_tool.py --verify) can tell "the
+    save died" from "the data rotted".  Recovery is identical: skip the
+    generation and fall back."""
+
+
 # ---------------------------------------------------------------------------
 # low-level generation store (pure host / numpy -- unit-testable without jax)
 # ---------------------------------------------------------------------------
@@ -190,7 +198,8 @@ def verify_generation(path: str) -> dict:
         with open(mpath) as f:
             manifest = json.load(f)
     except (json.JSONDecodeError, OSError) as e:
-        raise CheckpointError(f"{path}: unreadable manifest ({e})")
+        raise CheckpointManifestError(
+            f"{path}: torn or unreadable manifest ({e})")
     if manifest.get("format") != FORMAT_VERSION:
         raise CheckpointError(
             f"{path}: unsupported checkpoint format "

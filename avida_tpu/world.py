@@ -248,6 +248,13 @@ class World:
             from avida_tpu.observability.exporter import MetricsExporter
             self.exporter = MetricsExporter(self)
 
+        # deterministic fault injection (utils/faultinject.py): None in
+        # every production run -- with TPU_FAULT unset no hook fires and
+        # the update program is untouched (the `nan:` kind rides
+        # params.fault_nan behind the same static gate as the tracer)
+        from avida_tpu.utils.faultinject import plan_from_config
+        self.faults = plan_from_config(cfg)
+
         # offspring reversion/sterilization via the batched Test CPU
         # (cHardwareBase::Divide_TestFitnessMeasures cc:866); fitness
         # lookups memoize per genotype (systematics/test_metrics.py)
@@ -1134,11 +1141,20 @@ class World:
         return saved
 
     def save_checkpoint(self, base_dir: str | None = None,
-                        audit: bool = True) -> str:
+                        audit: bool | None = None) -> str:
         """Write one native checkpoint generation (bit-exact run state:
         full PopulationState, PRNG keys, host counters, event cursors,
         systematics tables).  Atomic: tmp dir + fsync + rename; rolling
-        retention via TPU_CKPT_KEEP.  Returns the generation path."""
+        retention via TPU_CKPT_KEEP.  Returns the generation path.
+
+        audit=None follows TPU_CKPT_AUDIT (default 1): the invariant
+        sweep is a separate jitted program, so frequently-checkpointing
+        short-lived runs (supervised chaos children, latency-sensitive
+        tenants) can opt out of its one-off compile with
+        TPU_CKPT_AUDIT=0 -- corruption then surfaces at restore/audit
+        boundaries instead of save time."""
+        if audit is None:
+            audit = bool(int(self.cfg.get("TPU_CKPT_AUDIT", 1)))
         from avida_tpu.utils import checkpoint as ckpt_mod
         base = base_dir or self._ckpt_base()
         if base is None:
@@ -1154,9 +1170,16 @@ class World:
             from avida_tpu.utils.audit import check_invariants
             check_invariants(self.params, self.state,
                              where=f"checkpoint save (update {self.update})")
-        return ckpt_mod.save_checkpoint(base, self)
+        path = ckpt_mod.save_checkpoint(base, self)
+        if self.faults is not None:
+            # chaos hooks: corrupt-ckpt / torn-manifest mutate the
+            # generation JUST published (deterministic at-rest damage;
+            # the CRC/manifest fallback must recover on the next resume)
+            self.faults.at_save(self, path)
+        return path
 
-    def resume(self, ckpt_dir: str | None = None, audit: bool = True) -> int:
+    def resume(self, ckpt_dir: str | None = None,
+               audit: bool | None = None) -> int:
         """Restore this world from the newest VALID checkpoint generation
         and position the run loop to continue bit-exactly (the run PRNG
         stream is a pure function of the restored key and update number).
@@ -1178,6 +1201,8 @@ class World:
         from avida_tpu.observability.runlog import trim_update_records
         trim_update_records(os.path.join(self.data_dir, "telemetry.jsonl"),
                             update)
+        if audit is None:
+            audit = bool(int(self.cfg.get("TPU_CKPT_AUDIT", 1)))
         if audit:
             from avida_tpu.utils.audit import check_invariants
             check_invariants(self.params, self.state,
@@ -1205,6 +1230,12 @@ class World:
         can_chunk = (not self._revert_on and self.telemetry is None and
                      not any(ev.trigger in ("generation", "births")
                              for ev in self.events))
+        # TPU_MAX_STRETCH bounds the event-free stretch (0 = engine
+        # default).  Supervised runs set it to trade a little dispatch
+        # overhead for operational granularity: chunk boundaries gate
+        # the heartbeat export, the auto-save cadence and preemption
+        # latency, so a tighter stretch bounds all three
+        max_stretch = int(self.cfg.get("TPU_MAX_STRETCH", 0))
         try:
             while not self._exit and not self._preempt:
                 if max_updates is not None and self.update >= max_updates:
@@ -1229,6 +1260,8 @@ class World:
                     if max_updates is not None:
                         due = min(due, max_updates)
                     cap_stretch = 128.0 if self.systematics is None else 8.0
+                    if max_stretch > 0:
+                        cap_stretch = min(cap_stretch, float(max_stretch))
                     gap = int(max(1.0, min(due - self.update, cap_stretch)))
                     # power-of-two stretch buckets: at most 8 compiled
                     # variants of the scanned update program instead of one
@@ -1282,6 +1315,12 @@ class World:
                         and self.update - last_ckpt >= ckpt_every:
                     self.save_checkpoint(ckpt_base)
                     last_ckpt = self.update
+                if self.faults is not None:
+                    # injected failures fire at chunk boundaries, AFTER
+                    # any auto-save due at the same boundary (so e.g.
+                    # `sigkill@update=N` tests the resume path, not a
+                    # save race)
+                    self.faults.at_boundary(self)
             # orderly exit (normal or preempted): the phylogeny drain and,
             # on preemption, the final checkpoint both need a consistent
             # host view -- neither runs after an exception (the state may
@@ -1289,6 +1328,14 @@ class World:
             self._flush_newborn_drain()
             self._flush_trace()
             if self._preempt and ckpt_base and self.state is not None:
+                self.save_checkpoint(ckpt_base)
+            elif ckpt_base and self.state is not None \
+                    and int(self.cfg.get("TPU_CKPT_FINAL", 0)) \
+                    and self.update != last_ckpt:
+                # TPU_CKPT_FINAL=1: a completed run publishes its final
+                # state as a generation too, so downstream tooling (the
+                # chaos suite's bit-exactness proof, analyze pipelines)
+                # reads the end state without re-running the world
                 self.save_checkpoint(ckpt_base)
             self.preempted = self._preempt
             if self.exporter is not None and self.state is not None:

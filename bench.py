@@ -196,9 +196,46 @@ def main():
     if os.environ.get("BENCH_TRACE", "0") == "1":
         line.update(trace_overhead_fields(world if on_tpu else 30,
                                           updates=64 if on_tpu else 16))
+    if os.environ.get("BENCH_SUPERVISE", "0") == "1":
+        line.update(supervisor_restart_fields())
     if os.environ.get("BENCH_PHASES", "1") != "0":
         line["phases"] = phase_breakdown(world)
     print(json.dumps(line))
+
+
+def supervisor_restart_fields():
+    """BENCH_SUPERVISE=1: the supervision tax on a restart -- wall time
+    per death->classify->record->backoff->relaunch cycle
+    (service/supervisor.py), measured with a stub child that exits
+    immediately so no jax boot or compile time pollutes the number.
+    This is the floor a restarted tenant pays ON TOP of its own resume
+    cost; the fleet scheduler budgets against it."""
+    import subprocess
+    import tempfile
+
+    from avida_tpu.service.supervisor import Supervisor, SupervisorConfig
+
+    def stub_spawn(argv, env, logf):
+        return subprocess.Popen(
+            [sys.executable, "-c", "raise SystemExit(1)"],
+            env=env, stdout=logf, stderr=logf)
+
+    cycles = 6
+    with tempfile.TemporaryDirectory() as td:
+        data = os.path.join(td, "data")
+        ck = os.path.join(td, "ck")
+        os.makedirs(ck)
+        cfg = SupervisorConfig(watchdog_sec=60, poll_sec=0.005,
+                               grace_sec=60, max_retries=cycles,
+                               backoff_base=1e-4, backoff_cap=2e-4,
+                               healthy_sec=1e9)
+        sup = Supervisor(["-d", data, "-set", "TPU_CKPT_DIR", ck],
+                         cfg=cfg, spawn=stub_spawn)
+        t0 = time.perf_counter()
+        rc = sup.run()
+        dt = time.perf_counter() - t0
+        assert rc == 1 and sup.boots == cycles + 1
+    return {"supervisor_restart_ms": round(dt / sup.boots * 1e3, 2)}
 
 
 def ckpt_audit_overhead(params, st):

@@ -39,8 +39,18 @@ def _force_cpu():
         sys.path.insert(0, repo)
 
 
+_COMPUTED = None
+
+
 def compute() -> dict:
-    """Trace the production update_step and digest the jaxpr string."""
+    """Trace the production update_step and digest the jaxpr string.
+    Memoized per process: the digest of a fixed program cannot change
+    within one interpreter, and two tier-1 tests consult it
+    (tests/test_jaxpr_snapshot.py and the fault-off gate in
+    tests/test_chaos.py) -- one trace, not two."""
+    global _COMPUTED
+    if _COMPUTED is not None:
+        return dict(_COMPUTED)
     import jax
     import jax.numpy as jnp
 
@@ -62,12 +72,13 @@ def compute() -> dict:
     jx = str(jax.make_jaxpr(
         lambda s, k, u: update_step(p, s, k, nb, u))(
             st, jax.random.key(0), jnp.int32(0)))
-    return {
+    _COMPUTED = {
         "update_step_sha256": hashlib.sha256(jx.encode()).hexdigest(),
         "jaxpr_lines": jx.count("\n") + 1,
         "jax_version": jax.__version__,
         "platform": jax.devices()[0].platform,
     }
+    return dict(_COMPUTED)
 
 
 def check(current: dict | None = None) -> tuple[bool, str]:

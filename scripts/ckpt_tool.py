@@ -1,23 +1,39 @@
-"""Checkpoint directory inspector/verifier (utils/checkpoint.py format).
+"""Checkpoint directory inspector/verifier/janitor (utils/checkpoint.py).
 
 Usage:
     python scripts/ckpt_tool.py <ckpt_dir>            # list generations
     python scripts/ckpt_tool.py <ckpt_dir> --verify   # full CRC sweep
     python scripts/ckpt_tool.py <ckpt_dir> --manifest # dump newest manifest
+    python scripts/ckpt_tool.py <ckpt_dir> --prune [--keep N]
+                                                      # sweep strays +
+                                                      # retention overflow
 
 List mode shows, per generation: update number, save time, array count,
 total bytes and a cheap manifest-presence status.  --verify re-reads
 every array and sidecar, checking each CRC32 against the manifest -- the
 same validation World.resume runs, usable from an ops shell to answer
 "can this run be resumed, and from which generation?" without loading
-jax or touching the device.  Exit status: 0 when at least one generation
-verifies, 1 otherwise.
+jax or touching the device.  A TORN MANIFEST (truncated mid-write by a
+crash: JSON decode failure) is reported distinctly from payload CRC
+corruption -- the first means the save died, the second means data
+rotted at rest.  Exit status: 0 when at least one generation verifies,
+1 otherwise.
+
+--prune removes stranded publish debris (`.tmp-*`, `.bad-*` supervisor
+quarantines, and `.old-*` publish asides -- the latter only once a
+published generation verifies, because an aside can be the sole
+resumable copy after a crash inside the publish window) and any
+generation beyond the retention window (--keep N, default TPU_CKPT_KEEP
+or 2).  The newest VERIFYING generation is never removed, even when
+newer-but-corrupt generations fill the keep window.  Prints every path
+it removes; exit 0.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import shutil
 import sys
 import time
 
@@ -34,19 +50,99 @@ def _dir_bytes(path: str) -> int:
                if os.path.isfile(os.path.join(path, f)))
 
 
-def main() -> int:
+def verify_status(path: str) -> tuple:
+    """(ok, status_line) for one generation -- distinguishes a torn
+    manifest from payload corruption."""
     _repo_path()
-    from avida_tpu.utils.checkpoint import (CheckpointError, MANIFEST,
+    from avida_tpu.utils.checkpoint import (CheckpointError,
+                                            CheckpointManifestError,
+                                            verify_generation)
+    try:
+        manifest = verify_generation(path)
+        return True, "OK (verified)", manifest
+    except CheckpointManifestError as e:
+        return False, f"TORN MANIFEST -- {e}", None
+    except (CheckpointError, OSError) as e:
+        return False, f"CORRUPT -- {e}", None
+
+
+def prune(base: str, keep: int) -> list:
+    """Remove stranded `.tmp-*`/`.bad-*` entries, `.old-*` publish
+    asides, and published generations beyond the newest `keep`.
+    Returns removed paths.
+
+    Safety: an `.old-*` aside can be the ONLY resumable copy -- a crash
+    inside write_generation's two-rename publish window leaves the old
+    generation moved aside and nothing published, and
+    restore_candidates() resumes from exactly that aside.  Asides are
+    therefore only swept once at least one PUBLISHED generation
+    verifies (the same condition under which the engine's own post-save
+    sweep runs)."""
+    _repo_path()
+    from avida_tpu.utils.checkpoint import (CheckpointError,
                                             list_generations,
                                             verify_generation)
+    removed = []
+    if not os.path.isdir(base):
+        return removed
+    newest_valid = None
+    for gen in reversed(list_generations(base)):
+        try:
+            verify_generation(gen)
+            newest_valid = gen
+            break
+        except (CheckpointError, OSError):
+            continue
+    for d in sorted(os.listdir(base)):
+        if d.startswith((".tmp-", ".bad-")) \
+                or (d.startswith(".old-") and newest_valid is not None):
+            p = os.path.join(base, d)
+            shutil.rmtree(p, ignore_errors=True)
+            removed.append(p)
+    gens = list_generations(base)
+    for old in gens[:-max(int(keep), 1)]:
+        if old == newest_valid:
+            # retention must never delete the only generation a resume
+            # can actually use (newer ones may all be corrupt)
+            continue
+        shutil.rmtree(old, ignore_errors=True)
+        removed.append(old)
+    return removed
 
-    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+
+def main(argv=None) -> int:
+    _repo_path()
+    from avida_tpu.utils.checkpoint import MANIFEST, list_generations
+
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    args = [a for a in argv if not a.startswith("--")]
     if not args:
         print(__doc__)
         return 1
     base = args[0]
-    do_verify = "--verify" in sys.argv
-    do_manifest = "--manifest" in sys.argv
+    do_verify = "--verify" in argv
+    do_manifest = "--manifest" in argv
+
+    if "--prune" in argv:
+        if "--keep" in argv:
+            i = argv.index("--keep")
+            if i + 1 >= len(argv) or not argv[i + 1].isdigit():
+                print("--keep needs an integer argument")
+                return 2
+            keep = int(argv[i + 1])
+            args.remove(argv[i + 1])    # not a directory operand
+        else:
+            keep = int(os.environ.get("TPU_CKPT_KEEP", 2))
+        if not args:
+            print(__doc__)
+            return 1
+        base = args[0]
+        removed = prune(base, keep)
+        for p in removed:
+            print(f"pruned {p}")
+        print(f"{len(removed)} path(s) removed, "
+              f"{len(list_generations(base))} generation(s) kept")
+        return 0
 
     gens = list_generations(base)
     if not gens:
@@ -56,31 +152,36 @@ def main() -> int:
     any_ok = False
     for path in gens:
         name = os.path.basename(path)
-        mpath = os.path.join(path, MANIFEST)
-        try:
-            if do_verify:
-                manifest = verify_generation(path)
-                status = "OK (verified)"
-            else:
-                with open(mpath) as f:
+        if do_verify:
+            ok, status, manifest = verify_status(path)
+        else:
+            try:
+                with open(os.path.join(path, MANIFEST)) as f:
                     manifest = json.load(f)
-                status = "present"
-            any_ok = True
-            saved = time.strftime("%Y-%m-%d %H:%M:%S",
-                                  time.localtime(manifest.get("saved_at", 0)))
-            print(f"{name}: update {manifest.get('update')}, saved {saved}, "
-                  f"{len(manifest.get('arrays', {}))} arrays, "
-                  f"{_dir_bytes(path) / 1e6:.2f} MB, {status}")
-        except (CheckpointError, OSError, json.JSONDecodeError) as e:
-            print(f"{name}: CORRUPT -- {e}")
+                ok, status = True, "present"
+            except (OSError, json.JSONDecodeError) as e:
+                ok, status, manifest = False, f"TORN MANIFEST -- {e}", None
+        if not ok:
+            print(f"{name}: {status}")
+            continue
+        any_ok = True
+        saved = time.strftime("%Y-%m-%d %H:%M:%S",
+                              time.localtime(manifest.get("saved_at", 0)))
+        print(f"{name}: update {manifest.get('update')}, saved {saved}, "
+              f"{len(manifest.get('arrays', {}))} arrays, "
+              f"{_dir_bytes(path) / 1e6:.2f} MB, {status}")
 
     if do_manifest and any_ok:
         for path in reversed(gens):
-            try:
-                manifest = verify_generation(path) if do_verify else \
-                    json.load(open(os.path.join(path, MANIFEST)))
-            except Exception:
-                continue
+            if do_verify:
+                ok, _, manifest = verify_status(path)
+                if not ok:
+                    continue
+            else:
+                try:
+                    manifest = json.load(open(os.path.join(path, MANIFEST)))
+                except Exception:
+                    continue
             print(json.dumps(manifest, indent=1))
             break
     return 0 if any_ok else 1

@@ -1,0 +1,396 @@
+"""Chaos suite: the self-healing proof, with REAL child processes.
+
+A supervised run is SIGKILLed / hung / corrupted at seeded, injected
+fault points (utils/faultinject.py), the supervisor
+(service/supervisor.py) recovers it without human input, and the final
+PopulationState is BIT-EXACT versus an uninterrupted run -- read from
+the TPU_CKPT_FINAL generation, so the pytest process never compiles the
+world itself.
+
+Tier split (1-core host: children run sequentially, never concurrent
+with other jax work): one single-SIGKILL recovery proof stays in
+tier-1; the multi-kill, Pallas-path, hang-watchdog and
+corrupt-checkpoint proofs are `slow`.  Every child boot pays its own
+jit compile -- see _env() for why the persistent compilation cache is
+deliberately NOT used.
+
+Also here (fast, in-process): the guarantee that the fault-injection
+OFF path leaves the production update program untouched -- with
+TPU_FAULT unset, `update_step` traces to the recorded jaxpr digest
+(scripts/jaxpr_digest.json), and only an active `nan:` fault changes
+the traced program.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from avida_tpu.service.supervisor import Supervisor, SupervisorConfig
+from avida_tpu.utils import checkpoint as ckpt_mod
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+import check_jaxpr  # noqa: E402
+
+SEED = 11
+UPDATES = 20
+
+# world config shared by every child AND the uninterrupted reference:
+# small world, capped slices, systematics off (PR-4 proved chunked
+# bit-exactness without it), TPU_MAX_STRETCH=2 so chunk boundaries --
+# the fault/save/heartbeat points -- come every 2 updates
+_SETS = [
+    ("WORLD_X", "8"), ("WORLD_Y", "8"), ("TPU_MAX_MEMORY", "256"),
+    ("AVE_TIME_SLICE", "100"), ("TPU_MAX_STEPS_PER_UPDATE", "100"),
+    ("TPU_SYSTEMATICS", "0"), ("TPU_MAX_STRETCH", "2"),
+    ("TPU_CKPT_EVERY", "4"), ("TPU_CKPT_FINAL", "1"),
+]
+
+
+def _argv(data_dir, ckpt_dir, extra=(), updates=UPDATES):
+    argv = ["-s", str(SEED), "-u", str(updates), "-d", str(data_dir),
+            "-set", "TPU_CKPT_DIR", str(ckpt_dir)]
+    for name, value in _SETS:
+        argv += ["-set", name, value]
+    for name, value in extra:
+        argv += ["-set", name, value]
+    return argv
+
+
+def _env():
+    env = dict(os.environ)
+    env.pop("TPU_FAULT", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    # NOTE: deliberately NO persistent jax compilation cache here --
+    # JAX_COMPILATION_CACHE_DIR on this CPU toolchain corrupts resumed
+    # runs (heap corruption + garbage state observed under jax 0.4.37
+    # with donated buffers), so every child boot pays its own compile
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    return env
+
+
+def _sup_cfg(**overrides):
+    kw = dict(watchdog_sec=120.0, poll_sec=0.25, grace_sec=600.0,
+              max_retries=6, backoff_base=0.05, backoff_cap=0.2,
+              healthy_sec=1e9, seed=3)
+    kw.update(overrides)
+    return SupervisorConfig(**kw)
+
+
+def _final_gen(ckpt_dir):
+    gens = ckpt_mod.list_generations(str(ckpt_dir))
+    assert gens, f"no generations under {ckpt_dir}"
+    manifest, arrays, files = ckpt_mod.read_generation(gens[-1])
+    return manifest, arrays
+
+
+def _assert_bit_exact(ckpt_dir, ref):
+    manifest, arrays = _final_gen(ckpt_dir)
+    assert manifest["update"] == ref["manifest"]["update"] == UPDATES
+    assert set(arrays) == set(ref["arrays"])
+    for name in sorted(arrays):
+        np.testing.assert_array_equal(arrays[name], ref["arrays"][name],
+                                      err_msg=f"array {name}")
+
+
+@pytest.fixture(scope="module")
+def ref_run(tmp_path_factory):
+    """The uninterrupted reference: one plain (unsupervised) child run
+    to completion, final state published via TPU_CKPT_FINAL."""
+    base = tmp_path_factory.mktemp("chaos_ref")
+    data, ck = str(base / "data"), str(base / "ck")
+    proc = subprocess.run(
+        [sys.executable, "-m", "avida_tpu"] + _argv(data, ck),
+        env=_env(), capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    manifest, arrays = _final_gen(ck)
+    return {"manifest": manifest, "arrays": arrays}
+
+
+def _supervise(tmp_path, ref, fault_plan, extra=(), cfg=None,
+               updates=UPDATES):
+    data, ck = str(tmp_path / "data"), str(tmp_path / "ck")
+    sup = Supervisor(_argv(data, ck, extra=extra, updates=updates),
+                     fault_plan=fault_plan, cfg=cfg or _sup_cfg(),
+                     env=_env())
+    rc = sup.run()
+    return sup, rc, data, ck
+
+
+# ---------------------------------------------------------------------------
+# fast, in-process: TPU_FAULT off => production jaxpr untouched
+# ---------------------------------------------------------------------------
+
+def _digest(fault_spec):
+    import hashlib
+
+    import jax
+    import jax.numpy as jnp
+
+    from avida_tpu.config import AvidaConfig
+    from avida_tpu.config.environment import default_logic9_environment
+    from avida_tpu.config.instset import default_instset
+    from avida_tpu.core.state import make_world_params, zeros_population
+    from avida_tpu.ops import birth as birth_ops
+    from avida_tpu.ops.update import update_step
+
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 6
+    cfg.WORLD_Y = 6
+    cfg.TPU_MAX_MEMORY = 64
+    if fault_spec:
+        cfg.set("TPU_FAULT", fault_spec)
+    p = make_world_params(cfg, default_instset(),
+                          default_logic9_environment())
+    st = zeros_population(p.num_cells, p.max_memory, p.num_reactions)
+    nb = jnp.asarray(birth_ops.neighbor_table(6, 6, p.geometry))
+    jx = str(jax.make_jaxpr(
+        lambda s, k, u: update_step(p, s, k, nb, u))(
+            st, jax.random.key(0), jnp.int32(0)))
+    return p, hashlib.sha256(jx.encode()).hexdigest()
+
+
+def test_fault_off_leaves_update_step_jaxpr_unchanged():
+    """The satellite CI gate: TPU_FAULT unset => update_step traces to
+    the recorded snapshot digest.  The trace itself is shared with the
+    existing gate -- check_jaxpr.compute() runs in an environment with
+    no fault spec, so it IS the fault-off path; this re-asserts it
+    post-wiring and pins the param plumbing (every host-side kind stays
+    out of WorldParams; tier-1 cost: one cached check, no extra
+    trace)."""
+    ok, msg = check_jaxpr.check()
+    assert ok, ("fault-injection off path changed the production update "
+                "program (re-record only for INTENTIONAL trace changes): "
+                + msg)
+    # nan wiring reaches params (and only nan does) -- pure host asserts
+    from avida_tpu.config import AvidaConfig
+    from avida_tpu.core.state import _fault_nan_param
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 6
+    cfg.WORLD_Y = 6
+    assert _fault_nan_param(cfg) == ()
+    cfg.set("TPU_FAULT", "nan:merit@update=3")
+    assert _fault_nan_param(cfg) == ("merit", 18, 3)
+
+
+@pytest.mark.slow
+def test_fault_on_changes_the_traced_program():
+    """The off-path gate above is not vacuous: an active nan fault
+    traces a DIFFERENT update program (one extra trace -- slow tier, the
+    off path is the one tier-1 must guard)."""
+    p_off, off = _digest(None)
+    assert p_off.fault_nan == ()
+    p_on, on = _digest("nan:merit@update=3")
+    assert p_on.fault_nan == ("merit", 18, 3)
+    assert on != off
+
+
+def test_host_fault_kinds_leave_params_untouched():
+    """Host-side kinds (crash/sigkill/hang/ckpt corruption) never reach
+    WorldParams -- only `nan:` is traced."""
+    from avida_tpu.config import AvidaConfig
+    from avida_tpu.core.state import _fault_nan_param
+    for spec in ("crash@update=120", "sigkill@chunk=3", "hang@chunk=2",
+                 "corrupt-ckpt:leaf=merit;torn-manifest"):
+        cfg = AvidaConfig()
+        cfg.set("TPU_FAULT", spec)
+        assert _fault_nan_param(cfg) == ()
+
+
+# ---------------------------------------------------------------------------
+# tier-1: one seeded SIGKILL at a non-save boundary, supervised recovery
+# ---------------------------------------------------------------------------
+
+def test_supervised_sigkill_recovery(tmp_path):
+    """The tier-1 recovery proof, sized for the suite budget (two child
+    processes, light slices, no separate reference run): the child is
+    SIGKILLed at the update-6 chunk boundary -- PAST the last auto-save
+    at update 4, so the crash outran the checkpoint -- and the
+    supervisor restarts it with --resume to a clean finish, recording
+    the crash class in runlog + metrics.  The bit-exact-vs-uninterrupted
+    versions of this drill (single reference, >=3 kills, XLA and Pallas)
+    are the slow tests below."""
+    extra = (("AVE_TIME_SLICE", "30"), ("TPU_MAX_STEPS_PER_UPDATE", "30"),
+             ("TPU_CKPT_AUDIT", "0"))
+    # minimal event list (Inject only): skips the update-0 Print actions
+    # and their one-off summarize compile in BOTH child boots
+    cfgdir = tmp_path / "cfg"
+    os.makedirs(cfgdir)
+    (cfgdir / "avida.cfg").write_text("")
+    (cfgdir / "events.cfg").write_text("u begin Inject default-heads.org\n")
+    data, ck = str(tmp_path / "data"), str(tmp_path / "ck")
+    argv = ["-c", str(cfgdir), "-set", "INST_SET", "-"] \
+        + _argv(data, ck, extra=extra, updates=10)
+    sup = Supervisor(argv, fault_plan=["sigkill@update=5"],
+                     cfg=_sup_cfg(), env=_env())
+    rc = sup.run()
+    assert rc == 0
+    assert sup.boots == 2
+    assert sup.failures["crash"] == 1 and sup.restarts == 1
+    # the second boot really resumed from the update-4 generation and
+    # REPLAYED 4..10 (stderr echoes the runlog event)
+    log = open(os.path.join(data, "supervised.log")).read()
+    assert "ckpt-000000000004 update=4" in log
+    manifest, arrays = _final_gen(ck)
+    assert manifest["update"] == 10
+    assert "state.alive" in arrays
+    # supervisor breadcrumbs: runlog + prometheus counters
+    recs = [json.loads(line)
+            for line in open(os.path.join(data, "supervisor.jsonl"))]
+    assert [r["event"] for r in recs].count("launch") == 2
+    from avida_tpu.observability.exporter import read_metrics
+    m = read_metrics(os.path.join(data, "supervisor.prom"))
+    assert m['avida_supervisor_failures_total{class="crash"}'] == 1
+    assert m["avida_supervisor_boots_total"] == 2
+
+
+@pytest.mark.slow
+def test_supervised_single_sigkill_bit_exact(tmp_path, ref_run):
+    """The strict version of the tier-1 drill: same single kill at a
+    non-save boundary, final state bit-exact vs the uninterrupted
+    reference."""
+    sup, rc, data, ck = _supervise(tmp_path, ref_run,
+                                   fault_plan=["sigkill@update=5"])
+    assert rc == 0 and sup.boots == 2
+    _assert_bit_exact(ck, ref_run)
+
+
+# ---------------------------------------------------------------------------
+# slow: the full chaos drill
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_supervised_multi_sigkill_bit_exact_xla(tmp_path, ref_run):
+    """Three SIGKILLs at seeded random chunk boundaries, one per boot;
+    re-supervised to completion; bit-exact final state (acceptance:
+    >= 3 random seeded kills, XLA path)."""
+    rng = np.random.default_rng(0xC4A05)
+    kills = sorted(int(u) for u in
+                   rng.choice(np.arange(3, UPDATES - 2), size=3,
+                              replace=False))
+    plan = [f"sigkill@update={u}" for u in kills]
+    sup, rc, data, ck = _supervise(tmp_path, ref_run, fault_plan=plan)
+    assert rc == 0
+    assert sup.boots == 4 and sup.failures["crash"] == 3
+    _assert_bit_exact(ck, ref_run)
+
+
+@pytest.mark.slow
+def test_supervised_multi_sigkill_bit_exact_pallas(tmp_path,
+                                                   tmp_path_factory):
+    """The same multi-kill drill through the lane-packed Pallas kernel
+    path (interpret mode on CPU), with its own uninterrupted
+    reference.  Config mirrors the known-good kernel-path resume test
+    (tests/test_native_checkpoint.py): deterministic slicing, no
+    mutations, lane_perm refreshed every update."""
+    extra = (("TPU_USE_PALLAS", "1"), ("SLICING_METHOD", "0"),
+             ("COPY_MUT_PROB", "0.0"), ("DIVIDE_INS_PROB", "0.0"),
+             ("DIVIDE_DEL_PROB", "0.0"))
+    data0, ck0 = str(tmp_path / "refdata"), str(tmp_path / "refck")
+    proc = subprocess.run(
+        [sys.executable, "-m", "avida_tpu"] + _argv(data0, ck0, extra=extra),
+        env=_env(), capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    manifest, arrays = _final_gen(ck0)
+    ref = {"manifest": manifest, "arrays": arrays}
+    # the packed path must actually be active with lane packing on
+    assert "state.lane_perm" in arrays
+    assert not np.array_equal(arrays["state.lane_perm"],
+                              np.arange(arrays["state.lane_perm"].size))
+
+    rng = np.random.default_rng(0xC4A06)
+    kills = sorted(int(u) for u in
+                   rng.choice(np.arange(3, UPDATES - 2), size=3,
+                              replace=False))
+    sup, rc, data, ck = _supervise(
+        tmp_path, ref, fault_plan=[f"sigkill@update={u}" for u in kills],
+        extra=extra)
+    assert rc == 0
+    assert sup.failures["crash"] == 3
+    _assert_bit_exact(ck, ref)
+
+
+@pytest.mark.slow
+def test_hang_watchdog_kill_and_resume(tmp_path, ref_run):
+    """An injected hang at the third chunk boundary goes heartbeat-stale;
+    the watchdog SIGKILLs it and the restart completes bit-exactly --
+    no human input (acceptance: hang proof)."""
+    sup, rc, data, ck = _supervise(
+        tmp_path, ref_run, fault_plan=["hang@chunk=3"],
+        cfg=_sup_cfg(watchdog_sec=4.0, poll_sec=0.25))
+    assert rc == 0
+    assert sup.failures["hang"] == 1 and sup.watchdog_kills == 1
+    _assert_bit_exact(ck, ref_run)
+    recs = [json.loads(line)
+            for line in open(os.path.join(data, "supervisor.jsonl"))]
+    kills = [r for r in recs if r["event"] == "watchdog_kill"]
+    assert kills and kills[0]["reason"] == "stale heartbeat"
+    from avida_tpu.observability.exporter import read_metrics
+    m = read_metrics(os.path.join(data, "supervisor.prom"))
+    assert m['avida_supervisor_failures_total{class="hang"}'] == 1
+
+
+@pytest.mark.slow
+def test_corrupt_ckpt_generation_skipped_and_classified(tmp_path, ref_run):
+    """A checkpoint generation is byte-flipped at rest, then the run is
+    killed: resume skips the corrupt generation via CRC fallback (one
+    older generation back) and the supervisor records the corrupt_ckpt
+    class in its runlog and metrics (acceptance: corrupt-ckpt proof)."""
+    sup, rc, data, ck = _supervise(
+        tmp_path, ref_run,
+        fault_plan=["corrupt-ckpt:leaf=merit@update=8;sigkill@update=9"])
+    assert rc == 0
+    assert sup.failures["crash"] == 1            # the sigkill
+    assert sup.failures["corrupt_ckpt"] == 1     # the CRC fallback, seen
+    assert sup.ckpt_fallbacks == 1
+    _assert_bit_exact(ck, ref_run)
+    log = open(os.path.join(data, "supervised.log")).read()
+    assert "checkpoint_corrupt" in log and "checkpoint_restored" in log
+    from avida_tpu.observability.exporter import read_metrics
+    m = read_metrics(os.path.join(data, "supervisor.prom"))
+    assert m['avida_supervisor_failures_total{class="corrupt_ckpt"}'] == 1
+
+
+@pytest.mark.slow
+def test_torn_manifest_generation_skipped_on_resume(tmp_path, ref_run):
+    """Same drill with a manifest torn mid-write instead of payload rot:
+    the resume falls back past the unreadable generation (the
+    deterministic world-level version of the torn-manifest satellite)."""
+    sup, rc, data, ck = _supervise(
+        tmp_path, ref_run,
+        fault_plan=["torn-manifest@update=8;sigkill@update=9"])
+    assert rc == 0
+    _assert_bit_exact(ck, ref_run)
+    log = open(os.path.join(data, "supervised.log")).read()
+    assert "checkpoint_corrupt" in log
+    assert "torn or unreadable manifest" in log
+
+
+@pytest.mark.slow
+def test_nan_injection_audit_rollback_recovery(tmp_path, ref_run):
+    """Device-side NaN lands in merit at update 6; the periodic auditor
+    trips (StateInvariantError -> classified exit), the supervisor
+    ROLLS BACK (quarantines the newest generation) and the restarted
+    child -- fault no longer injected -- replays to a bit-exact
+    finish."""
+    sup, rc, data, ck = _supervise(
+        tmp_path, ref_run, fault_plan=["nan:merit@update=6"],
+        extra=(("TPU_AUDIT_EVERY", "2"), ("TPU_CKPT_EVERY", "2")))
+    assert rc == 0
+    assert sup.failures["audit_violation"] == 1
+    assert sup.rollbacks == 1
+    assert [d for d in os.listdir(ck) if d.startswith(".bad-")]
+    _assert_bit_exact(ck, ref_run)
+    log = open(os.path.join(data, "supervised.log")).read()
+    assert "merit_finite" in log                 # the auditor named it
+    recs = [json.loads(line)
+            for line in open(os.path.join(data, "supervisor.jsonl"))]
+    assert "rollback" in [r["event"] for r in recs]

@@ -120,6 +120,41 @@ def test_stale_tmp_swept(tmp_path):
     assert not [d for d in os.listdir(base) if d.startswith(".tmp-")]
 
 
+def test_resume_falls_back_past_torn_manifest(tmp_path):
+    """A manifest.json truncated MID-WRITE (the fault framework's
+    deterministic torn-manifest mode, utils/faultinject.py) raises the
+    distinct CheckpointManifestError and the restore scan falls back to
+    the previous generation -- resume survives a torn save, not just
+    bad-CRC leaves."""
+    from avida_tpu.utils import faultinject as fi
+    base = str(tmp_path / "ck")
+    good = ckpt_mod.write_generation(base, 10, _arrays(), {"u": 10}, keep=3)
+    newest = ckpt_mod.write_generation(base, 20, _arrays(), {"u": 20}, keep=3)
+    fi.tear_manifest(newest, fi.parse_spec("torn-manifest", seed=4)[0].rng)
+    with pytest.raises(ckpt_mod.CheckpointManifestError, match="manifest"):
+        ckpt_mod.verify_generation(newest)
+
+    skipped = []
+    path, manifest = ckpt_mod.latest_valid(
+        base, on_skip=lambda p, e: skipped.append((p, e)))
+    assert path == good and manifest["update"] == 10
+    assert [p for p, _ in skipped] == [newest]
+    assert isinstance(skipped[0][1], ckpt_mod.CheckpointManifestError)
+
+
+def test_resume_falls_back_past_empty_manifest(tmp_path):
+    """Truncation edge: the crash landed before ANY manifest byte was
+    flushed (0-byte file).  Still a torn manifest, still skipped."""
+    base = str(tmp_path / "ck")
+    good = ckpt_mod.write_generation(base, 10, _arrays(), {}, keep=3)
+    newest = ckpt_mod.write_generation(base, 20, _arrays(), {}, keep=3)
+    os.truncate(os.path.join(newest, ckpt_mod.MANIFEST), 0)
+    with pytest.raises(ckpt_mod.CheckpointManifestError):
+        ckpt_mod.verify_generation(newest)
+    path, manifest = ckpt_mod.latest_valid(base)
+    assert path == good and manifest["update"] == 10
+
+
 # ---------------------------------------------------------------------------
 # fast: .spop sequence symbol encoding satellite (a-z then A-Z, cap 52)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,794 @@
+"""Run supervisor + fault-injection unit tier (service/, utils/faultinject).
+
+Everything here is host-only and drives the supervisor with a FAKE
+clock, FAKE sleeps and SCRIPTED fake child processes -- no jax, no real
+subprocesses, no real time.  The end-to-end chaos proofs with real
+children live in tests/test_chaos.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from avida_tpu.service import EXIT_AUDIT, EXIT_CKPT
+from avida_tpu.service.backoff import RetryPolicy
+from avida_tpu.service.supervisor import (Supervisor, SupervisorConfig,
+                                          classify, pallas_suspect)
+from avida_tpu.utils import checkpoint as ckpt_mod
+from avida_tpu.utils import faultinject as fi
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+import ckpt_tool  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# backoff / retry budget (fake clock: zero real sleeps)
+# ---------------------------------------------------------------------------
+
+def test_backoff_cap_and_jitter_bounds():
+    p = RetryPolicy(max_retries=50, base=0.5, cap=8.0, seed=3)
+    prev = 0.5
+    for _ in range(50):
+        d = p.next_delay()
+        assert 0.5 <= d <= 8.0                    # cap honored, base floor
+        assert d <= max(prev * 3, 0.5) + 1e-9     # decorrelated jitter bound
+        prev = d
+    assert not p.can_retry()
+
+
+def test_backoff_delays_are_seeded_and_decorrelated():
+    a = [RetryPolicy(seed=7).next_delay() for _ in range(1)]
+    b = [RetryPolicy(seed=7).next_delay() for _ in range(1)]
+    assert a == b                                  # reproducible
+    c = RetryPolicy(seed=8).next_delay()
+    assert c != a[0]                               # seed actually used
+    p = RetryPolicy(seed=7)
+    ds = [p.next_delay() for _ in range(6)]
+    assert len(set(round(d, 6) for d in ds)) > 1   # jittered, not a ladder
+
+
+def test_backoff_budget_resets_after_sustained_health():
+    p = RetryPolicy(max_retries=2, base=1.0, cap=30.0, healthy_sec=60.0)
+    p.next_delay()
+    p.next_delay()
+    assert not p.can_retry()
+    assert not p.note_healthy(59.9)                # not sustained yet
+    assert not p.can_retry()
+    assert p.note_healthy(60.0)                    # refill
+    assert p.can_retry() and p.budget_left() == 2
+    # and the backoff ladder restarts from base
+    assert p.next_delay() <= 3.0
+
+
+def test_backoff_rejects_bad_window():
+    with pytest.raises(ValueError):
+        RetryPolicy(base=0.0)
+    with pytest.raises(ValueError):
+        RetryPolicy(base=2.0, cap=1.0)
+
+
+# ---------------------------------------------------------------------------
+# TPU_FAULT spec grammar
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_grammar():
+    (f,) = fi.parse_spec("crash@update=120")
+    assert f.kind == "crash" and f.trigger == ("update", 120)
+    (f,) = fi.parse_spec("sigkill@chunk=3")
+    assert f.trigger == ("chunk", 3)
+    (f,) = fi.parse_spec("corrupt-ckpt:leaf=merit")
+    assert f.args == {"leaf": "merit"} and f.trigger is None
+    (f,) = fi.parse_spec("nan:merit@update=200")   # bare value -> leaf
+    assert f.kind == "nan" and f.args == {"leaf": "merit"}
+    (f,) = fi.parse_spec("hang:sec=5@chunk=2")
+    assert float(f.args["sec"]) == 5.0
+    two = fi.parse_spec(" corrupt-ckpt:leaf=merit ; sigkill@update=8 ")
+    assert [x.kind for x in two] == ["corrupt-ckpt", "sigkill"]
+
+
+def test_save_kinds_reject_chunk_triggers():
+    # save-time faults fire on checkpoint publishes; a @chunk trigger
+    # would be silently meaningless there, so the parser refuses it
+    with pytest.raises(ValueError, match="save-time kinds"):
+        fi.parse_spec("corrupt-ckpt@chunk=3")
+    with pytest.raises(ValueError, match="save-time kinds"):
+        fi.parse_spec("torn-manifest@chunk=1")
+    fi.parse_spec("corrupt-ckpt@update=8")         # @update stays legal
+
+
+def test_fault_spec_rejects_garbage():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        fi.parse_spec("meteor@update=1")
+    with pytest.raises(ValueError, match="nan requires @update"):
+        fi.parse_spec("nan:merit")
+    with pytest.raises(ValueError, match="nan requires @update"):
+        fi.parse_spec("nan:merit@chunk=2")
+    with pytest.raises(ValueError, match="leaf must be one of"):
+        fi.parse_spec("nan:alive@update=3")
+    with pytest.raises(ValueError, match="trigger"):
+        fi.parse_spec("crash@whenever=1")
+    with pytest.raises(ValueError, match="no bare argument"):
+        fi.parse_spec("crash:hard")
+    with pytest.raises(ValueError, match="empty"):
+        fi.parse_spec(" ; ")
+
+
+def test_fault_due_semantics():
+    (f,) = fi.parse_spec("crash@update=10")
+    assert not f.due(update=9, chunk=99) and f.due(update=10, chunk=1)
+    (f,) = fi.parse_spec("crash@chunk=2")
+    assert not f.due(update=99, chunk=1) and f.due(update=0, chunk=2)
+    (f,) = fi.parse_spec("crash")
+    assert f.due(update=0, chunk=1)                # first boundary
+
+
+def test_fault_seeding_is_deterministic():
+    a = fi.parse_spec("torn-manifest", seed=5)[0].rng.random()
+    b = fi.parse_spec("torn-manifest", seed=5)[0].rng.random()
+    c = fi.parse_spec("torn-manifest", seed=6)[0].rng.random()
+    assert a == b and a != c
+
+
+# ---------------------------------------------------------------------------
+# host-side corruption helpers against real generation dirs
+# ---------------------------------------------------------------------------
+
+def _gen(base, update=1, keep=4):
+    arrays = {"state.merit": np.linspace(0, 1, 64).astype(np.float32),
+              "state.alive": np.ones(64, bool)}
+    return ckpt_mod.write_generation(str(base), update, arrays,
+                                     {"update": update}, keep=keep)
+
+
+def test_corrupt_leaf_is_crc_detectable(tmp_path):
+    path = _gen(tmp_path / "ck")
+    fi.corrupt_leaf(path, "merit", fi.parse_spec("corrupt-ckpt", seed=1)[0].rng)
+    with pytest.raises(ckpt_mod.CheckpointError, match="CRC mismatch"):
+        ckpt_mod.verify_generation(path)
+    with pytest.raises(ValueError, match="no state.fitness"):
+        fi.corrupt_leaf(path, "fitness")
+
+
+def test_tear_manifest_is_distinct_error_class(tmp_path):
+    path = _gen(tmp_path / "ck")
+    kept = fi.tear_manifest(path)
+    assert 0 <= kept < os.path.getsize(os.path.join(path, "manifest.json")) \
+        + 1
+    with pytest.raises(ckpt_mod.CheckpointManifestError, match="manifest"):
+        ckpt_mod.verify_generation(path)
+    # the torn-manifest class is still a CheckpointError (restore
+    # fallback catches one type), but NOT a CRC mismatch
+    assert issubclass(ckpt_mod.CheckpointManifestError,
+                      ckpt_mod.CheckpointError)
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+def test_failure_classification_table():
+    assert classify(0) == "success"
+    assert classify(0, preempted=True) == "preempt"
+    assert classify(1) == "crash"
+    assert classify(-9) == "crash"                 # SIGKILL'd from outside
+    assert classify(EXIT_AUDIT) == "audit_violation"
+    assert classify(EXIT_CKPT) == "corrupt_ckpt"
+    assert classify(-9, watchdog_killed=True) == "hang"
+    assert classify(0, anomaly_killed=True) == "audit_violation"
+    # supervisor-initiated kills outrank the exit code they caused
+    assert classify(EXIT_AUDIT, watchdog_killed=True) == "hang"
+
+
+def test_pallas_suspect_matcher():
+    assert pallas_suspect("jax._src.pallas.mosaic.lowering: boom")
+    assert pallas_suspect("Mosaic failed to compile")
+    assert not pallas_suspect("ValueError: seed genome length")
+
+
+# ---------------------------------------------------------------------------
+# the supervision loop, driven by fakes
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+class FakeProc:
+    """Scripted child: exits with `code` after `runtime` fake seconds
+    (None = runs until killed).  `poll_hook(proc, elapsed)` runs at
+    every supervisor poll so scenarios can refresh heartbeats or plant
+    anomalies mid-flight."""
+
+    def __init__(self, clock, code=0, runtime=0.0, on_spawn=None,
+                 poll_hook=None):
+        self.clock = clock
+        self.code = code
+        self.runtime = runtime
+        self.on_spawn = on_spawn
+        self.poll_hook = poll_hook
+        self.returncode = None
+        self.pid = 4242
+        self.t0 = None
+
+    def _spawned(self, argv, env, logf):
+        self.t0 = self.clock()
+        self.argv, self.env = argv, env
+        if self.on_spawn:
+            self.on_spawn(self, argv, env, logf)
+
+    def poll(self):
+        if self.returncode is None and self.t0 is not None:
+            elapsed = self.clock() - self.t0
+            if self.poll_hook:
+                self.poll_hook(self, elapsed)
+            if self.returncode is None and self.runtime is not None \
+                    and elapsed >= self.runtime:
+                self.returncode = self.code
+        return self.returncode
+
+    def wait(self, timeout=None):
+        if self.poll() is None:
+            if self.runtime is None:
+                raise AssertionError("wait() on a hung FakeProc")
+            self.clock.t = self.t0 + self.runtime
+            self.returncode = self.code
+        return self.returncode
+
+    def kill(self):
+        if self.returncode is None:
+            self.returncode = -9
+
+    def terminate(self):
+        if self.returncode is None:
+            self.returncode = 0            # graceful preempt path
+
+    def send_signal(self, sig):
+        self.terminate()
+
+
+def _write_metrics(data_dir, hb, preempted=0, anomalies=None):
+    os.makedirs(data_dir, exist_ok=True)
+    lines = [f"avida_heartbeat_timestamp_seconds {hb}",
+             f"avida_preempted {preempted}",
+             "avida_update 42"]
+    if anomalies is not None:
+        lines.append(
+            f'avida_trace_code_total{{code="anom_merit"}} {anomalies}')
+    with open(os.path.join(data_dir, "metrics.prom"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def _mk_sup(tmp_path, procs, clock, **cfg_kw):
+    data = tmp_path / "data"
+    ck = tmp_path / "ck"
+    os.makedirs(ck, exist_ok=True)
+    seq = list(procs)
+    spawned = []
+
+    def spawn(argv, env, logf):
+        proc = seq.pop(0)
+        proc._spawned(argv, env, logf)
+        spawned.append(proc)
+        return proc
+
+    kw = dict(watchdog_sec=10.0, poll_sec=0.5, grace_sec=30.0,
+              max_retries=4, backoff_base=0.1, backoff_cap=1.0,
+              healthy_sec=1e9, seed=2)
+    kw.update(cfg_kw)
+    sup = Supervisor(
+        ["-d", str(data), "-set", "TPU_CKPT_DIR", str(ck), "-u", "100"],
+        cfg=SupervisorConfig(**kw), env={}, spawn=spawn,
+        clock=clock, sleep=clock.sleep)
+    return sup, str(data), str(ck), spawned
+
+
+def _runlog_events(data_dir):
+    path = os.path.join(data_dir, "supervisor.jsonl")
+    recs = [json.loads(line) for line in open(path)]
+    assert all(r["record"] == "supervisor" for r in recs)
+    return [r["event"] for r in recs], recs
+
+
+def test_supervisor_forces_metrics_and_resume_flags(tmp_path):
+    clk = FakeClock()
+    sup, _, _, _ = _mk_sup(tmp_path, [], clk)
+    assert "--resume" in sup.child_argv
+    assert "TPU_METRICS" in sup.child_argv
+
+
+def test_supervisor_rejects_unsupervisable_child_argv(tmp_path):
+    with pytest.raises(ValueError, match="data dir"):
+        Supervisor(["-set", "TPU_CKPT_DIR", str(tmp_path)], env={})
+    with pytest.raises(ValueError, match="TPU_CKPT_DIR"):
+        Supervisor(["-d", str(tmp_path)], env={})
+    with pytest.raises(ValueError, match="fault-plan"):
+        Supervisor(["-d", str(tmp_path), "-set", "TPU_CKPT_DIR",
+                    str(tmp_path), "-set", "TPU_FAULT", "crash"], env={})
+    # an explicit heartbeat opt-out would blind the watchdog
+    with pytest.raises(ValueError, match="heartbeat"):
+        Supervisor(["-d", str(tmp_path), "-set", "TPU_CKPT_DIR",
+                    str(tmp_path), "-set", "TPU_METRICS", "0"], env={})
+
+
+def test_success_first_boot(tmp_path):
+    clk = FakeClock()
+
+    def finish(proc, argv, env, logf):
+        _write_metrics(os.path.dirname(logf.name), hb=clk())
+
+    sup, data, _, spawned = _mk_sup(
+        tmp_path, [FakeProc(clk, code=0, runtime=0.0, on_spawn=finish)], clk)
+    assert sup.run() == 0
+    assert sup.boots == 1 and sup.restarts == 0
+    events, _ = _runlog_events(data)
+    assert events[0] == "launch" and "done" in events
+    # metrics file published and parseable
+    from avida_tpu.observability.exporter import read_metrics
+    m = read_metrics(os.path.join(data, "supervisor.prom"))
+    assert m["avida_supervisor_boots_total"] == 1
+    assert m['avida_supervisor_failures_total{class="crash"}'] == 0
+
+
+def test_crash_restarts_with_backoff_then_budget_exhausts(tmp_path):
+    clk = FakeClock()
+    procs = [FakeProc(clk, code=1, runtime=0.0) for _ in range(5)]
+    sup, data, _, _ = _mk_sup(tmp_path, procs, clk, max_retries=4)
+    t0 = clk()
+    assert sup.run() == 1                          # gave up
+    assert sup.boots == 5 and sup.failures["crash"] == 5
+    assert not sup.policy.can_retry()
+    # backoff actually slept: 4 jittered delays in [base, cap]
+    assert 4 * 0.1 <= clk() - t0 <= 4 * 1.0 + 5 * 0.5 + 1
+    events, recs = _runlog_events(data)
+    assert events.count("backoff") == 4 and "giving_up" in events
+    delays = [r["delay_sec"] for r in recs if r["event"] == "backoff"]
+    assert all(0.1 <= d <= 1.0 for d in delays)
+
+
+def test_watchdog_kills_stale_heartbeat_and_recovers(tmp_path):
+    clk = FakeClock()
+
+    def beat_once(proc, argv, env, logf):
+        _write_metrics(os.path.dirname(logf.name), hb=clk())
+
+    def finish(proc, argv, env, logf):
+        _write_metrics(os.path.dirname(logf.name), hb=clk())
+
+    hung = FakeProc(clk, runtime=None, on_spawn=beat_once)
+    ok = FakeProc(clk, code=0, runtime=0.0, on_spawn=finish)
+    sup, data, _, _ = _mk_sup(tmp_path, [hung, ok], clk, watchdog_sec=10.0)
+    assert sup.run() == 0
+    assert sup.failures["hang"] == 1 and sup.watchdog_kills == 1
+    assert hung.returncode == -9                   # SIGKILL, not SIGTERM
+    events, _ = _runlog_events(data)
+    assert "watchdog_kill" in events
+
+
+def test_watchdog_grace_covers_slow_first_heartbeat(tmp_path):
+    clk = FakeClock()
+
+    def late_beat(proc, elapsed):
+        # first heartbeat only after 20s of jit compilation -- well past
+        # watchdog_sec but inside grace_sec
+        if elapsed >= 20.0:
+            _write_metrics(proc._data, hb=clk())
+            if elapsed >= 21.0:
+                proc.returncode = 0
+
+    proc = FakeProc(clk, runtime=None, poll_hook=late_beat)
+    sup, data, _, _ = _mk_sup(tmp_path, [proc], clk,
+                              watchdog_sec=5.0, grace_sec=60.0)
+    proc._data = str(tmp_path / "data")
+    assert sup.run() == 0
+    assert sup.watchdog_kills == 0
+
+
+def test_stale_previous_heartbeat_does_not_insta_kill_restart(tmp_path):
+    clk = FakeClock()
+    data = str(tmp_path / "data")
+    # a PREVIOUS boot's heartbeat, very stale by now
+    _write_metrics(data, hb=clk() - 500.0)
+
+    def finish(proc, argv, env, logf):
+        pass                                       # exits before beating
+
+    def slow_finish(proc, elapsed):
+        if elapsed >= 15.0:                        # past watchdog_sec
+            _write_metrics(data, hb=clk())
+            proc.returncode = 0
+
+    proc = FakeProc(clk, runtime=None, poll_hook=slow_finish)
+    sup, _, _, _ = _mk_sup(tmp_path, [proc], clk,
+                           watchdog_sec=5.0, grace_sec=60.0)
+    assert sup.run() == 0
+    assert sup.watchdog_kills == 0                 # grace clock governed
+
+
+def test_preempt_relaunches_without_consuming_budget(tmp_path):
+    clk = FakeClock()
+
+    def preempted(proc, argv, env, logf):
+        _write_metrics(os.path.dirname(logf.name), hb=clk(), preempted=1)
+
+    def finish(proc, argv, env, logf):
+        _write_metrics(os.path.dirname(logf.name), hb=clk(), preempted=0)
+
+    procs = [FakeProc(clk, code=0, runtime=0.0, on_spawn=preempted),
+             FakeProc(clk, code=0, runtime=0.0, on_spawn=finish)]
+    sup, data, _, _ = _mk_sup(tmp_path, procs, clk)
+    assert sup.run() == 0
+    assert sup.failures["preempt"] == 1
+    assert sup.policy.failures == 0                # no budget consumed
+    events, _ = _runlog_events(data)
+    assert "restart" in events
+
+
+def test_audit_violation_rolls_back_newest_generation(tmp_path):
+    clk = FakeClock()
+    ck = tmp_path / "ck"
+    old = _gen(ck, update=10)
+    new = _gen(ck, update=20)
+
+    def finish(proc, argv, env, logf):
+        _write_metrics(os.path.dirname(logf.name), hb=clk())
+
+    procs = [FakeProc(clk, code=EXIT_AUDIT, runtime=0.0),
+             FakeProc(clk, code=0, runtime=0.0, on_spawn=finish)]
+    sup, data, _, _ = _mk_sup(tmp_path, procs, clk)
+    assert sup.run() == 0
+    assert sup.failures["audit_violation"] == 1 and sup.rollbacks == 1
+    gens = ckpt_mod.list_generations(str(ck))
+    assert gens == [old]                           # newest quarantined
+    quarantined = [d for d in os.listdir(ck) if d.startswith(".bad-")]
+    assert len(quarantined) == 1
+    assert os.path.basename(new) in quarantined[0]
+    # the quarantine is invisible to resume's candidate scan
+    assert ckpt_mod.restore_candidates(str(ck)) == [old]
+    events, _ = _runlog_events(data)
+    assert "rollback" in events
+
+
+def test_audit_rollback_keeps_a_sole_generation(tmp_path):
+    clk = FakeClock()
+    ck = tmp_path / "ck"
+    only = _gen(ck, update=10)
+    procs = [FakeProc(clk, code=EXIT_AUDIT, runtime=0.0),
+             FakeProc(clk, code=0, runtime=0.0)]
+    sup, data, _, _ = _mk_sup(tmp_path, procs, clk)
+    assert sup.run() == 0
+    assert ckpt_mod.list_generations(str(ck)) == [only]
+    events, _ = _runlog_events(data)
+    assert "rollback_skipped" in events
+
+
+def test_anomaly_onset_triggers_graceful_stop_and_rollback(tmp_path):
+    clk = FakeClock()
+    ck = tmp_path / "ck"
+    _gen(ck, update=10)
+    _gen(ck, update=20)
+    data = str(tmp_path / "data")
+
+    def evolving(proc, elapsed):
+        # healthy heartbeats, then a flight-recorder anomaly shows up
+        _write_metrics(data, hb=clk(),
+                       anomalies=0 if elapsed < 3.0 else 1)
+
+    def finish(proc, argv, env, logf):
+        _write_metrics(data, hb=clk(), anomalies=1)
+
+    procs = [FakeProc(clk, runtime=None, poll_hook=evolving),
+             FakeProc(clk, code=0, runtime=0.0, on_spawn=finish)]
+    sup, _, _, _ = _mk_sup(tmp_path, procs, clk)
+    assert sup.run() == 0
+    assert procs[0].returncode == 0                # SIGTERM, not SIGKILL
+    assert sup.failures["audit_violation"] == 1 and sup.rollbacks == 1
+    assert len(ckpt_mod.list_generations(str(ck))) == 1
+    events, _ = _runlog_events(data)
+    assert "anomaly_detected" in events
+    # boot 2's anomaly baseline resets: the restored counter (still 1)
+    # must not re-trigger -- proven by the clean exit above
+
+
+def test_pallas_crash_degrades_to_xla_once(tmp_path):
+    clk = FakeClock()
+
+    def pallas_boom(proc, argv, env, logf):
+        logf.write("jax._src.pallas.mosaic.lowering.LoweringError: bad\n")
+        logf.flush()
+
+    def finish(proc, argv, env, logf):
+        _write_metrics(os.path.dirname(logf.name), hb=clk())
+
+    procs = [FakeProc(clk, code=1, runtime=0.0, on_spawn=pallas_boom),
+             FakeProc(clk, code=0, runtime=0.0, on_spawn=finish)]
+    sup, data, _, spawned = _mk_sup(tmp_path, procs, clk)
+    assert sup.run() == 0
+    assert sup.pallas_fallbacks == 1
+    assert sup.policy.failures == 0                # the free retry
+    argv2 = spawned[1].argv
+    i = argv2.index("TPU_USE_PALLAS")
+    assert argv2[i - 1] == "-set" and argv2[i + 1] == "2"
+    events, _ = _runlog_events(data)
+    assert "pallas_fallback" in events
+
+
+def test_corrupt_ckpt_fallback_is_recorded_even_on_success(tmp_path):
+    clk = FakeClock()
+
+    def fallback_then_finish(proc, argv, env, logf):
+        # the fallback marker lands at boot START (resume time); a
+        # chatty child then writes far more than the 8 KB tail window --
+        # classification must still see the head of the boot's log
+        logf.write("[avida-tpu] checkpoint_corrupt: path=gen error=CRC\n")
+        logf.write("chatter\n" * 4000)
+        logf.flush()
+        _write_metrics(os.path.dirname(logf.name), hb=clk())
+
+    sup, data, _, _ = _mk_sup(
+        tmp_path,
+        [FakeProc(clk, code=0, runtime=0.0, on_spawn=fallback_then_finish)],
+        clk)
+    assert sup.run() == 0
+    assert sup.failures["corrupt_ckpt"] == 1 and sup.ckpt_fallbacks == 1
+    from avida_tpu.observability.exporter import read_metrics
+    m = read_metrics(os.path.join(data, "supervisor.prom"))
+    assert m["avida_supervisor_ckpt_fallbacks_total"] == 1
+    assert m['avida_supervisor_failures_total{class="corrupt_ckpt"}'] == 1
+
+
+def test_corrupt_ckpt_counted_once_per_generation_not_per_boot(tmp_path):
+    """The corrupt generation stays on disk after CRC fallback, so
+    every later boot's resume re-logs the same path -- ONE corruption
+    event must not inflate the counter once per boot."""
+    clk = FakeClock()
+
+    def log_fallback(proc, argv, env, logf):
+        logf.write("[avida-tpu] checkpoint_corrupt: path=/ck/gen-8 "
+                   "error=CRC\n")
+        logf.flush()
+        _write_metrics(os.path.dirname(logf.name), hb=clk())
+
+    procs = [FakeProc(clk, code=1, runtime=0.0, on_spawn=log_fallback),
+             FakeProc(clk, code=0, runtime=0.0, on_spawn=log_fallback)]
+    sup, data, _, _ = _mk_sup(tmp_path, procs, clk)
+    assert sup.run() == 0
+    assert sup.failures["corrupt_ckpt"] == 1       # one generation
+    assert sup.ckpt_fallbacks == 1
+    assert sup.failures["crash"] == 1              # boot 1 still a crash
+
+
+def test_fault_plan_is_consumed_one_spec_per_boot(tmp_path):
+    clk = FakeClock()
+    procs = [FakeProc(clk, code=1, runtime=0.0) for _ in range(3)]
+    sup, _, _, spawned = _mk_sup(tmp_path, procs, clk, max_retries=2)
+    sup.fault_plan = ["sigkill@update=5", "sigkill@update=9"]
+    assert sup.run() == 1
+    assert spawned[0].env.get("TPU_FAULT") == "sigkill@update=5"
+    assert spawned[1].env.get("TPU_FAULT") == "sigkill@update=9"
+    assert "TPU_FAULT" not in spawned[2].env       # plan exhausted
+
+
+def test_healthy_interval_resets_budget(tmp_path):
+    clk = FakeClock()
+    data = str(tmp_path / "data")
+
+    def long_healthy(proc, elapsed):
+        _write_metrics(data, hb=clk())
+        if elapsed >= 50.0:
+            proc.returncode = 0
+
+    procs = [FakeProc(clk, code=1, runtime=0.0),
+             FakeProc(clk, runtime=None, poll_hook=long_healthy)]
+    sup, _, _, _ = _mk_sup(tmp_path, procs, clk, healthy_sec=20.0)
+    assert sup.run() == 0
+    assert sup.policy.failures == 0                # refilled mid-boot-2
+    events, _ = _runlog_events(data)
+    assert "budget_reset" in events
+
+
+# ---------------------------------------------------------------------------
+# --status exit codes (external watchdog contract)
+# ---------------------------------------------------------------------------
+
+def test_status_exit_codes(tmp_path, capsys):
+    import time as _time
+
+    from avida_tpu.observability.exporter import status_main
+    d = str(tmp_path)
+    assert status_main(d) == 1                     # no metrics file
+    _write_metrics(d, hb=_time.time())
+    assert status_main(d) == 0
+    assert status_main(d, max_age=60.0) == 0       # fresh
+    _write_metrics(d, hb=_time.time() - 120.0)
+    assert status_main(d, max_age=60.0) == 2       # stale
+    assert "STALE" in capsys.readouterr().out
+    assert status_main(d) == 0                     # display-only: no flag
+    with open(os.path.join(d, "metrics.prom"), "w") as f:
+        f.write("avida_update 3\n")                # heartbeat line missing
+    assert status_main(d, max_age=60.0) == 2
+
+
+def test_status_shows_supervisor_summary(tmp_path, capsys):
+    import time as _time
+
+    from avida_tpu.observability.exporter import status_main
+    d = str(tmp_path)
+    _write_metrics(d, hb=_time.time())
+    with open(os.path.join(d, "supervisor.prom"), "w") as f:
+        f.write("avida_supervisor_boots_total 3\n"
+                'avida_supervisor_failures_total{class="hang"} 2\n'
+                "avida_supervisor_retry_budget 6\n")
+    assert status_main(d) == 0
+    out = capsys.readouterr().out
+    assert "supervisor" in out and "boots 3" in out and "failures 2" in out
+
+
+def test_main_dispatches_status_max_age(tmp_path):
+    from avida_tpu.__main__ import main
+    assert main(["--status", str(tmp_path)]) == 1
+    _write_metrics(str(tmp_path), hb=0.0)          # epoch: maximally stale
+    assert main(["--status", str(tmp_path), "--max-age", "60"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# ckpt_tool: torn-manifest reporting + --prune
+# ---------------------------------------------------------------------------
+
+def test_ckpt_tool_verify_distinguishes_torn_manifest(tmp_path, capsys):
+    base = tmp_path / "ck"
+    _gen(base, update=10)
+    crc_gen = _gen(base, update=20)
+    torn_gen = _gen(base, update=30)
+    fi.corrupt_leaf(crc_gen, "merit")
+    fi.tear_manifest(torn_gen)
+    assert ckpt_tool.main([str(base), "--verify"]) == 0
+    out = capsys.readouterr().out
+    assert "OK (verified)" in out
+    assert "CORRUPT -- " in out and "CRC mismatch" in out
+    assert "TORN MANIFEST" in out
+    # and torn manifests surface in plain list mode too
+    assert ckpt_tool.main([str(base)]) == 0
+    assert "TORN MANIFEST" in capsys.readouterr().out
+
+
+def test_ckpt_tool_verify_all_bad_exits_nonzero(tmp_path, capsys):
+    base = tmp_path / "ck"
+    fi.tear_manifest(_gen(base, update=10))
+    assert ckpt_tool.main([str(base), "--verify"]) == 1
+
+
+def test_ckpt_tool_prune(tmp_path, capsys):
+    base = tmp_path / "ck"
+    for u in (10, 20, 30, 40):
+        _gen(base, update=u, keep=10)
+    for stray in (".tmp-ckpt-000000000099.1",
+                  ".old-ckpt-000000000010.2",
+                  ".bad-ckpt-000000000020.3"):
+        os.makedirs(base / stray)
+    removed = ckpt_tool.prune(str(base), keep=2)
+    assert len(removed) == 5                       # 3 strays + 2 old gens
+    names = sorted(os.path.basename(p)
+                   for p in ckpt_mod.list_generations(str(base)))
+    assert names == ["ckpt-000000000030", "ckpt-000000000040"]
+    assert not [d for d in os.listdir(base) if d.startswith(".")]
+    # CLI wrapper prints what it removed and keeps newest regardless
+    assert ckpt_tool.main([str(base), "--prune"]) == 0
+    assert "generation(s) kept" in capsys.readouterr().out
+    # --keep parses as a FLAG (any argument order), never as the base dir
+    assert ckpt_tool.main(["--prune", "--keep", "1", str(base)]) == 0
+    assert len(ckpt_mod.list_generations(str(base))) == 1
+    assert "1 generation(s) kept" in capsys.readouterr().out
+    assert ckpt_tool.main([str(base), "--prune", "--keep"]) == 2
+    assert "integer argument" in capsys.readouterr().out
+
+
+def _aside(base, update=10):
+    """Simulate a crash inside write_generation's publish window: a
+    generation moved aside, nothing renamed in to replace it."""
+    gen = _gen(base, update=update)
+    aside = str(base / f".old-ckpt-{update:012d}.77")
+    os.rename(gen, aside)
+    return aside
+
+
+def test_prune_never_deletes_the_only_resumable_aside(tmp_path):
+    """An `.old-*` publish aside can be the ONLY resumable copy (crash
+    inside write_generation's two-rename window) -- prune must keep it
+    until a published generation verifies."""
+    base = tmp_path / "ck"
+    aside = _aside(base)
+    assert ckpt_mod.restore_candidates(str(base)) == [aside]
+    removed = ckpt_tool.prune(str(base), keep=2)
+    assert removed == [] and os.path.isdir(aside)  # rescue copy kept
+
+    # a corrupt published generation is not good enough either
+    base2 = tmp_path / "ck2"
+    bad = _gen(base2, update=20)
+    fi.tear_manifest(bad)
+    aside2 = _aside(base2, update=10)
+    assert aside2 not in ckpt_tool.prune(str(base2), keep=2)
+    assert os.path.isdir(aside2)
+
+    # once a published generation VERIFIES, the aside is debris
+    base3 = tmp_path / "ck3"
+    _gen(base3, update=20)
+    aside3 = _aside(base3, update=10)
+    assert aside3 in ckpt_tool.prune(str(base3), keep=2)
+    assert not os.path.isdir(aside3)
+
+
+def test_prune_retention_never_removes_newest_valid_generation(tmp_path):
+    """Bit-rotted newer generations must not push the only resumable
+    one out of the retention window."""
+    base = tmp_path / "ck"
+    good = _gen(base, update=4, keep=10)
+    for u in (8, 12):
+        fi.tear_manifest(_gen(base, update=u, keep=10))
+    removed = ckpt_tool.prune(str(base), keep=2)
+    assert good not in removed and os.path.isdir(good)
+    path, manifest = ckpt_mod.latest_valid(str(base))
+    assert manifest["update"] == 4                 # still resumable
+
+
+def test_sigterm_during_backoff_exits_before_next_boot(tmp_path):
+    """Preemption that lands mid-backoff (no child alive) must stop the
+    supervisor within the sleep, not after one more full boot."""
+    clk = FakeClock()
+    procs = [FakeProc(clk, code=1, runtime=0.0) for _ in range(3)]
+    sup, data, _, _ = _mk_sup(tmp_path, procs, clk,
+                              backoff_base=5.0, backoff_cap=10.0)
+    real_sleep = sup._sleep
+
+    def preempting_sleep(s):
+        real_sleep(s)
+        sup._stop = True                           # SIGTERM mid-backoff
+
+    sup._sleep = preempting_sleep
+    assert sup.run() == 0
+    assert sup.boots == 1                          # no further boot
+    events, _ = _runlog_events(data)
+    assert "supervisor_preempted" in events
+
+
+def test_explicit_config_off_overrides_fault_env(monkeypatch):
+    """`-set TPU_FAULT 0` must defuse a fault exported in the shell;
+    only an ABSENT config value falls through to the environment."""
+    from avida_tpu.config import AvidaConfig
+    monkeypatch.setenv("TPU_FAULT", "crash@chunk=1")
+    cfg = AvidaConfig()
+    assert fi.active_spec(cfg) == "crash@chunk=1"  # absent -> env
+    for off in ("0", "-", ""):
+        cfg.set("TPU_FAULT", off)
+        assert fi.active_spec(cfg) is None         # explicit off wins
+    cfg.set("TPU_FAULT", "sigkill@chunk=2")
+    assert fi.active_spec(cfg) == "sigkill@chunk=2"
+
+
+def test_render_families_labeled_and_scalar():
+    from avida_tpu.observability.exporter import read_metrics, render_families
+    text = render_families([
+        ("x_total", "counter", "things", 3),
+        ("y_total", "counter", "classified things",
+         {'class="a"': 1, 'class="b"': 2}),
+    ])
+    assert "# TYPE x_total counter" in text
+    assert 'y_total{class="a"} 1' in text
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "m.prom")
+        with open(p, "w") as f:
+            f.write(text)
+        m = read_metrics(p)
+    assert m["x_total"] == 3 and m['y_total{class="b"}'] == 2
