@@ -232,6 +232,23 @@ class AvidaConfig:
     # N > 0 = exactly N shards (must not exceed the device count; tests
     # use 1 to force the unsharded reference trajectory).
     TPU_KERNEL_SHARDS: int = 0
+    # Packed-resident update chunk (ops/packed_chunk.py; round 6): keep
+    # the population in the Pallas kernel's [LP, N] plane layout across
+    # a WHOLE update_scan chunk -- pack once, run the chunk's updates
+    # with the packed-native birth flush (lane-axis rolls on the word
+    # planes; ops/birth.flush_births_packed), unpack once at the chunk
+    # boundary where checkpoints / trace drains / .dat readbacks already
+    # synchronize.  1 = auto: engaged whenever the configuration
+    # qualifies (Pallas path + torus birth fast path + asexual + no
+    # demes/energy/caps/point-or-slip mutations/resource pools and
+    # TPU_SYSTEMATICS=0 -- see packed_chunk.active).  0 = off: the
+    # per-update pack/unpack path with TPU_LANE_PERM budget packing (the
+    # round-5 engine, byte-identical behavior).  When active, the
+    # resident planes are CELL-ordered, so the budget-sort lane
+    # permutation is superseded (identity lanes); the budget tail is
+    # attacked in-kernel instead (TPU_KERNEL_ROWSKIP row-tile skipping +
+    # the per-block while_loop early exit).
+    TPU_PACKED_CHUNK: int = 1
     # Runtime telemetry (avida_tpu/observability/): 1 = phase-fenced
     # staged updates, device counters and a telemetry.jsonl run log in
     # DATA_DIR.  Opt-in: 0 (default) compiles to the identical update

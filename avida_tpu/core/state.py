@@ -90,6 +90,15 @@ class WorldParams(struct.PyTreeNode):
     # kernel launch sharding over the cells mesh axis (0 = auto: every
     # visible device; see TPU_KERNEL_SHARDS in config/schema.py)
     kernel_shards: int = struct.field(pytree_node=False, default=0)
+    # packed-resident update chunk (ops/packed_chunk.py): keep the
+    # population in the kernel's [LP, N] plane layout across a whole
+    # update_scan chunk, with the packed-native birth flush; unpack only
+    # at chunk boundaries.  1 = auto (on whenever the configuration
+    # qualifies -- packed_chunk.active), 0 = off (per-update pack/unpack
+    # with budget-sort lane packing, the round-5 engine).  When active
+    # it supersedes lane_perm_k: resident planes are cell-ordered
+    # (lane_perm stays identity; see TPU_PACKED_CHUNK in config/schema)
+    packed_chunk: int = struct.field(pytree_node=False, default=1)
     # energy model (cPhenotype energy store; cAvidaConfig.h:649-667)
     energy_enabled: bool = struct.field(pytree_node=False, default=False)
     energy_given_on_inject: float = struct.field(pytree_node=False, default=0.0)
@@ -333,6 +342,7 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         lane_perm_k=int(cfg.get("TPU_LANE_PERM", 1)),
         lane_perm_min_util=float(cfg.get("TPU_LANE_PERM_MIN_UTIL", 0.5)),
         kernel_shards=int(cfg.get("TPU_KERNEL_SHARDS", 0)),
+        packed_chunk=int(cfg.get("TPU_PACKED_CHUNK", 1)),
         num_demes=cfg.NUM_DEMES,
         demes_use_germline=cfg.DEMES_USE_GERMLINE,
         germline_copy_mut=cfg.GERMLINE_COPY_MUT,

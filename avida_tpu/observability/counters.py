@@ -72,7 +72,9 @@ def budget_tail(granted, block):
     g = jnp.pad(granted, (0, pad))            # padded lanes grant 0 cycles
     gb = g.reshape(-1, block)
     return {
-        "granted_sum": granted.sum(),
+        # f32 totals: the int32 lane-cycle sums wrap at bench scale once
+        # uncapped grants pass ~20k cycles (see scheduler.block_ceiling)
+        "granted_sum": granted.astype(jnp.float32).sum(),
         "ceiling_sum": block_ceiling(granted, block),
         "block_max_max": gb.max(axis=1).max(),
         "block_mean_mean": gb.mean(axis=1).mean(),

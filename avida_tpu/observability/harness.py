@@ -68,6 +68,36 @@ def profile_phases(params, st, neighbors, key, reps=3, warmup=1,
     return {name: ms / reps for name, ms in acc.items()}, st, granted_total
 
 
+def measure_packed_chunk(params, st, neighbors, key, updates=8, reps=3):
+    """End-to-end ms/update of the packed-resident chunk path
+    (ops/packed_chunk.py): pack once + `updates` updates on the resident
+    [LP, N] planes + unpack once, through the production update_scan.
+    Returns (ms_per_update, final_state), or (None, st) when the
+    configuration does not qualify (packed_chunk.active).
+
+    Caching-immune by construction (the module-docstring caveat): every
+    rep scans onward from the previous rep's evolved state with a fresh
+    update-number base, so no chunk ever sees a repeated input."""
+    import time
+
+    from avida_tpu.ops import packed_chunk
+    from avida_tpu.ops.update import update_scan
+
+    if not packed_chunk.active(params, st):
+        return None, st
+    u0 = 1 << 20              # clear of any real update numbers
+    st, _ = update_scan(params, st, updates, key, neighbors,
+                        jnp.int32(u0))           # compile + warm
+    jax.block_until_ready(st)
+    t0 = time.perf_counter()
+    for r in range(reps):
+        st, _ = update_scan(params, st, updates, key, neighbors,
+                            jnp.int32(u0 + (r + 1) * updates))
+        st = jax.block_until_ready(st)
+    ms = (time.perf_counter() - t0) * 1e3 / (reps * updates)
+    return ms, st
+
+
 def measure_trace_drain(cap=4096, n_updates=16, reps=5):
     """Host cost (ms) of one flight-recorder chunk-boundary drain at its
     worst case: a FULL ring of `cap` events spread over `n_updates`
@@ -171,6 +201,12 @@ def main(argv=None):
         st, k_run, 100, reps)
     print(f"{'full_step':12s} {t_full * 1e3:8.2f} ms   "
           f"({per_update / t_full / 1e6:.1f} M inst/s end-to-end fused)")
+    pcms, _ = measure_packed_chunk(params, st2, neighbors,
+                                   jax.random.key(4321))
+    if pcms is not None:
+        print(f"{'packed_chunk':12s} {pcms:8.2f} ms   "
+              f"(ms/update of the resident-plane chunk scan; compare "
+              f"pack+kernel+unpack+birth above)")
     if trace:
         print(f"{'trace_drain':12s} {measure_trace_drain():8.2f} ms   "
               f"(host drain of a full 4096-event ring per chunk boundary)")

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from avida_tpu.models.heads import SEM_H_DIVIDE_SEX
+from avida_tpu.models.heads import MAX_LABEL_SIZE, SEM_H_DIVIDE_SEX
 
 
 def has_divide_sex(params) -> bool:
@@ -259,6 +259,87 @@ _OFFS_2D = ((-1, -1), (-1, 0), (-1, 1), (0, -1), (0, 1), (1, -1), (1, 0),
             (1, 1))
 
 
+def _fast_torus_placement(params, k_place, pending, alive, time_used, merit):
+    """Target selection + conflict resolution for the torus fast path
+    (local_torus_fast_path: BIRTH_METHOD 0-3, torus, asexual, no demes/
+    caps) on cell-indexed [N] vectors -- 9 rolls + selects, no gathers.
+
+    Factored out of flush_births (round 6) so the packed-native flush
+    (flush_births_packed) shares the EXACT placement semantics and PRNG
+    draw order with the canonical one; the claim/choice algebra is
+    documented at the claim-resolution comment in flush_births.
+
+    Returns (pending, births, parent_idx, won, dir_idx) where
+    dir_idx[cell] = index into the placement offsets (_OFFS_2D + optional
+    parent slot) of the direction the newborn at `cell` came FROM (-1 =
+    no birth) -- the by-parent data movement is then a dir_idx-select
+    over static rolls, for [N]-vectors and [LP, N] planes alike."""
+    n = alive.shape[0]
+    rows = jnp.arange(n)
+    bm = params.birth_method
+    wx, wy = params.world_x, params.world_y
+    offs_all = _OFFS_2D + (((0, 0),) if params.allow_parent else ())
+    ncand = len(offs_all)
+
+    def nbr(x, k):
+        dy, dx = offs_all[k]
+        return _roll2d(x, -dy, -dx, wx, wy)
+
+    occupied = jnp.stack([nbr(alive, k) for k in range(ncand)], axis=1)
+    u = jax.random.uniform(k_place, (n, ncand))
+    # empty-first lexicographic pick; see flush_births for why a shared
+    # empty_bonus score would break the random tiebreak in float32
+    empty_cand = ~occupied
+    has_empty = empty_cand.any(axis=1)
+    empty_pick = jnp.argmax(jnp.where(empty_cand, u, -1.0), axis=1)
+
+    def pick_empty_first(occ_score):
+        return jnp.where(has_empty, empty_pick,
+                         jnp.argmax(occ_score, axis=1))
+
+    if bm == 0:            # RANDOM neighbor (PREFER_EMPTY optional)
+        choice = (pick_empty_first(u) if params.prefer_empty
+                  else jnp.argmax(u, axis=1))
+    elif bm == 1:          # AGE: replace the oldest neighbor; empty first
+        occ_age = jnp.where(
+            occupied,
+            jnp.stack([nbr(time_used, k) for k in range(ncand)], axis=1), 0)
+        choice = pick_empty_first(occ_age.astype(jnp.float32) + u)
+    elif bm == 2:          # MERIT: replace the lowest-merit neighbor
+        occ_merit = jnp.where(
+            occupied,
+            jnp.stack([nbr(merit, k) for k in range(ncand)], axis=1), 0)
+        choice = pick_empty_first(-occ_merit.astype(jnp.float32) + u)
+    else:                  # bm == 3, EMPTY: only empty cells qualify
+        choice = empty_pick
+    if bm == 3:
+        # no empty candidate -> the parent keeps waiting
+        pending = pending & ~occupied.all(axis=1)
+
+    BIG = jnp.int32(2**30)
+    claim = jnp.full(n, BIG, jnp.int32)
+    dir_idx = jnp.full(n, -1, jnp.int32)
+    pk_l, hit_l = [], []
+    for k in range(ncand):
+        dy, dx = offs_all[k]
+        pk = _roll2d(rows, dy, dx, wx, wy)        # id of cell j - off_k
+        pend_k = _roll2d(pending, dy, dx, wx, wy)
+        ch_k = _roll2d(choice, dy, dx, wx, wy)
+        hit = pend_k & (ch_k == k)                # that parent targets j
+        claim = jnp.minimum(claim, jnp.where(hit, pk, BIG))
+        pk_l.append(pk)
+        hit_l.append(hit)
+    for k in range(ncand):
+        dir_idx = jnp.where(hit_l[k] & (pk_l[k] == claim), k, dir_idx)
+    births = claim < BIG
+    parent_idx = jnp.clip(claim, 0, n - 1)
+    claim_at_tgt = jnp.full(n, BIG, jnp.int32)
+    for k in range(ncand):
+        claim_at_tgt = jnp.where(choice == k, nbr(claim, k), claim_at_tgt)
+    won = pending & (claim_at_tgt == rows)
+    return pending, births, parent_idx, won, dir_idx
+
+
 def _roll2d(x, dy, dx, world_x, world_y):
     """Torus-shift a cell-indexed array: out[c] = x[cell at (y-dy, x-dx)],
     i.e. the value of the neighbor in direction (-dy,-dx) -- a pure
@@ -457,251 +538,223 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
         dy, dx = offs_all[k]
         return _roll2d(x, -dy, -dx, wx, wy)
 
-    cand = neighbors                                  # [N, C]
-    pad = cand < 0           # -1 slots (short connection lists); a padded
-    cand = jnp.where(pad, rows[:, None], cand)        # slot never wins
-    if params.num_demes > 1:
-        # deme-local placement: candidates in a different deme collapse to
-        # the parent cell (births stay inside the group; cross-deme birth
-        # happens only through migration below).  Bands align with shards,
-        # so this also keeps placement traffic on-device (ops/demes.py).
-        cpd = params.num_cells // params.num_demes
-        same_deme = (cand // cpd) == (rows // cpd)[:, None]
-        cand = jnp.where(same_deme, cand, rows[:, None])
-    if params.allow_parent and bm in (0, 1, 2, 3):
-        cand = jnp.concatenate([cand, rows[:, None]], axis=1)   # [N, C+1]
-        pad = jnp.concatenate(
-            [pad, jnp.zeros((n, 1), bool)], axis=1)
-    ncand = cand.shape[1]
     if fast:
-        occupied = jnp.stack([nbr(st.alive, k) for k in range(ncand)],
-                             axis=1)
-    else:
-        occupied = st.alive[cand]                     # [N, C]
-    u = jax.random.uniform(k_place, (n, ncand))
-    # Empty-first methods pick lexicographically: a uniformly-random empty
-    # candidate when one exists, else the best occupied one.  (Adding a
-    # large empty_bonus to a shared score would swallow the random
-    # tiebreak in float32 -- 1e12 + u rounds back to 1e12 -- making every
-    # "random among ties" pick deterministically lowest-index.)
-    real = ~pad              # padding slots (short connection lists) never
-    #                          win unless the cell has no real candidate
-    empty_cand = real & ~occupied
-    has_empty = empty_cand.any(axis=1)
-    empty_pick = jnp.argmax(jnp.where(empty_cand, u, -1.0), axis=1)
-
-    def pick_empty_first(occ_score):
-        occ_pick = jnp.argmax(jnp.where(real, occ_score, -jnp.inf), axis=1)
-        return jnp.where(has_empty, empty_pick, occ_pick)
-
-    if bm == 0:            # RANDOM neighbor (PREFER_EMPTY optional)
-        if params.prefer_empty:
-            choice = pick_empty_first(u)
-        else:
-            choice = jnp.argmax(jnp.where(real, u, -1.0), axis=1)
-    elif bm == 1:          # AGE: replace the oldest neighbor; empty first
-        # stale stats of DEAD former occupants must not leak into scores
-        occ = (jnp.stack([nbr(st.time_used, k) for k in range(ncand)], axis=1)
-               if fast else st.time_used[cand])
-        occ_age = jnp.where(occupied, occ, 0)
-        choice = pick_empty_first(occ_age.astype(jnp.float32) + u)
-    elif bm == 2:          # MERIT: replace the lowest-merit neighbor
-        occ = (jnp.stack([nbr(st.merit, k) for k in range(ncand)], axis=1)
-               if fast else st.merit[cand])
-        occ_merit = jnp.where(occupied, occ, 0)
-        choice = pick_empty_first(-occ_merit.astype(jnp.float32) + u)
-    elif bm == 3:          # EMPTY: only empty neighbor cells qualify
-        choice = empty_pick
-    else:
-        choice = jnp.argmax(jnp.where(real, u, -1.0), axis=1)
-    if fast:
-        target = jnp.zeros(n, jnp.int32)
-        for k in range(ncand):
-            target = jnp.where(choice == k, nbr(rows, k), target)
-    else:
-        target = cand[rows, choice]                   # [N]
-    if bm == 3:
-        # no empty candidate -> the parent keeps waiting (the reference
-        # simply fails the birth)
-        pending = pending & ~occupied.all(axis=1)
-    elif bm == 4:          # FULL_SOUP_RANDOM: anywhere in the world/deme
-        if params.num_demes > 1:
-            cpd = params.num_cells // params.num_demes
-            r = jax.random.randint(jax.random.fold_in(k_place, 4), (n,), 0,
-                                   cpd, dtype=jnp.int32)
-            target = (rows // cpd) * cpd + r
-        else:
-            target = jax.random.randint(jax.random.fold_in(k_place, 4),
-                                        (n,), 0, n, dtype=jnp.int32)
-    elif bm == 5:          # FULL_SOUP_ELDEST (reaper queue analogue):
-        # everyone targets the globally oldest slot (empty cells count as
-        # infinitely old); lowest parent index wins the claim
-        age = jnp.where(st.alive, st.time_used, 2**30)
-        target = jnp.full(n, jnp.argmax(age), jnp.int32)
-    elif bm == 6:          # DEME_RANDOM
-        cpd = params.num_cells // max(params.num_demes, 1)
-        r = jax.random.randint(jax.random.fold_in(k_place, 6), (n,), 0,
-                               cpd, dtype=jnp.int32)
-        target = (rows // cpd) * cpd + r
-    elif bm == 7:          # PARENT_FACING (cPopulation.cc:5259): the faced
-        # connection.  Experimental hardware (hw 3) has real facing state
-        # (rotate-x / rotate-org-id), so the offspring goes one step in
-        # the parent's facing direction; heads hardware models no
-        # rotation, so facing = connection 0 (documented deviation)
-        if params.hw_type == 3:
-            from avida_tpu.ops.interpreter import _facing_step
-            ftgt, fvalid = _facing_step(params, rows, st.facing,
-                                        jnp.ones_like(rows))
-            target = jnp.where(fvalid, ftgt, rows)
-            # Off-grid facing on a bounded geometry can never produce a
-            # birth (the reference cannot reach this state: its facing
-            # indexes the connection list, which only holds in-grid
-            # cells).  The offspring is DROPPED and the parent resumes --
-            # same policy as the mating-type store drops.  Retrying
-            # instead would livelock the parent permanently: a
-            # divide-pending organism is excluded from exec_mask, so it
-            # could never execute rotate-x to fix its facing.
-            face_drop = pending & ~fvalid
-            pending = pending & fvalid
-        else:
-            target = jnp.where(neighbors[:, 0] < 0, rows, neighbors[:, 0])
-    elif bm == 8:          # NEXT_CELL
-        target = (rows + 1) % n
-    elif bm == 9:          # FULL_SOUP_ENERGY_USED (cPopulation.cc:5332):
-        # the cell whose occupant has used the most energy (time used when
-        # the energy model is off); empty cells count as INT_MAX, i.e.
-        # preferred; random tiebreak
-        used9 = (st.energy_spent if params.energy_enabled
-                 else st.time_used.astype(jnp.float32))
-        u9 = jax.random.uniform(jax.random.fold_in(k_place, 9), (n,))
-        any_dead = (~st.alive).any()
-        dead_pick = jnp.argmax(jnp.where(st.alive, -1.0, u9))
-        live_pick = jnp.argmax(jnp.where(st.alive, used9 + u9, -jnp.inf))
-        target = jnp.full(n, jnp.where(any_dead, dead_pick, live_pick),
-                          jnp.int32)
-    elif bm == 10:         # NEIGHBORHOOD_ENERGY_USED (cc:5400): same rule
-        # among the parent's connections (empty-first, random tiebreak,
-        # padded slots excluded -- same lexicographic pick as bm 0-3)
-        used10 = (st.energy_spent if params.energy_enabled
-                  else st.time_used.astype(jnp.float32))
-        choice10 = pick_empty_first(
-            jnp.where(occupied, used10[cand], 0.0) + u)
-        target = cand[rows, choice10]
-    elif bm == 11:         # DISPERSAL (cc:5363): a Poisson(DISPERSAL_RATE)
-        # number of random single-cell hops from the parent (capped at 8)
-        k11 = jax.random.fold_in(k_place, 11)
-        hops = jnp.clip(jax.random.poisson(
-            jax.random.fold_in(k11, 0), params.dispersal_rate, (n,)),
-            0, 8).astype(jnp.int32)
-        wx, wy = params.world_x, params.world_y
-        y = rows // wx
-        x = rows % wx
-        for h in range(8):
-            kd = jax.random.fold_in(k11, h + 1)
-            d = jax.random.randint(kd, (n,), 0, 8, jnp.int32)
-            step = h < hops
-            dy = jnp.where(d < 3, -1, jnp.where(d < 5, 0, 1))
-            dx_t = jnp.asarray([-1, 0, 1, -1, 1, -1, 0, 1], jnp.int32)
-            dx = dx_t[d]
-            if params.geometry == 2:
-                y = jnp.where(step, (y + dy) % wy, y)
-                x = jnp.where(step, (x + dx) % wx, x)
-            else:
-                y = jnp.where(step, jnp.clip(y + dy, 0, wy - 1), y)
-                x = jnp.where(step, jnp.clip(x + dx, 0, wx - 1), x)
-        target = y * wx + x
-    if params.num_demes > 1 and bm in (5, 7, 8):
-        # global/absolute targets must still respect deme boundaries:
-        # a cross-deme target collapses to the parent cell (only
-        # DEMES_MIGRATION_RATE crosses demes)
-        cpd = params.num_cells // params.num_demes
-        target = jnp.where(target // cpd == rows // cpd, target, rows)
-    if params.num_demes > 1 and params.demes_migration_rate > 0:
-        # DEMES_MIGRATION_RATE: migrating offspring land in another deme
-        # picked by DEMES_MIGRATION_METHOD (cPopulation.cc:5508-5600):
-        #   0 uniform over the other demes, 1 random 8-neighbor on the
-        #   DEMES_NUM_X deme grid, 2 list-adjacent (+/-1), 4 weight-matrix
-        #   (MIGRATION_FILE; cMigrationMatrix::GetProbabilisticDemeID);
-        # then a uniform random cell of the target deme.
-        k_mig, k_mcell, k_mdeme = jax.random.split(
-            jax.random.fold_in(k_place, 1), 3)
-        migrate = (jax.random.uniform(k_mig, (n,))
-                   < params.demes_migration_rate) & pending
-        cpd = params.num_cells // params.num_demes
-        D = params.num_demes
-        home = rows // cpd
-        mm = params.demes_migration_method
-        if mm == 0:
-            d_r = jax.random.randint(k_mdeme, (n,), 0, D - 1,
-                                     dtype=jnp.int32)
-            mig_deme = jnp.where(d_r >= home, d_r + 1, d_r)
-        elif mm == 1:
-            xs = params.demes_num_x
-            ys = D // xs
-            d8 = jax.random.randint(k_mdeme, (n,), 0, 8, dtype=jnp.int32)
-            dy = jnp.asarray([-1, -1, -1, 0, 0, 1, 1, 1], jnp.int32)[d8]
-            dx = jnp.asarray([-1, 0, 1, -1, 1, -1, 0, 1], jnp.int32)[d8]
-            mx = (home % xs + dx + xs) % xs
-            my = (home // xs + dy + ys) % ys
-            mig_deme = mx + xs * my
-        elif mm == 2:
-            pm = jax.random.randint(k_mdeme, (n,), 0, 2,
-                                    dtype=jnp.int32) * 2 - 1
-            mig_deme = (home + pm + D) % D
-        elif mm == 4:
-            u_d = jax.random.uniform(k_mdeme, (n,))
-            cdf = jnp.asarray(params.migration_cdf, jnp.float32)  # [D, D]
-            row_cdf = cdf[home]                                   # [n, D]
-            mig_deme = (u_d[:, None] >= row_cdf).sum(
-                axis=1).astype(jnp.int32)
-            mig_deme = jnp.clip(mig_deme, 0, D - 1)
-        else:
-            raise NotImplementedError(
-                f"DEMES_MIGRATION_METHOD {mm}")
-        mig_cell = mig_deme * cpd + jax.random.randint(
-            k_mcell, (n,), 0, cpd, dtype=jnp.int32)
-        target = jnp.where(migrate, mig_cell, target)
-
-    # ---- conflict resolution: lowest parent index claims the cell ----
-    # claim[j] = min index of a pending parent targeting cell j (BIG if none).
-    # Every claimed cell receives exactly one birth, from parent claim[j];
-    # this turns placement into a clean per-cell *gather* with no scatter
-    # conflicts.  On the torus fast path the scatter-min, the claim[target]
-    # gather, and every later by-parent gather become 9 rolls + selects
-    # (local_torus_fast_path).
-    BIG = jnp.int32(2**30)
-    if fast:
-        claim = jnp.full(n, BIG, jnp.int32)
-        dir_idx = jnp.full(n, -1, jnp.int32)
-        pk_l, hit_l = [], []
-        for k in range(ncand):
-            dy, dx = offs_all[k]
-            pk = _roll2d(rows, dy, dx, wx, wy)        # id of cell j - off_k
-            pend_k = _roll2d(pending, dy, dx, wx, wy)
-            ch_k = _roll2d(choice, dy, dx, wx, wy)
-            hit = pend_k & (ch_k == k)                # that parent targets j
-            claim = jnp.minimum(claim, jnp.where(hit, pk, BIG))
-            pk_l.append(pk)
-            hit_l.append(hit)
-        for k in range(ncand):
-            dir_idx = jnp.where(hit_l[k] & (pk_l[k] == claim), k, dir_idx)
-        births = claim < BIG
-        parent_idx = jnp.clip(claim, 0, n - 1)
-        claim_at_tgt = jnp.full(n, BIG, jnp.int32)
-        for k in range(ncand):
-            claim_at_tgt = jnp.where(choice == k, nbr(claim, k),
-                                     claim_at_tgt)
-        won = pending & (claim_at_tgt == rows)
+        # strictly neighbor-local placement on a torus: selection AND
+        # conflict resolution collapse to rolls + selects.  The helper
+        # shares these exact semantics and PRNG draws with the
+        # packed-native flush (flush_births_packed).
+        pending, births, parent_idx, won, dir_idx = _fast_torus_placement(
+            params, k_place, pending, st.alive, st.time_used, st.merit)
 
         def by_parent(x):
             out = jnp.zeros_like(x)
-            for k in range(ncand):
-                dy, dx = offs_all[k]
+            for k, (dy, dx) in enumerate(offs_all):
                 sel = dir_idx == k
                 out = jnp.where(sel.reshape((n,) + (1,) * (x.ndim - 1)),
                                 _roll2d(x, dy, dx, wx, wy), out)
             return out
+
     else:
+        cand = neighbors                                  # [N, C]
+        pad = cand < 0           # -1 slots (short connection lists); a padded
+        cand = jnp.where(pad, rows[:, None], cand)        # slot never wins
+        if params.num_demes > 1:
+            # deme-local placement: candidates in a different deme collapse to
+            # the parent cell (births stay inside the group; cross-deme birth
+            # happens only through migration below).  Bands align with shards,
+            # so this also keeps placement traffic on-device (ops/demes.py).
+            cpd = params.num_cells // params.num_demes
+            same_deme = (cand // cpd) == (rows // cpd)[:, None]
+            cand = jnp.where(same_deme, cand, rows[:, None])
+        if params.allow_parent and bm in (0, 1, 2, 3):
+            cand = jnp.concatenate([cand, rows[:, None]], axis=1)   # [N, C+1]
+            pad = jnp.concatenate(
+                [pad, jnp.zeros((n, 1), bool)], axis=1)
+        ncand = cand.shape[1]
+        occupied = st.alive[cand]                         # [N, C]
+        u = jax.random.uniform(k_place, (n, ncand))
+        # Empty-first methods pick lexicographically: a uniformly-random empty
+        # candidate when one exists, else the best occupied one.  (Adding a
+        # large empty_bonus to a shared score would swallow the random
+        # tiebreak in float32 -- 1e12 + u rounds back to 1e12 -- making every
+        # "random among ties" pick deterministically lowest-index.)
+        real = ~pad              # padding slots (short connection lists) never
+        #                          win unless the cell has no real candidate
+        empty_cand = real & ~occupied
+        has_empty = empty_cand.any(axis=1)
+        empty_pick = jnp.argmax(jnp.where(empty_cand, u, -1.0), axis=1)
+
+        def pick_empty_first(occ_score):
+            occ_pick = jnp.argmax(jnp.where(real, occ_score, -jnp.inf), axis=1)
+            return jnp.where(has_empty, empty_pick, occ_pick)
+
+        if bm == 0:            # RANDOM neighbor (PREFER_EMPTY optional)
+            if params.prefer_empty:
+                choice = pick_empty_first(u)
+            else:
+                choice = jnp.argmax(jnp.where(real, u, -1.0), axis=1)
+        elif bm == 1:          # AGE: replace the oldest neighbor; empty first
+            # stale stats of DEAD former occupants must not leak into scores
+            occ_age = jnp.where(occupied, st.time_used[cand], 0)
+            choice = pick_empty_first(occ_age.astype(jnp.float32) + u)
+        elif bm == 2:          # MERIT: replace the lowest-merit neighbor
+            occ_merit = jnp.where(occupied, st.merit[cand], 0)
+            choice = pick_empty_first(-occ_merit.astype(jnp.float32) + u)
+        elif bm == 3:          # EMPTY: only empty neighbor cells qualify
+            choice = empty_pick
+        else:
+            choice = jnp.argmax(jnp.where(real, u, -1.0), axis=1)
+        target = cand[rows, choice]                       # [N]
+        if bm == 3:
+            # no empty candidate -> the parent keeps waiting (the reference
+            # simply fails the birth)
+            pending = pending & ~occupied.all(axis=1)
+        elif bm == 4:          # FULL_SOUP_RANDOM: anywhere in the world/deme
+            if params.num_demes > 1:
+                cpd = params.num_cells // params.num_demes
+                r = jax.random.randint(jax.random.fold_in(k_place, 4), (n,), 0,
+                                       cpd, dtype=jnp.int32)
+                target = (rows // cpd) * cpd + r
+            else:
+                target = jax.random.randint(jax.random.fold_in(k_place, 4),
+                                            (n,), 0, n, dtype=jnp.int32)
+        elif bm == 5:          # FULL_SOUP_ELDEST (reaper queue analogue):
+            # everyone targets the globally oldest slot (empty cells count as
+            # infinitely old); lowest parent index wins the claim
+            age = jnp.where(st.alive, st.time_used, 2**30)
+            target = jnp.full(n, jnp.argmax(age), jnp.int32)
+        elif bm == 6:          # DEME_RANDOM
+            cpd = params.num_cells // max(params.num_demes, 1)
+            r = jax.random.randint(jax.random.fold_in(k_place, 6), (n,), 0,
+                                   cpd, dtype=jnp.int32)
+            target = (rows // cpd) * cpd + r
+        elif bm == 7:          # PARENT_FACING (cPopulation.cc:5259): the faced
+            # connection.  Experimental hardware (hw 3) has real facing state
+            # (rotate-x / rotate-org-id), so the offspring goes one step in
+            # the parent's facing direction; heads hardware models no
+            # rotation, so facing = connection 0 (documented deviation)
+            if params.hw_type == 3:
+                from avida_tpu.ops.interpreter import _facing_step
+                ftgt, fvalid = _facing_step(params, rows, st.facing,
+                                            jnp.ones_like(rows))
+                target = jnp.where(fvalid, ftgt, rows)
+                # Off-grid facing on a bounded geometry can never produce a
+                # birth (the reference cannot reach this state: its facing
+                # indexes the connection list, which only holds in-grid
+                # cells).  The offspring is DROPPED and the parent resumes --
+                # same policy as the mating-type store drops.  Retrying
+                # instead would livelock the parent permanently: a
+                # divide-pending organism is excluded from exec_mask, so it
+                # could never execute rotate-x to fix its facing.
+                face_drop = pending & ~fvalid
+                pending = pending & fvalid
+            else:
+                target = jnp.where(neighbors[:, 0] < 0, rows, neighbors[:, 0])
+        elif bm == 8:          # NEXT_CELL
+            target = (rows + 1) % n
+        elif bm == 9:          # FULL_SOUP_ENERGY_USED (cPopulation.cc:5332):
+            # the cell whose occupant has used the most energy (time used when
+            # the energy model is off); empty cells count as INT_MAX, i.e.
+            # preferred; random tiebreak
+            used9 = (st.energy_spent if params.energy_enabled
+                     else st.time_used.astype(jnp.float32))
+            u9 = jax.random.uniform(jax.random.fold_in(k_place, 9), (n,))
+            any_dead = (~st.alive).any()
+            dead_pick = jnp.argmax(jnp.where(st.alive, -1.0, u9))
+            live_pick = jnp.argmax(jnp.where(st.alive, used9 + u9, -jnp.inf))
+            target = jnp.full(n, jnp.where(any_dead, dead_pick, live_pick),
+                              jnp.int32)
+        elif bm == 10:         # NEIGHBORHOOD_ENERGY_USED (cc:5400): same rule
+            # among the parent's connections (empty-first, random tiebreak,
+            # padded slots excluded -- same lexicographic pick as bm 0-3)
+            used10 = (st.energy_spent if params.energy_enabled
+                      else st.time_used.astype(jnp.float32))
+            choice10 = pick_empty_first(
+                jnp.where(occupied, used10[cand], 0.0) + u)
+            target = cand[rows, choice10]
+        elif bm == 11:         # DISPERSAL (cc:5363): a Poisson(DISPERSAL_RATE)
+            # number of random single-cell hops from the parent (capped at 8)
+            k11 = jax.random.fold_in(k_place, 11)
+            hops = jnp.clip(jax.random.poisson(
+                jax.random.fold_in(k11, 0), params.dispersal_rate, (n,)),
+                0, 8).astype(jnp.int32)
+            wx, wy = params.world_x, params.world_y
+            y = rows // wx
+            x = rows % wx
+            for h in range(8):
+                kd = jax.random.fold_in(k11, h + 1)
+                d = jax.random.randint(kd, (n,), 0, 8, jnp.int32)
+                step = h < hops
+                dy = jnp.where(d < 3, -1, jnp.where(d < 5, 0, 1))
+                dx_t = jnp.asarray([-1, 0, 1, -1, 1, -1, 0, 1], jnp.int32)
+                dx = dx_t[d]
+                if params.geometry == 2:
+                    y = jnp.where(step, (y + dy) % wy, y)
+                    x = jnp.where(step, (x + dx) % wx, x)
+                else:
+                    y = jnp.where(step, jnp.clip(y + dy, 0, wy - 1), y)
+                    x = jnp.where(step, jnp.clip(x + dx, 0, wx - 1), x)
+            target = y * wx + x
+        if params.num_demes > 1 and bm in (5, 7, 8):
+            # global/absolute targets must still respect deme boundaries:
+            # a cross-deme target collapses to the parent cell (only
+            # DEMES_MIGRATION_RATE crosses demes)
+            cpd = params.num_cells // params.num_demes
+            target = jnp.where(target // cpd == rows // cpd, target, rows)
+        if params.num_demes > 1 and params.demes_migration_rate > 0:
+            # DEMES_MIGRATION_RATE: migrating offspring land in another deme
+            # picked by DEMES_MIGRATION_METHOD (cPopulation.cc:5508-5600):
+            #   0 uniform over the other demes, 1 random 8-neighbor on the
+            #   DEMES_NUM_X deme grid, 2 list-adjacent (+/-1), 4 weight-matrix
+            #   (MIGRATION_FILE; cMigrationMatrix::GetProbabilisticDemeID);
+            # then a uniform random cell of the target deme.
+            k_mig, k_mcell, k_mdeme = jax.random.split(
+                jax.random.fold_in(k_place, 1), 3)
+            migrate = (jax.random.uniform(k_mig, (n,))
+                       < params.demes_migration_rate) & pending
+            cpd = params.num_cells // params.num_demes
+            D = params.num_demes
+            home = rows // cpd
+            mm = params.demes_migration_method
+            if mm == 0:
+                d_r = jax.random.randint(k_mdeme, (n,), 0, D - 1,
+                                         dtype=jnp.int32)
+                mig_deme = jnp.where(d_r >= home, d_r + 1, d_r)
+            elif mm == 1:
+                xs = params.demes_num_x
+                ys = D // xs
+                d8 = jax.random.randint(k_mdeme, (n,), 0, 8, dtype=jnp.int32)
+                dy = jnp.asarray([-1, -1, -1, 0, 0, 1, 1, 1], jnp.int32)[d8]
+                dx = jnp.asarray([-1, 0, 1, -1, 1, -1, 0, 1], jnp.int32)[d8]
+                mx = (home % xs + dx + xs) % xs
+                my = (home // xs + dy + ys) % ys
+                mig_deme = mx + xs * my
+            elif mm == 2:
+                pm = jax.random.randint(k_mdeme, (n,), 0, 2,
+                                        dtype=jnp.int32) * 2 - 1
+                mig_deme = (home + pm + D) % D
+            elif mm == 4:
+                u_d = jax.random.uniform(k_mdeme, (n,))
+                cdf = jnp.asarray(params.migration_cdf, jnp.float32)  # [D, D]
+                row_cdf = cdf[home]                                   # [n, D]
+                mig_deme = (u_d[:, None] >= row_cdf).sum(
+                    axis=1).astype(jnp.int32)
+                mig_deme = jnp.clip(mig_deme, 0, D - 1)
+            else:
+                raise NotImplementedError(
+                    f"DEMES_MIGRATION_METHOD {mm}")
+            mig_cell = mig_deme * cpd + jax.random.randint(
+                k_mcell, (n,), 0, cpd, dtype=jnp.int32)
+            target = jnp.where(migrate, mig_cell, target)
+
+        # ---- conflict resolution: lowest parent index claims the cell ----
+        # claim[j] = min index of a pending parent targeting cell j (BIG if none).
+        # Every claimed cell receives exactly one birth, from parent claim[j];
+        # this turns placement into a clean per-cell *gather* with no scatter
+        # conflicts.  On the torus fast path (_fast_torus_placement above) the
+        # scatter-min, the claim[target] gather, and every later by-parent
+        # gather become 9 rolls + selects (local_torus_fast_path).
+        BIG = jnp.int32(2**30)
         claim = jnp.full(n, BIG, jnp.int32)
         claim = claim.at[jnp.where(pending, target, rows)].min(
             jnp.where(pending, rows, BIG))
@@ -984,6 +1037,362 @@ def flush_births(params, st, key, neighbors, update_no, use_off_tape=False):
         st = flush_injections(params, st, jax.random.fold_in(key, 17),
                               neighbors)
     return st
+
+
+# ---------------------------------------------------------------------------
+# Packed-native birth flush (round-6 tentpole).
+#
+# Under the packed-resident update chunk (ops/packed_chunk.py) the
+# population state lives in the Pallas kernel's [LP, N] word-plane layout
+# for a whole chunk of updates, in CELL-ordered lanes.  The flush below
+# re-implements flush_births' torus fast path DIRECTLY on those planes:
+# per-byte operations become SWAR word algebra (helpers `_pk_*`), the
+# by-parent data movement becomes lane-axis rolls on [LP, N] (the same 9
+# static rolls _fast_torus_placement uses for its [N] vectors), and NO
+# traced lane-axis gather of a packed plane ever happens -- the data
+# movement that sank the round-4/5 budget-binning attempts.
+#
+# Bit-exactness contract: flush_births_packed(pack(st)) == pack(
+# flush_births(st)) for every eligible configuration -- same PRNG key
+# splits, same draw shapes, same placement algebra (shared via
+# _fast_torus_placement).  tests/test_packed_chunk.py holds this.
+# ---------------------------------------------------------------------------
+
+
+def _pk_rows(LP):
+    return jnp.arange(LP, dtype=jnp.int32)[:, None]
+
+
+def _pk_bytemask(m):
+    """int32 mask of the m lowest bytes of a word, m in [0, 4] (same
+    algebra as the kernel's bytemask; m broadcasts to [LP, N])."""
+    r = jnp.where(m <= 0, 0, 0xFF)
+    r = jnp.where(m >= 2, 0xFFFF, r)
+    r = jnp.where(m >= 3, 0xFFFFFF, r)
+    return jnp.where(m >= 4, -1, r)
+
+
+def _pk_range_mask(LP, lo, hi):
+    """int32[LP, N] byte mask selecting tape positions [lo, hi) of a
+    packed [LP, N] word plane (lo/hi are [N] position vectors)."""
+    base = _pk_rows(LP) * 4
+    return (_pk_bytemask(jnp.clip(hi - base, 0, 4))
+            & ~_pk_bytemask(jnp.clip(lo - base, 0, 4)))
+
+
+def _pk_set_byte(plane, pos, val):
+    """Set the byte at position pos[lane] to val[lane] (int32 0..255)."""
+    LP = plane.shape[0]
+    sh = (pos & 3) * 8
+    hit = _pk_rows(LP) == (pos >> 2)
+    return jnp.where(hit,
+                     (plane & ~(jnp.int32(255) << sh))
+                     | (val.astype(jnp.int32) << sh), plane)
+
+
+def _pk_shift_r1(plane):
+    """Byte-funnel shift right by ONE position: out[q] = in[q - 1]
+    (position 0 gets 0)."""
+    up = jnp.concatenate(
+        [jnp.zeros((1, plane.shape[1]), jnp.int32), plane[:-1]], axis=0)
+    return (plane << 8) | ((up >> 24) & 0xFF)
+
+
+def _pk_shift_l1(plane):
+    """Byte-funnel shift left by ONE position: out[q] = in[q + 1]."""
+    down = jnp.concatenate(
+        [plane[1:], jnp.zeros((1, plane.shape[1]), jnp.int32)], axis=0)
+    return ((plane >> 8) & 0x00FFFFFF) | (down << 24)
+
+
+def _pk_roll2d(x, dy, dx, wx, wy):
+    """Torus-shift along the LAST (cell/lane) axis: the [LP, N]-plane /
+    [K, N]-matrix counterpart of _roll2d (same displacement semantics:
+    out[..., c] = x[..., cell at (y-dy, x-dx)])."""
+    lead = x.shape[:-1]
+    g = x.reshape(lead + (wy, wx))
+    g = jnp.roll(g, (dy, dx), axis=(-2, -1))
+    return g.reshape(lead + (wy * wx,))
+
+
+def _pk_extract_offspring(params, key, off_t, off_len, genome_len,
+                          divide_pending):
+    """extract_offspring's divide-mutation half on the packed [LP, N]
+    offspring plane (the barrel extraction itself already happened at
+    the divide cycle, in-kernel).  Mirrors ops/interpreter.
+    extract_offspring's PRNG draw-for-draw (same key splits, shapes and
+    order) so the packed flush stays bit-exact vs the canonical one.
+    DIVIDE_SLIP_PROB is not ported (packed_chunk.active gates it off).
+
+    Returns (off plane int32[LP, N], off_len int32[N])."""
+    from avida_tpu.ops.interpreter import random_inst
+    LP, n = off_t.shape
+    L0 = params.max_memory
+    zeros_n = jnp.zeros(n, jnp.int32)
+    fullL = jnp.full(n, LP * 4, jnp.int32)
+
+    off = off_t & _pk_range_mask(LP, zeros_n, off_len)
+    gsize = genome_len.astype(jnp.float32)
+    max_sz = jnp.minimum(L0, (gsize * params.offspring_size_range
+                              ).astype(jnp.int32))
+    div_m = divide_pending
+
+    k_u, k_mpos, k_ipos, k_dpos, k_iinst = jax.random.split(key, 5)
+    u_mut = jax.random.uniform(k_u, (n, 3))
+    r_inst2 = random_inst(params, k_iinst, (n, 2))
+
+    def ins1(off, off_len, ipos, iv, do):
+        sel = _pk_range_mask(LP, ipos + 1, fullL)
+        out = (_pk_shift_r1(off) & sel) | (off & ~sel)
+        out = _pk_set_byte(out, ipos, iv)
+        return (jnp.where(do[None, :], out, off),
+                jnp.where(do, off_len + 1, off_len))
+
+    def del1(off, off_len, dpos, do):
+        sel = _pk_range_mask(LP, dpos, fullL)
+        out = (_pk_shift_l1(off) & sel) | (off & ~sel)
+        out = out & _pk_range_mask(LP, zeros_n, off_len - 1)
+        return (jnp.where(do[None, :], out, off),
+                jnp.where(do, off_len - 1, off_len))
+
+    if params.div_mut_prob > 0:
+        k_dm = jax.random.fold_in(key, 0xD1)
+        n_sub = jnp.clip(jax.random.binomial(
+            k_dm, jnp.maximum(off_len, 1).astype(jnp.float32),
+            params.div_mut_prob), 0, 8).astype(jnp.int32)
+        for k in range(8):
+            kk = jax.random.fold_in(k_dm, k + 1)
+            site = jax.random.randint(kk, (n,), 0, jnp.maximum(off_len, 1))
+            rv = random_inst(params, jax.random.fold_in(kk, 3), (n,))
+            do = div_m & (k < n_sub) & (off_len > 0)
+            off = jnp.where(do[None, :], _pk_set_byte(off, site, rv), off)
+    if params.divide_mut_prob > 0:
+        mpos = jax.random.randint(k_mpos, (n,), 0, jnp.maximum(off_len, 1))
+        do_sub = div_m & (u_mut[:, 0] < params.divide_mut_prob) \
+            & (off_len > 0)
+        off = jnp.where(do_sub[None, :],
+                        _pk_set_byte(off, mpos, r_inst2[:, 0]), off)
+    if params.divide_ins_prob > 0:
+        ipos = jax.random.randint(k_ipos, (n,), 0,
+                                  jnp.maximum(off_len, 1) + 1)
+        do_ins = div_m & (u_mut[:, 1] < params.divide_ins_prob) \
+            & (off_len + 1 <= max_sz)
+        off, off_len = ins1(off, off_len, ipos, r_inst2[:, 1], do_ins)
+    if params.divide_del_prob > 0:
+        dpos = jax.random.randint(k_dpos, (n,), 0, jnp.maximum(off_len, 1))
+        do_del = div_m & (u_mut[:, 2] < params.divide_del_prob) \
+            & (off_len - 1 >= params.min_genome_len)
+        off, off_len = del1(off, off_len, dpos, do_del)
+
+    KMAX = 4
+    if params.copy_ins_prob > 0 or params.copy_del_prob > 0:
+        k_ci, k_cd = jax.random.split(jax.random.fold_in(key, 0xC0), 2)
+        cl = jnp.maximum(off_len, 1).astype(jnp.float32)
+        if params.copy_ins_prob > 0:
+            n_ins = jnp.clip(jax.random.binomial(
+                k_ci, cl, params.copy_ins_prob), 0, KMAX).astype(jnp.int32)
+            for k in range(KMAX):
+                kk = jax.random.fold_in(k_ci, k + 1)
+                ipos2 = jax.random.randint(kk, (n,), 0,
+                                           jnp.maximum(off_len, 1) + 1)
+                iv = random_inst(params, jax.random.fold_in(kk, 7), (n,))
+                do = div_m & (k < n_ins) & (off_len + 1 <= max_sz)
+                off, off_len = ins1(off, off_len, ipos2, iv, do)
+        if params.copy_del_prob > 0:
+            n_del = jnp.clip(jax.random.binomial(
+                k_cd, cl, params.copy_del_prob), 0, KMAX).astype(jnp.int32)
+            for k in range(KMAX):
+                kk = jax.random.fold_in(k_cd, k + 1)
+                dpos2 = jax.random.randint(kk, (n,), 0,
+                                           jnp.maximum(off_len, 1))
+                do = div_m & (k < n_del) \
+                    & (off_len - 1 >= params.min_genome_len)
+                off, off_len = del1(off, off_len, dpos2, do)
+    return off, off_len
+
+
+def flush_births_packed(params, st, key, planes, update_no):
+    """flush_births' torus fast path on resident kernel planes.
+
+    planes = (tape_t, off_t, gen_t, ivec, fvec): the [LP, N] opcode /
+    offspring / genome word planes plus the [NI, N] / [NF, N] scalar
+    planes, CELL-ordered (identity lane mapping -- packed residency
+    supersedes the budget-sort lane permutation; ops/packed_chunk.py).
+    `st` is the canonical carrier whose [N, L] planes are stale between
+    chunk boundaries; this updates its cheap per-cell fields (alive /
+    merit / breed_true / parent_id / birth_update / genotype_id /
+    budget_carry / gestation_time / generation and, with the flight
+    recorder armed, the trace-visible mirrors) so scheduling, stats and
+    trace emission keep reading canonical fields mid-chunk.
+
+    Returns (planes', st')."""
+    from avida_tpu.core.state import make_cell_inputs
+    from avida_tpu.ops import pallas_cycles as pc
+    tape_t, off_t, gen_t, ivec, fvec = planes
+    LP, n = tape_t.shape
+    R = params.num_reactions
+    NI, LW, IV_COPIED_BM, IV_DYN = pc._layout(params, LP * 4)
+    wx, wy = params.world_x, params.world_y
+    rows = jnp.arange(n)
+    zeros_n = jnp.zeros(n, jnp.int32)
+
+    k_place, k_inputs, k_off, k_sex = jax.random.split(key, 4)
+    del k_sex              # asexual only (packed_chunk.active gates)
+
+    flags = ivec[pc.IV_FLAGS]
+    alive = (flags & pc.FLAG_ALIVE) != 0
+    divide_pending = (flags & pc.FLAG_DIVPEND) != 0
+    pending = divide_pending & alive
+
+    off_len0 = ivec[pc.IV_OFF_LEN]
+    genome_len = ivec[pc.IV_GENOME_LEN]
+    merit = fvec[pc.FV_MERIT]
+    off_w, off_len = _pk_extract_offspring(
+        params, k_off, off_t, off_len0, genome_len, divide_pending)
+    fresh_inputs = make_cell_inputs(k_inputs, n)
+    child_merit = merit                       # asexual: parent's merit
+
+    pending, births, parent_idx, won, dir_idx = _fast_torus_placement(
+        params, k_place, pending, alive, ivec[pc.IV_TIME_USED], merit)
+
+    # breed-true: wordwise compare of the (mutated) offspring against the
+    # parent's birth genome, masked to the offspring's bytes
+    diff = (off_w ^ gen_t) & _pk_range_mask(LP, zeros_n, off_len)
+    is_breed_true = (off_len == genome_len) & ~jnp.any(diff != 0, axis=0)
+
+    max_exec = jnp.where(
+        params.death_method == 2, params.age_limit * off_len,
+        jnp.where(params.death_method == 1, params.age_limit, 2**30))
+
+    offs_all = _OFFS_2D + (((0, 0),) if params.allow_parent else ())
+
+    def by_parent(x):
+        """dir_idx-select over the 9 static rolls, for [.., N] arrays --
+        the packed counterpart of flush_births' fast-path by_parent."""
+        out = jnp.zeros_like(x)
+        for k, (dy, dx) in enumerate(offs_all):
+            sel = dir_idx == k
+            out = jnp.where(sel, _pk_roll2d(x, dy, dx, wx, wy), out)
+        return out
+
+    # one batched roll-select for every parent-sourced scalar (the
+    # canonical flush gathers these rows by parent index; here they ride
+    # two stacked matrices -- ints and floats -- through the same rolls)
+    gim_inc = 0 if params.generation_inc_method == 1 else 1
+    imat = jnp.stack(
+        [off_len, max_exec, ivec[pc.IV_GEST_TIME], ivec[pc.IV_EXEC_SIZE],
+         ivec[pc.IV_CHILD_COPIED], ivec[pc.IV_GENERATION] + gim_inc,
+         is_breed_true.astype(jnp.int32)]
+        + [ivec[IV_DYN + 2 * R + r] for r in range(R)], axis=0)
+    fmat = jnp.stack(
+        [child_merit, fvec[pc.FV_FITNESS], fvec[pc.FV_LAST_BONUS],
+         fvec[pc.FV_LAST_MERIT_BASE]], axis=0)
+    mvi = by_parent(imat)
+    mvf = by_parent(fmat)
+    (mv_len, mv_maxexec, mv_gest, mv_exec, mv_copied, mv_gen,
+     mv_breed) = (mvi[k] for k in range(7))
+    mv_last_task = mvi[7:]
+    mv_merit, mv_fitness, mv_last_bonus, mv_last_mb = (
+        mvf[k] for k in range(4))
+
+    mv_plane = by_parent(off_w)               # the one [LP, N] movement
+
+    # ---- newborn scatter: zero-reset rows, then the value rows ----
+    b = births
+    bi = b[None, :]
+    zmask = np.zeros(NI, bool)
+    zrows = [pc.IV_ACTIVE_STACK, pc.IV_READ_LABEL_LEN, pc.IV_INPUT_PTR,
+             pc.IV_INPUT_BUF_N, pc.IV_OUTPUT_BUF, pc.IV_TIME_USED,
+             pc.IV_CPU_CYCLES, pc.IV_GEST_START, pc.IV_CHILD_COPIED,
+             pc.IV_NUM_DIVIDES, pc.IV_OFF_START, pc.IV_OFF_LEN,
+             pc.IV_OFF_COPIED, pc.IV_INSTS_EXEC, pc.IV_COST_WAIT,
+             pc.IV_FT_LO, pc.IV_FT_HI, pc.IV_OFF_SEX]
+    zrows += [pc.IV_REGS + k for k in range(3)]
+    zrows += [pc.IV_HEADS + k for k in range(4)]
+    zrows += [pc.IV_SP + k for k in range(2)]
+    zrows += [pc.IV_INPUT_BUF + k for k in range(3)]
+    zrows += [pc.IV_READ_LABEL + k for k in range(MAX_LABEL_SIZE)]
+    zrows += [pc.IV_STACKS + k for k in range(20)]
+    zrows += [pc.IV_EXEC_BM + w for w in range(LW)]
+    zrows += [IV_COPIED_BM + w for w in range(LW)]
+    zrows += [IV_DYN + r for r in range(R)]            # cur_task
+    zrows += [IV_DYN + R + r for r in range(R)]        # cur_reaction
+    zmask[zrows] = True
+    ivec = jnp.where(jnp.asarray(zmask)[:, None] & bi, 0, ivec)
+
+    def setrow(i, val):
+        return ivec.at[i].set(jnp.where(b, val, ivec[i]))
+
+    ivec = setrow(pc.IV_MEM_LEN, mv_len)
+    ivec = setrow(pc.IV_GENOME_LEN, mv_len)
+    ivec = setrow(pc.IV_COPIED_SIZE, mv_copied)
+    ivec = setrow(pc.IV_MAX_EXEC, mv_maxexec)
+    ivec = setrow(pc.IV_GEST_TIME, mv_gest)
+    ivec = setrow(pc.IV_EXEC_SIZE, mv_exec)
+    ivec = setrow(pc.IV_GENERATION, mv_gen)
+    for k in range(3):
+        ivec = setrow(pc.IV_INPUTS + k, fresh_inputs[:, k])
+    for r in range(R):
+        ivec = setrow(IV_DYN + 2 * R + r, mv_last_task[r])
+
+    fvec = fvec.at[pc.FV_MERIT].set(jnp.where(b, mv_merit, merit))
+    fvec = fvec.at[pc.FV_CUR_BONUS].set(
+        jnp.where(b, jnp.float32(params.default_bonus),
+                  fvec[pc.FV_CUR_BONUS]))
+    fvec = fvec.at[pc.FV_FITNESS].set(
+        jnp.where(b, mv_fitness, fvec[pc.FV_FITNESS]))
+    fvec = fvec.at[pc.FV_LAST_BONUS].set(
+        jnp.where(b, mv_last_bonus, fvec[pc.FV_LAST_BONUS]))
+    fvec = fvec.at[pc.FV_LAST_MERIT_BASE].set(
+        jnp.where(b, mv_last_mb, fvec[pc.FV_LAST_MERIT_BASE]))
+
+    tape_t = jnp.where(bi, mv_plane, tape_t)
+    gen_t = jnp.where(bi, mv_plane, gen_t)
+    off_t = jnp.where(bi, 0, off_t)
+
+    # flags: newborns get ALIVE only; winners/dead parents resume; the
+    # kernel-internal NEWDIV bit clears for everyone (the per-update path
+    # clears it implicitly at every pack -- resident planes must too, or
+    # the next launch would re-extract stale offspring over live tapes)
+    flags_b = jnp.where(b, jnp.int32(pc.FLAG_ALIVE), flags)
+    alive_post = (flags_b & pc.FLAG_ALIVE) != 0
+    divp_b = (flags_b & pc.FLAG_DIVPEND) != 0
+    resumes = won | ~alive_post
+    cleared = jnp.where(resumes, False, divp_b)
+    flags_final = ((flags_b & ~(pc.FLAG_DIVPEND | pc.FLAG_NEWDIV))
+                   | jnp.where(cleared, pc.FLAG_DIVPEND, 0))
+    ivec = ivec.at[pc.IV_FLAGS].set(flags_final)
+    off_sex_b = jnp.where(b, 0, ivec[pc.IV_OFF_SEX])
+    ivec = ivec.at[pc.IV_OFF_SEX].set(
+        jnp.where(cleared, off_sex_b, 0))
+
+    # canonical per-cell fields the packed chunk keeps FRESH on `st`
+    # (everything else canonical is rebuilt at the chunk-boundary unpack)
+    upd = dict(
+        breed_true=jnp.where(b, mv_breed != 0, st.breed_true),
+        parent_id=jnp.where(b, parent_idx, st.parent_id),
+        birth_update=jnp.where(b, jnp.int32(update_no), st.birth_update),
+        genotype_id=jnp.where(b, -1, st.genotype_id),
+        budget_carry=jnp.where(b, 0, st.budget_carry),
+        mating_type=jnp.where(b, -1, st.mating_type),
+        energy_spent=jnp.where(b, 0.0, st.energy_spent),
+        alive=alive_post,
+        merit=fvec[pc.FV_MERIT],
+        gestation_time=ivec[pc.IV_GEST_TIME],
+        generation=ivec[pc.IV_GENERATION],
+    )
+    if int(getattr(params, "trace_cap", 0)):
+        # trace emission reads these canonical fields mid-chunk
+        # (ops/update.trace_pre_phase / trace_post_phase)
+        upd.update(
+            mem_len=ivec[pc.IV_MEM_LEN],
+            heads=jnp.stack([ivec[pc.IV_HEADS + k] for k in range(4)],
+                            axis=1),
+            task_exe_total=jnp.stack(
+                [ivec[IV_DYN + 3 * R + r] for r in range(R)], axis=1),
+        )
+    st = st.replace(**upd)
+    return (tape_t, off_t, gen_t, ivec, fvec), st
 
 
 def flush_injections(params, st, key, neighbors):

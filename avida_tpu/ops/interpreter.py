@@ -492,12 +492,42 @@ def micro_step(params, st, key, exec_mask, return_signals=False,
             input_buf=st.input_buf, input_buf_n=st.input_buf_n,
             output=val)[:6]
 
-    (new_bonus, new_tc, new_rc, resources, res_grid,
-     deme_resources) = jax.lax.cond(
-        io_m.any(), io_block,
-        lambda _: (st.cur_bonus, st.cur_task_count, st.cur_reaction_count,
-                   st.resources, st.res_grid, st.deme_resources),
-        None)
+    # Round-6 satellite (ROUND5 item 3): at steady state SOME organism
+    # performs IO on nearly every cycle, so the any-lane cond around the
+    # task pipeline fired ~always and its branch barrier cost more than
+    # the masked row ops it guarded.  For infinite-resource environments
+    # (no resource-bound reactions, no by-products, no deme bindings --
+    # stock logic-9 qualifies) the pipeline is pure mask algebra whose
+    # io_m=False case returns the inputs bit-identically, so it runs
+    # unconditionally on TPU backends.  Resource-bound environments keep
+    # the cond (their false branch must not touch the pools), and so
+    # does the CPU backend: there the branch costs nothing and the
+    # pipeline is real scalar work -- measured +20-80% per XLA update on
+    # the 1-core test host in the no-IO regime (round-6 A/B), which
+    # would blow the tier-1 budget for zero TPU benefit.  The platform
+    # probe is the PROCESS default backend, same trace-time routing rule
+    # as ops/update.use_pallas_path: valid because nothing in-tree jits
+    # micro_step with an explicit backend/device override (don't start
+    # -- a CPU-pinned trace inside a TPU process would take the
+    # unconditional branch and pay the CPU cost this gate avoids).
+    _io_uncond = (all(r < 0 for r in params.proc_res_idx)
+                  and all(pi < 0
+                          for pi in getattr(params, "proc_product_idx", ()))
+                  and params.num_global_res == 0
+                  and params.num_spatial_res == 0
+                  and params.num_deme_res == 0
+                  and jax.devices()[0].platform == "tpu")
+    if _io_uncond:
+        (new_bonus, new_tc, new_rc, resources, res_grid,
+         deme_resources) = io_block(None)
+    else:
+        (new_bonus, new_tc, new_rc, resources, res_grid,
+         deme_resources) = jax.lax.cond(
+            io_m.any(), io_block,
+            lambda _: (st.cur_bonus, st.cur_task_count,
+                       st.cur_reaction_count, st.resources, st.res_grid,
+                       st.deme_resources),
+            None)
     # lifetime per-cell task executions (tasks_exe.dat source; the delta
     # from cur_task_count is exactly this cycle's performances)
     task_exe_total = st.task_exe_total + (new_tc - st.cur_task_count)

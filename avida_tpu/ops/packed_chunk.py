@@ -1,0 +1,195 @@
+"""Packed-resident update chunk: the round-6 perf tentpole.
+
+The per-update Pallas path (ops/update.interpret_phase -> pallas_cycles.
+run_cycles) round-trips the whole population between the canonical
+[N, L] PopulationState layout and the kernel's [LP, N] word-plane layout
+on EVERY update -- pack + unpack + the [N, L] birth flush were ~13 ms of
+the ~31 ms update at bench scale (ROUND5_NOTES).  This module instead
+makes the packed layout the RESIDENT representation across a whole
+update chunk:
+
+  pack ONCE  ->  scan{ schedule -> kernel launch -> packed birth flush }
+             ->  unpack ONCE at the chunk boundary
+
+Chunk boundaries are exactly where the World driver already
+synchronizes -- checkpoints, flight-recorder drains, newborn drains and
+.dat readbacks all happen between update_scan calls -- so everything
+host-visible still sees canonical [N, L] state (tests/
+test_native_checkpoint.py, tests/test_tracer.py).
+
+Layout contract: the resident planes are CELL-ordered (identity lane
+mapping).  The packed-native birth flush (ops/birth.flush_births_packed)
+moves offspring between neighbor cells with lane-axis ROLLS on [LP, N],
+which is only meaningful in grid order -- so packed residency SUPERSEDES
+the budget-sort lane permutation (TPU_LANE_PERM): ops/update.perm_phase
+keeps the identity mapping whenever this path is active, for the
+per-update reference path too, keeping the two bit-exact (same kernel
+lane assignment => same per-lane PRNG streams).  The budget tail the
+permutation used to pack away is attacked inside the kernel instead:
+level-1 per-block while_loop early exit + level-2 row-tile skipping
+(ops/pallas_cycles.py, TPU_KERNEL_ROWSKIP) and the per-block histogram
+attribution in ops/scheduler.py.
+
+The canonical `st` rides along inside PackedChunk as a carrier: its
+world-level fields (resources, PRNG-independent tables, trace rings) and
+a small set of per-cell scalar mirrors (alive, merit, gestation_time,
+generation, birth_update, parent_id, genotype_id, breed_true,
+budget_carry -- plus heads/mem_len/task_exe_total when the flight
+recorder is armed) stay FRESH every update, so scheduling, light-stats
+and trace emission read canonical fields mid-chunk.  Its [N, L] planes
+are stale between boundaries and are rebuilt by unpack_chunk.
+
+TPU_PACKED_CHUNK=0 disables the path entirely (the per-update
+pack/unpack path with lane packing is then exactly the round-5 engine).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from avida_tpu.ops import birth as birth_ops
+from avida_tpu.ops import pallas_cycles
+
+
+class PackedChunk(struct.PyTreeNode):
+    """Resident chunk state: canonical carrier + the five planes."""
+    st: object               # PopulationState (see module docstring)
+    tape_t: jax.Array        # int32[LP, N] opcode word plane
+    off_t: jax.Array         # int32[LP, N] extracted-offspring plane
+    gen_t: jax.Array         # int32[LP, N] birth-genome plane
+    ivec: jax.Array          # int32[NI, N] per-organism scalars
+    fvec: jax.Array          # f32[NF, N]  float phenotype scalars
+
+
+def active(params, st=None) -> bool:
+    """Static routing predicate: may this configuration keep state
+    packed across a chunk?  Everything here is trace-time (params +
+    state SHAPES), so update_scan / update_step / bench all agree.
+
+    Requirements beyond the kernel's own `eligible`: the torus birth
+    fast path (the packed flush is roll-based), asexual, no demes /
+    energy / population caps, no point or slip mutations (per-site
+    [N, L] sweeps / variable-size region moves stay canonical), no
+    resource pools (resource_phase must not read stale planes), no
+    device-side fault injection, and an EMPTY newborn ring (systematics
+    records gather newborn genomes row-wise -- a lane-axis gather in
+    packed space; run with TPU_SYSTEMATICS=0 for the packed path)."""
+    from avida_tpu.ops.update import use_pallas_path
+    if int(getattr(params, "packed_chunk", 1)) == 0:
+        return False
+    if params.hw_type != 0 or params.max_cpu_threads > 1:
+        return False
+    if not use_pallas_path(params):
+        return False
+    if birth_ops.has_divide_sex(params):
+        return False
+    if not birth_ops.local_torus_fast_path(params, sexual=False):
+        return False
+    if params.point_mut_prob > 0 or params.divide_slip_prob > 0:
+        return False
+    if params.num_global_res or params.num_spatial_res \
+            or params.num_deme_res:
+        return False
+    if getattr(params, "fault_nan", ()):
+        return False
+    if st is not None and st.nb_genome.shape[0] > 0:
+        return False
+    return True
+
+
+def pack_chunk(params, st) -> PackedChunk:
+    """Canonical state -> resident planes (traced; once per chunk).
+    Identity lane mapping by contract (see module docstring)."""
+    n, L0 = st.tape.shape
+    quad = pallas_cycles.pack_state(params, st, jnp.zeros(n, jnp.int32),
+                                    None, 1)
+    tape_t, off_t, ivec, fvec = (p[:, :n] for p in quad)
+    L = tape_t.shape[0] * 4
+    genp = jnp.pad(st.genome.astype(jnp.uint8), ((0, 0), (0, L - L0)))
+    gen_t = pallas_cycles._pack_words(genp, L).T
+    return PackedChunk(st=st, tape_t=tape_t, off_t=off_t, gen_t=gen_t,
+                       ivec=ivec, fvec=fvec)
+
+
+def unpack_chunk(params, pc: PackedChunk):
+    """Resident planes -> canonical state (traced; once per chunk).
+    restore_ro=True: births updated the kernel-read-only rows
+    (genome_len / copied_size / max_executed / inputs) in-plane."""
+    st = pc.st
+    n, L0 = st.tape.shape
+    st = pallas_cycles.unpack_state(
+        params, st, (pc.tape_t, pc.off_t, pc.ivec, pc.fvec),
+        None, restore_ro=True)
+    L = pc.gen_t.shape[0] * 4
+    genome = pallas_cycles._unpack_words(pc.gen_t.T, L)[:, :L0]
+    return st.replace(genome=genome.astype(jnp.int8))
+
+
+def _launch(params, planes, key, cap):
+    """One kernel launch over the resident planes: pad lanes to the
+    shard/block quantum, run, slice back.  At bench scale (102400 cells,
+    512-lane blocks) the pad is empty and this is the bare launch."""
+    tape_t, off_t, ivec, fvec = planes
+    n = tape_t.shape[1]
+    shards = pallas_cycles.kernel_shards(params)
+    _, n_pad, _ = pallas_cycles._dims(params, n, params.max_memory, shards)
+    pad = n_pad - n
+
+    def padl(x):
+        return jnp.pad(x, ((0, 0), (0, pad))) if pad else x
+
+    out = pallas_cycles.run_packed(
+        params, (padl(tape_t), padl(off_t), padl(ivec), padl(fvec)),
+        key, cap)
+    if pad:
+        out = tuple(o[:, :n] for o in out)
+    return out
+
+
+def update_step_packed(params, pc: PackedChunk, key, neighbors, update_no):
+    """One update on resident planes -- the packed mirror of
+    ops/update.update_step's phase order (resources -> schedule ->
+    [trace_pre] -> kernel -> bank -> birth -> [trace_post]), consuming
+    the identical PRNG splits so the trajectory is bit-exact vs the
+    per-update path (tests/test_packed_chunk.py).  Returns
+    (pc', executed_this_update)."""
+    from avida_tpu.ops import update as upd
+    IV_GRANTED = pallas_cycles.IV_GRANTED
+    IV_INSTS = pallas_cycles.IV_INSTS_EXEC
+
+    k_budget, k_steps, k_birth = jax.random.split(key, 3)
+
+    st = upd.resource_phase(params, pc.st, key, update_no)
+    budgets, granted, max_k = upd.schedule_phase(params, st, k_budget)
+    del max_k            # the kernel derives its own per-block ceiling
+    ivec = pc.ivec.at[IV_GRANTED].set(granted)
+
+    if params.trace_cap:
+        st, tsnap = upd.trace_pre_phase(params, st, granted, update_no)
+
+    executed0 = ivec[IV_INSTS]
+    tape_t, off_t, ivec, fvec = _launch(
+        params, (pc.tape_t, pc.off_t, ivec, pc.fvec), k_steps,
+        upd.static_cap(params))
+
+    # bank_phase on rows (same values as ops/update.bank_phase on the
+    # unpacked state: insts_executed and alive are ivec-backed)
+    executed_this = ivec[IV_INSTS] - executed0
+    alive_k = (ivec[pallas_cycles.IV_FLAGS] & pallas_cycles.FLAG_ALIVE) != 0
+    carry = jnp.clip(budgets - executed_this, 0,
+                     100 * params.ave_time_slice)
+    st = st.replace(budget_carry=jnp.where(alive_k, carry, 0))
+    executed = executed_this.sum()
+
+    planes, st = birth_ops.flush_births_packed(
+        params, st, k_birth, (tape_t, off_t, pc.gen_t, ivec, fvec),
+        update_no)
+
+    if params.trace_cap:
+        st = upd.trace_post_phase(params, st, tsnap, update_no)
+
+    tape_t, off_t, gen_t, ivec, fvec = planes
+    return pc.replace(st=st, tape_t=tape_t, off_t=off_t, gen_t=gen_t,
+                      ivec=ivec, fvec=fvec), executed

@@ -165,6 +165,23 @@ import os as _os
 _ABLATE = frozenset(
     f for f in _os.environ.get("TPU_KERNEL_ABLATE", "").split(",") if f)
 
+# Round-6 kernel scheduling knobs (A/B escape hatches; both default ON and
+# both are SEMANTICALLY EXACT -- flipping them changes performance only):
+#
+# TPU_KERNEL_ROWSKIP=0 disables two-level scheduling's row-tile skip: the
+# per-cycle tape traversals (merged read/apply pass + h-search scan) run
+# over all LP word rows again instead of stopping at the live extent of
+# the lanes still executing.
+# TPU_TASKS_UNCOND=0 restores the jnp.any(io_m) cond around the task
+# pipeline (ROUND5 item 3: at steady state some lane in a 512-wide block
+# does IO nearly every cycle, so the cond fired ~always and its barrier
+# cost more than the row ops it guarded).
+_ROWSKIP = _os.environ.get("TPU_KERNEL_ROWSKIP", "1") != "0"
+_TASKS_UNCOND = _os.environ.get("TPU_TASKS_UNCOND", "1") != "0"
+# two-level traversal tile height in word rows: divides CHUNK, and LP is
+# always a CHUNK multiple (_dims pads L), so tiles never straddle the end
+TCH = 16
+
 
 def eligible(params) -> bool:
     """True when the per-organism fast path is semantically exact: no
@@ -486,26 +503,77 @@ def _make_kernel(params, L, B, num_steps, interpret=False):
             # READ-head word, and the 4 words spanning the 10-byte label
             # window base (ip+1); the wrap-around window tail lives in
             # words 0-2, read directly after the store. ----
+            #
+            # Two-level scheduling, level 2 (TPU_KERNEL_ROWSKIP): level 1
+            # is the per-block while_loop stopping at the block's max
+            # granted budget; level 2 bounds each cycle's traversals to
+            # the word rows any lane still NEEDS -- the live memory
+            # extent of budget-unexhausted lanes plus the deferred-write
+            # reach of every lane.  Lanes whose budget is exhausted stop
+            # constraining the bound, so whole TCH-row tiles above it are
+            # skipped (their loads, stores and masked sums never issue).
+            # Semantically exact: every consumer of a masked lane's tape
+            # bytes is already exec-gated, and pending writes/zeroes are
+            # covered by pend_b (an exhausted lane's final deferred write
+            # still lands the cycle after its last execution).  Each
+            # tile's work runs under a scalar predicate -- pl.when for
+            # the apply/store pass, a value-returning lax.cond (with ref
+            # reads) for the sums; both constructs are long-proven in
+            # this kernel.
             ipw = ip >> 2
             rpw = rp >> 2
             labw = (ip + 1) >> 2
+            if _ROWSKIP:
+                need_b = jnp.max(jnp.where(exec_mask, mlen, 1))
+                pend_b = jnp.maximum(jnp.max(pw_pos + 1), jnp.max(pz_e))
+                bound_w = (jnp.maximum(need_b, pend_b) + 3) >> 2
+            else:
+                bound_w = None
+            TRAV = TCH if _ROWSKIP else CHUNK
             w_ip = jnp.zeros((1, B), jnp.int32)
             w_rp = jnp.zeros((1, B), jnp.int32)
             w_lab = [jnp.zeros((1, B), jnp.int32) for _ in range(4)]
-            for c in range(0, LP, CHUNK):
-                cn = min(CHUNK, LP - c)
-                tc = tape_ref[pl.ds(c, cn), :]
-                wrows_c = jax.lax.broadcasted_iota(jnp.int32, (cn, B), 0) + c
-                tc = apply_pending(tc, wrows_c, pw_pos, pw_val, pz_s, pz_e)
-                tape_ref[pl.ds(c, cn), :] = tc
-                w_ip = w_ip + jnp.sum(
-                    jnp.where(wrows_c == ipw, tc, 0), axis=0, keepdims=True)
-                w_rp = w_rp + jnp.sum(
-                    jnp.where(wrows_c == rpw, tc, 0), axis=0, keepdims=True)
+            for c in range(0, LP, TRAV):
+                cn = min(TRAV, LP - c)
+
+                def _tile_sums(_, c=c, cn=cn):
+                    # reads the POST-store tile: pending already applied,
+                    # same values the pre-store accumulation saw
+                    tc = tape_ref[pl.ds(c, cn), :]
+                    wr = jax.lax.broadcasted_iota(
+                        jnp.int32, (cn, B), 0) + c
+                    return tuple(
+                        jnp.sum(jnp.where(wr == w, tc, 0), axis=0,
+                                keepdims=True)
+                        for w in (ipw, rpw, labw, labw + 1, labw + 2,
+                                  labw + 3))
+
+                if _ROWSKIP:
+                    needed = bound_w > c
+
+                    @pl.when(needed)
+                    def _apply_tile(c=c, cn=cn):
+                        tc = tape_ref[pl.ds(c, cn), :]
+                        wr = jax.lax.broadcasted_iota(
+                            jnp.int32, (cn, B), 0) + c
+                        tape_ref[pl.ds(c, cn), :] = apply_pending(
+                            tc, wr, pw_pos, pw_val, pz_s, pz_e)
+
+                    sums = jax.lax.cond(
+                        needed, _tile_sums,
+                        lambda _: tuple(jnp.zeros((1, B), jnp.int32)
+                                        for _ in range(6)), None)
+                else:
+                    tc = tape_ref[pl.ds(c, cn), :]
+                    wrows_c = jax.lax.broadcasted_iota(
+                        jnp.int32, (cn, B), 0) + c
+                    tape_ref[pl.ds(c, cn), :] = apply_pending(
+                        tc, wrows_c, pw_pos, pw_val, pz_s, pz_e)
+                    sums = _tile_sums(None)
+                w_ip = w_ip + sums[0]
+                w_rp = w_rp + sums[1]
                 for j in range(4):
-                    w_lab[j] = w_lab[j] + jnp.sum(
-                        jnp.where(wrows_c == labw + j, tc, 0),
-                        axis=0, keepdims=True)
+                    w_lab[j] = w_lab[j] + sums[2 + j]
             # wrap words for the label window (post-store = pending applied)
             w_wrap = [tape_ref[w, :][None, :] for w in range(3)]
 
@@ -711,49 +779,65 @@ def _make_kernel(params, L, B, num_steps, interpret=False):
                 ok_lane = label_len > 0
                 best = jnp.full((1, B), L, jnp.int32)
                 W = 3            # extra lookahead words for the 20-bit window
-                for c in range(0, LP, CHUNK):
-                    hi = min(CHUNK + W, LP - c)
-                    cn = min(CHUNK, LP - c)
-                    tc = tape_ref[pl.ds(c, hi), :]
-                    if hi < cn + W:
-                        tc = jnp.concatenate(
-                            [tc, jnp.full((cn + W - hi, B),
-                                          0x3F3F3F3F, jnp.int32)], axis=0)
-                    # per-byte 2-bit complement codes (SWAR; the per-byte
-                    # ==0 test is bit7 of x | (0x80 - x), borrow-free for
-                    # 6-bit opcode bytes)
-                    M80 = jnp.int32(-2139062144)        # 0x80808080
+                for c in range(0, LP, TRAV):
+                    hi = min(TRAV + W, LP - c)
+                    cn = min(TRAV, LP - c)
 
-                    def byte_eqz(x):
-                        return ((x | (M80 - x)) >> 7) & 0x01010101
+                    def _tile_best(_, c=c, cn=cn, hi=hi):
+                        tc = tape_ref[pl.ds(c, hi), :]
+                        if hi < cn + W:
+                            tc = jnp.concatenate(
+                                [tc, jnp.full((cn + W - hi, B),
+                                              0x3F3F3F3F, jnp.int32)],
+                                axis=0)
+                        # per-byte 2-bit complement codes (SWAR; the
+                        # per-byte ==0 test is bit7 of x | (0x80 - x),
+                        # borrow-free for 6-bit opcode bytes)
+                        M80 = jnp.int32(-2139062144)        # 0x80808080
 
-                    if nops_prefix:
-                        # code = min(byte, 3): byte >= 3 <=> byte>>2 != 0
-                        # or byte == 3
-                        b2 = (tc >> 2) & 0x3F3F3F3F
-                        ge3f = ((byte_eqz(b2) ^ 0x01010101)
-                                | byte_eqz(tc ^ 0x03030303))
-                        cc = (tc | (ge3f * 0xFF)) & 0x03030303
+                        def byte_eqz(x):
+                            return ((x | (M80 - x)) >> 7) & 0x01010101
+
+                        if nops_prefix:
+                            # code = min(byte, 3): byte >= 3 <=> byte>>2
+                            # != 0 or byte == 3
+                            b2 = (tc >> 2) & 0x3F3F3F3F
+                            ge3f = ((byte_eqz(b2) ^ 0x01010101)
+                                    | byte_eqz(tc ^ 0x03030303))
+                            cc = (tc | (ge3f * 0xFF)) & 0x03030303
+                        else:
+                            cc = jnp.full_like(tc, 0x03030303)
+                            for k in range(num_insts):
+                                if nop_tab[k]:
+                                    ek = byte_eqz(tc ^ (int(k) * 0x01010101))
+                                    cc = ((cc & ~(ek * 0xFF))
+                                          | (ek * int(nmod_tab[k])))
+                        # pack 4 x 2-bit codes -> 8 bits per word
+                        cc8 = (cc | (cc >> 6) | (cc >> 12) | (cc >> 18)) & 0xFF
+                        cat = (cc8[:cn, :] | (cc8[1:cn + 1, :] << 8)
+                               | (cc8[2:cn + 2, :] << 16)
+                               | (cc8[3:cn + 3, :] << 24))
+                        rows4 = (jax.lax.broadcasted_iota(
+                            jnp.int32, (cn, B), 0) + c) * 4
+                        posw = jnp.full((cn, B), L, jnp.int32)
+                        for b in range(3, -1, -1):
+                            hb = (((cat >> (2 * b)) & m2) == c2) & ok_lane \
+                                & ((rows4 + b + label_len) <= mlen)
+                            posw = jnp.where(hb, rows4 + b, posw)
+                        return jnp.min(posw, axis=0, keepdims=True)
+
+                    if _ROWSKIP:
+                        # a match needs rows4 + label_len <= the searching
+                        # lane's mlen <= bound_w*4, so tiles at or above
+                        # the bound can never hold one (lookahead reads of
+                        # skipped tiles are fine: their rows carry no
+                        # un-applied pendings -- pend_b bounds those)
+                        tb = jax.lax.cond(
+                            bound_w > c, _tile_best,
+                            lambda _: jnp.full((1, B), L, jnp.int32), None)
                     else:
-                        cc = jnp.full_like(tc, 0x03030303)
-                        for k in range(num_insts):
-                            if nop_tab[k]:
-                                ek = byte_eqz(tc ^ (int(k) * 0x01010101))
-                                cc = ((cc & ~(ek * 0xFF))
-                                      | (ek * int(nmod_tab[k])))
-                    # pack 4 x 2-bit codes -> 8 bits per word
-                    cc8 = (cc | (cc >> 6) | (cc >> 12) | (cc >> 18)) & 0xFF
-                    cat = (cc8[:cn, :] | (cc8[1:cn + 1, :] << 8)
-                           | (cc8[2:cn + 2, :] << 16)
-                           | (cc8[3:cn + 3, :] << 24))
-                    rows4 = (jax.lax.broadcasted_iota(jnp.int32, (cn, B), 0) + c) * 4
-                    posw = jnp.full((cn, B), L, jnp.int32)
-                    for b in range(3, -1, -1):
-                        hb = (((cat >> (2 * b)) & m2) == c2) & ok_lane \
-                            & ((rows4 + b + label_len) <= mlen)
-                        posw = jnp.where(hb, rows4 + b, posw)
-                    best = jnp.minimum(
-                        best, jnp.min(posw, axis=0, keepdims=True))
+                        tb = _tile_best(None)
+                    best = jnp.minimum(best, tb)
                 return best
 
             if "search" in _ABLATE:
@@ -942,11 +1026,20 @@ def _make_kernel(params, L, B, num_steps, interpret=False):
                 f = jnp.zeros((1, B), jnp.int32)
                 return tuple([cur_bonus] + [f] * (2 * R))
 
-            # IO is absent from whole blocks for long stretches (the stock
-            # ancestor performs none); gate the ~400-op task pipeline on it
-            outs = jax.lax.cond(
-                jnp.any(io_m) if "tasks" not in _ABLATE else False,
-                tasks_block, no_tasks, None)
+            # Round-6 satellite (ROUND5 item 3): at steady state some lane
+            # in a 512-wide block performs IO on nearly every cycle, so
+            # the old jnp.any(io_m) cond fired ~always and its barrier
+            # cost more than the ~R x 40 row ops it guarded -- the task
+            # pipeline now runs unconditionally (identical values when no
+            # lane does IO: every reward is masked by io_m).
+            # TPU_TASKS_UNCOND=0 restores the gate for A/B measurement.
+            if "tasks" in _ABLATE:
+                outs = no_tasks(None)
+            elif _TASKS_UNCOND:
+                outs = tasks_block(None)
+            else:
+                outs = jax.lax.cond(jnp.any(io_m), tasks_block, no_tasks,
+                                    None)
             new_bonus = outs[0]
             performed_l = list(outs[1:1 + R])
             rewarded_l = list(outs[1 + R:1 + 2 * R])
@@ -1551,14 +1644,22 @@ def run_packed(params, packed, key, num_steps):
     return tuple(out)
 
 
-def unpack_state(params, st, packed, inv=None):
+def unpack_state(params, st, packed, inv=None, restore_ro=False):
     """Kernel layout -> PopulationState, preserving untouched fields of
     `st` (genome, breed_true, resources...) (traced).
 
     inv (int32[N], organism -> slot) undoes the pack-time lane
     permutation: organism o's state is read back from kernel lane inv[o].
     As in pack_state, every permute is a major-axis row gather (the ivec/
-    fvec planes ride one organism-major gather each)."""
+    fvec planes ride one organism-major gather each).
+
+    restore_ro=False (the per-update path) keeps the kernel-read-only
+    ivec rows (IV_GENOME_LEN / IV_COPIED_SIZE / IV_MAX_EXEC / IV_INPUTS)
+    out of the result -- the kernel never writes them, so callers keep
+    them from the pre-pack state.  The packed-resident chunk
+    (ops/packed_chunk.py) runs the birth flush ON the planes, which DOES
+    update those rows; its chunk-boundary unpack passes restore_ro=True
+    so the canonical state picks them up."""
     tape_o, off_o, ivec_o, fvec_o = packed
     n, L0 = st.tape.shape
     R = params.num_reactions
@@ -1589,7 +1690,16 @@ def unpack_state(params, st, packed, inv=None):
             | _words_to_flag(cop_w, 7, L))[:, :L0]
 
     flags = row(IV_FLAGS)
+    ro = {}
+    if restore_ro:
+        ro = dict(
+            genome_len=row(IV_GENOME_LEN),
+            copied_size=row(IV_COPIED_SIZE),
+            max_executed=row(IV_MAX_EXEC),
+            inputs=jnp.stack([row(IV_INPUTS + k) for k in range(3)], axis=1),
+        )
     return st.replace(
+        **ro,
         tape=tape,
         off_tape=_unpack_words(off_rows, L)[:, :L0],
         mem_len=row(IV_MEM_LEN),
@@ -1654,6 +1764,9 @@ def run_cycles(params, st, key, granted, num_steps):
     permutation itself persistent state, so the sort is refreshed on the
     perm_phase schedule rather than recomputed here."""
     use_perm = int(getattr(params, "lane_perm_k", 0)) > 0
+    if use_perm:
+        from avida_tpu.ops import packed_chunk
+        use_perm = not packed_chunk.active(params, st)
     perm = st.lane_perm if use_perm else None
     inv = st.lane_inv if use_perm else None
     packed = pack_state(params, st, granted, perm, kernel_shards(params))

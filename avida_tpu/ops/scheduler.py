@@ -85,18 +85,56 @@ def block_ceiling(granted, block: int):
     `block`-wide blocking: sum over blocks of block_size * block_max --
     the cycles the per-block while_loop actually burns (each block runs
     to the max granted budget of ITS lanes).  Shares the definition with
-    observability/counters.budget_tail; traced (device scalar out)."""
+    observability/counters.budget_tail; traced (device scalar out).
+    Returns FLOAT32: the int32 lane-cycle total wraps at bench scale
+    (102k lanes) once uncapped grants pass ~20k cycles -- same overflow
+    class the round-6 review caught in block_skip_fraction, fixed here
+    at the primitive so every consumer (utilization, skip fraction, the
+    telemetry ceiling_sum counter) is covered."""
     n = granted.shape[0]
     pad = (-n) % block
     g = jnp.pad(granted, (0, pad))           # padded lanes grant 0 cycles
-    return (g.reshape(-1, block).max(axis=1) * block).sum()
+    return (g.reshape(-1, block).max(axis=1).astype(jnp.float32)
+            * jnp.float32(block)).sum()
 
 
 def block_utilization(granted, block: int):
     """granted.sum() / block_ceiling: the fraction of lockstep lane-cycles
     doing useful work (1.0 = no budget tail).  The device-side imbalance
     statistic that triggers an early lane-permutation refresh
-    (ops/update.perm_phase) and the bench's budget_tail_util field."""
+    (ops/update.perm_phase) and the bench's budget_tail_util field.
+    Computed in float32 end-to-end (see block_ceiling): int32 lane-cycle
+    totals wrap at bench scale once uncapped grants pass ~20k cycles."""
     ceil = block_ceiling(granted, block)
-    return (granted.sum().astype(jnp.float32)
-            / jnp.maximum(ceil, 1).astype(jnp.float32))
+    return granted.astype(jnp.float32).sum() / jnp.maximum(ceil, 1.0)
+
+
+def block_budget_histogram(granted, block: int):
+    """Per-block (block_max int32[nb], block_sum int32[nb]) summary of a
+    granted vector under `block`-wide blocking -- the two-level-
+    scheduling attribution primitive: level 1 is the kernel's per-block
+    while_loop running to block_max (ops/pallas_cycles.py), level 2 is
+    the per-lane exec mask inside it, so block_max*block - block_sum is
+    each block's budget-tail waste in lane-cycles.  Consumed by
+    block_skip_fraction below (bench.py's budget_tail_skip_pct);
+    exported for ad-hoc tail analysis.  Traced (device out)."""
+    n = granted.shape[0]
+    pad = (-n) % block
+    g = jnp.pad(granted, (0, pad)).reshape(-1, block)
+    return g.max(axis=1), g.sum(axis=1)
+
+
+def block_skip_fraction(granted, block: int):
+    """Fraction of lockstep lane-cycles the kernel's two-level scheduler
+    SKIPS relative to a single global while_loop running every block to
+    the global max budget: 1 - block_ceiling / (global_max * lanes).
+    1.0-utilization measures the residual tail; this measures what the
+    per-block early exit already saves.  Feeds bench.py's
+    budget_tail_skip_pct field.  Float32 end-to-end (see block_ceiling):
+    gmax * lanes overflows int32 at bench scale (102k lanes) once
+    uncapped budget grants pass ~20k cycles."""
+    n = granted.shape[0]
+    pad = (-n) % block
+    gmax = jnp.maximum(granted.max(), 1).astype(jnp.float32)
+    total = gmax * jnp.float32(n + pad)
+    return 1.0 - block_ceiling(granted, block) / jnp.maximum(total, 1.0)

@@ -146,6 +146,15 @@ def perm_phase(params, st, granted, update_no):
     K = int(params.lane_perm_k)
     if K <= 0 or not use_pallas_path(params):
         return st
+    from avida_tpu.ops import packed_chunk
+    if packed_chunk.active(params, st):
+        # packed residency supersedes lane packing: the resident planes
+        # are CELL-ordered (the packed-native birth flush is lane-axis
+        # rolls, only meaningful in grid order), and the per-update
+        # reference path must keep the identity mapping too so both
+        # paths assign the same organisms to the same kernel lanes
+        # (identical per-lane PRNG streams => bit-exact trajectories)
+        return st
     n = granted.shape[0]
 
     def refresh(_):
@@ -447,7 +456,35 @@ def update_scan(params, st, chunk, run_key, neighbors, u0):
     place instead of double-buffering them, so the caller's reference to
     the pre-call state is invalid afterwards (World reassigns self.state
     from the return value; any device-array the caller still needs from
-    the old state must be copied out before the call)."""
+    the old state must be copied out before the call).
+
+    Packed-resident chunk (ops/packed_chunk.py, round 6): when the
+    configuration qualifies, the scan keeps the population in the
+    kernel's [LP, N] plane layout for the WHOLE chunk -- pack once, run
+    `chunk` updates with the packed-native birth flush, unpack once here
+    at the boundary (where checkpoints, trace drains and .dat readbacks
+    already synchronize).  Same per-update PRNG stream, bit-exact vs the
+    per-update path (tests/test_packed_chunk.py)."""
+    from avida_tpu.ops import packed_chunk
+
+    if packed_chunk.active(params, st):
+        pc = packed_chunk.pack_chunk(params, st)
+
+        def pbody(pc, i):
+            k = jax.random.fold_in(run_key, u0 + i)
+            alive_before = pc.st.alive.sum()
+            pc, executed = packed_chunk.update_step_packed(
+                params, pc, k, neighbors, u0 + i)
+            ave_gest, ave_gen, n_alive, births = light_stats(
+                params, pc.st, u0 + i)
+            deaths = jnp.maximum(alive_before + births - n_alive, 0)
+            dt = jnp.where(ave_gest > 0,
+                           1.0 / jnp.maximum(ave_gest, 1e-9), 0.0)
+            return pc, (executed, births, deaths, dt, ave_gen, n_alive)
+
+        pc, outs = jax.lax.scan(pbody, pc, jnp.arange(chunk))
+        return packed_chunk.unpack_chunk(params, pc), outs
+
     def body(st, i):
         k = jax.random.fold_in(run_key, u0 + i)
         alive_before = st.alive.sum()

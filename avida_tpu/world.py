@@ -934,7 +934,18 @@ class World:
 
     def _scan_updates(self, k: int):
         """Common device path: returns the per-update executed-count vector
-        (int32[k] device array; host sums in int64 at flush time)."""
+        (int32[k] device array; host sums in int64 at flush time).
+
+        Packed residency (ops/packed_chunk.py): when the configuration
+        qualifies (requires TPU_SYSTEMATICS=0 -- a populated newborn
+        ring keeps the per-update path), update_scan keeps the state in
+        the kernel's plane layout for the whole k-update stretch and
+        unpacks at return.  Every host consumer downstream of this call
+        therefore still sees canonical [N, L] state: the newborn drain
+        snapshot, the flight-recorder drain, auto-save / preemption
+        checkpoints and .dat readbacks all run BETWEEN _scan_updates
+        calls, i.e. strictly after the chunk-boundary unpack
+        (tests/test_native_checkpoint.py, tests/test_tracer.py)."""
         assert self.state is not None, "no population injected"
         self.state, (executed, births, deaths, dts, ave_gens, n_alive) = \
             update_scan(self.params, self.state, k, self._run_key,

@@ -84,7 +84,14 @@ def measure(world, warmup, timed, chunk=25, seed=100, sharded=False):
 
     sharded=True places the population over ALL visible devices
     (parallel/mesh.py) before timing -- the same protocol, measured
-    through the shard_map'd kernel path (BENCH_SHARDED=1)."""
+    through the shard_map'd kernel path (BENCH_SHARDED=1).
+
+    When the packed-resident chunk qualifies (ops/packed_chunk.py; the
+    default TPU configuration does), each timed chunk packs once, runs
+    its updates on the resident [LP, N] planes with the packed-native
+    birth flush, and unpacks once -- the round-6 tentpole path.  The
+    measured protocol is otherwise unchanged."""
+    from avida_tpu.ops import packed_chunk
     from avida_tpu.ops.update import update_step
 
     params, st, neighbors, key = build(world, world, 256, seed=seed)
@@ -94,9 +101,23 @@ def measure(world, warmup, timed, chunk=25, seed=100, sharded=False):
         mesh = make_mesh()
         st = shard_population(st, mesh)
         neighbors = shard_neighbors(neighbors, mesh)
+    packed = packed_chunk.active(params, st)
 
     @partial(jax.jit, donate_argnums=(0,))
     def run_chunk(st, key, u0):
+        if packed:
+            pc = packed_chunk.pack_chunk(params, st)
+
+            def pbody(carry, i):
+                pc, key = carry
+                key, k = jax.random.split(key)
+                pc, executed = packed_chunk.update_step_packed(
+                    params, pc, k, neighbors, u0 + i)
+                return (pc, key), executed
+            (pc, key), ex = jax.lax.scan(pbody, (pc, key),
+                                         jnp.arange(chunk))
+            return packed_chunk.unpack_chunk(params, pc), key, ex.sum()
+
         def body(carry, i):
             st, key = carry
             key, k = jax.random.split(key)
@@ -123,29 +144,40 @@ def measure(world, warmup, timed, chunk=25, seed=100, sharded=False):
 def kernel_facts(params, st):
     """Routing + budget-tail facts for the bench JSON line: which
     interpret path the measurement took, over how many devices/shards,
-    and the measured per-block budget utilization of the final state
-    under the CURRENT lane permutation (1.0 = no lockstep tail waste)."""
+    the measured per-block budget utilization of the final state under
+    the CURRENT lane mapping (1.0 = no lockstep tail waste), and
+    budget_tail_skip_pct -- the share of lockstep lane-cycles the
+    kernel's two-level scheduler skips vs a single global while_loop
+    (ops/scheduler.block_skip_fraction, from the same per-block budget
+    histogram the kernel's level-1 early exit realizes)."""
+    from avida_tpu.ops import packed_chunk
     from avida_tpu.ops import scheduler as sched_ops
     from avida_tpu.ops.pallas_cycles import block_dims, kernel_shards
     from avida_tpu.ops.update import use_pallas_path
 
     pallas = bool(use_pallas_path(params))
+    packed = bool(packed_chunk.active(params, st))
     block = block_dims(params, params.num_cells)[0] if pallas \
         else params.num_cells
+    use_perm = params.lane_perm_k > 0 and not packed
 
     @jax.jit
-    def util_fn(st):
+    def tail_fn(st):
         from avida_tpu.ops.update import scheduler_probe
         _, granted, _ = scheduler_probe(params, st, seed=17)
-        gp = granted[st.lane_perm] if params.lane_perm_k > 0 else granted
-        return sched_ops.block_utilization(gp, block)
+        gp = granted[st.lane_perm] if use_perm else granted
+        return (sched_ops.block_utilization(gp, block),
+                sched_ops.block_skip_fraction(gp, block))
 
+    util, skip = tail_fn(st)
     return {
         "device_count": jax.device_count(),
         "pallas_path": pallas,
+        "packed_chunk": packed,
         "kernel_shards": kernel_shards(params) if pallas else 1,
-        "lane_perm": params.lane_perm_k,
-        "budget_tail_util": round(float(util_fn(st)), 4),
+        "lane_perm": params.lane_perm_k if use_perm else 0,
+        "budget_tail_util": round(float(util), 4),
+        "budget_tail_skip_pct": round(float(skip) * 100, 2),
     }
 
 
@@ -199,7 +231,15 @@ def main():
     if os.environ.get("BENCH_SUPERVISE", "0") == "1":
         line.update(supervisor_restart_fields())
     if os.environ.get("BENCH_PHASES", "1") != "0":
-        line["phases"] = phase_breakdown(world)
+        phases = phase_breakdown(world)
+        line["phases"] = phases
+        # per-phase attribution of the tentpole's target costs: the
+        # pack/unpack round-trip and the birth flush of the PER-UPDATE
+        # path (what packed residency amortizes away -- compare with the
+        # phases["packed_chunk"] ms/update of the resident path)
+        line["pack_ms"] = round(phases.get("pack", 0.0)
+                                + phases.get("unpack", 0.0), 3)
+        line["flush_ms"] = round(phases.get("birth_flush", 0.0), 3)
     print(json.dumps(line))
 
 
@@ -332,12 +372,24 @@ def phase_breakdown(world, reps=2, seed=100):
     """Per-phase ms/update via the staged harness (runs after -- and does
     not perturb -- the headline measurement).  Fenced phases serialize
     work the fused scan overlaps, so these attribute the update's time;
-    they do not sum to the headline's per-update cost."""
-    from avida_tpu.observability.harness import profile_phases
+    they do not sum to the headline's per-update cost.
+
+    When the packed-resident chunk qualifies, a `packed_chunk` row is
+    appended: end-to-end ms/update of the resident-plane scan
+    (observability/harness.measure_packed_chunk) -- the direct
+    comparator for pack + kernel + unpack + birth of the staged
+    per-update rows."""
+    from avida_tpu.observability.harness import (measure_packed_chunk,
+                                                 profile_phases)
     params, st, neighbors, key = build(world, world, 256, seed=seed)
-    phases, _, _ = profile_phases(params, st, neighbors, key,
-                                  reps=reps, warmup=1)
-    return {name: round(ms, 3) for name, ms in phases.items()}
+    phases, st, _ = profile_phases(params, st, neighbors, key,
+                                   reps=reps, warmup=1)
+    out = {name: round(ms, 3) for name, ms in phases.items()}
+    pcms, _ = measure_packed_chunk(params, st, neighbors,
+                                   jax.random.key(seed + 1))
+    if pcms is not None:
+        out["packed_chunk"] = round(pcms, 3)
+    return out
 
 
 if __name__ == "__main__":

@@ -103,7 +103,15 @@ def check(current: dict | None = None) -> tuple[bool, str]:
             "If this refactor was meant to be pure code motion, it is not "
             "-- diff str(jax.make_jaxpr(update_step ...)) before/after.\n"
             "If the trace change is intentional (new feature/perf work), "
-            "re-record: python scripts/check_jaxpr.py --update")
+            "re-record the snapshot DELIBERATELY:\n"
+            "    python scripts/check_jaxpr.py --update\n"
+            "then commit scripts/jaxpr_digest.json alongside the change "
+            "and name the cause in the commit message (recent precedent: "
+            "round 2 added perm_phase; round 6 refactored the birth "
+            "flush placement into a shared helper).  Re-verify the "
+            "TPU_FAULT-off and "
+            "trace-off gates still pass (tests/test_chaos.py, "
+            "tests/test_telemetry.py) -- they digest the same program.")
     return True, "update_step jaxpr unchanged"
 
 

@@ -293,7 +293,11 @@ def test_supervised_multi_sigkill_bit_exact_pallas(tmp_path,
     mutations, lane_perm refreshed every update."""
     extra = (("TPU_USE_PALLAS", "1"), ("SLICING_METHOD", "0"),
              ("COPY_MUT_PROB", "0.0"), ("DIVIDE_INS_PROB", "0.0"),
-             ("DIVIDE_DEL_PROB", "0.0"))
+             ("DIVIDE_DEL_PROB", "0.0"),
+             # pin the budget-sort lane-packed path: packed residency
+             # (round 6) would supersede the permutation this drill
+             # asserts non-identity on
+             ("TPU_PACKED_CHUNK", "0"))
     data0, ck0 = str(tmp_path / "refdata"), str(tmp_path / "refck")
     proc = subprocess.run(
         [sys.executable, "-m", "avida_tpu"] + _argv(data0, ck0, extra=extra),

@@ -320,6 +320,10 @@ def test_pallas_lane_packed_resume_bit_exact(tmp_path):
         cfg.TPU_MAX_STEPS_PER_UPDATE = 100
         cfg.TPU_USE_PALLAS = 1        # interpret mode on CPU
         cfg.set("TPU_SYSTEMATICS", 0)
+        # this test targets the BUDGET-SORT lane-packed path; packed
+        # residency (round 6) supersedes the permutation when active, so
+        # pin it off (the packed path has its own resume test below)
+        cfg.set("TPU_PACKED_CHUNK", 0)
         if ckpt:
             cfg.set("TPU_CKPT_DIR", str(ckpt))
         w = World(cfg=cfg, data_dir=str(tmpdir))
@@ -342,6 +346,61 @@ def test_pallas_lane_packed_resume_bit_exact(tmp_path):
     wc = mk(tmp_path / "c", ckpt=ckdir)
     assert wc.resume() == 4
     wc.run(max_updates=8)
+    _assert_states_equal(wa.state, wc.state)
+
+
+@pytest.mark.slow
+def test_packed_chunk_sigterm_preempt_resume_bit_exact(tmp_path):
+    """SIGTERM preemption UNDER PACKED RESIDENCY (ops/packed_chunk.py,
+    mutations ON so the packed-native flush's divide-mutation path is in
+    the loop): the preempt flag is honored at the chunk boundary,
+    strictly AFTER update_scan's unpack, so the final checkpoint
+    serializes canonical [N, L] state mid-run; a fresh world resumes
+    bit-exactly and matches the uninterrupted packed run."""
+    from avida_tpu.config import AvidaConfig
+    from avida_tpu.config.events import parse_event_line
+    from avida_tpu.ops import packed_chunk
+    from avida_tpu.world import World
+
+    def mk(tmpdir, ckpt=None):
+        cfg = AvidaConfig()
+        cfg.WORLD_X = 8
+        cfg.WORLD_Y = 8
+        cfg.TPU_MAX_MEMORY = 200
+        cfg.RANDOM_SEED = 11
+        cfg.AVE_TIME_SLICE = 100
+        cfg.TPU_MAX_STEPS_PER_UPDATE = 100
+        cfg.TPU_USE_PALLAS = 1        # interpret mode on CPU
+        cfg.set("TPU_SYSTEMATICS", 0)
+        if ckpt:
+            cfg.set("TPU_CKPT_DIR", str(ckpt))
+        w = World(cfg=cfg, data_dir=str(tmpdir))
+        w.events = []
+        return w
+
+    wa = mk(tmp_path / "a")
+    wa.inject()
+    assert packed_chunk.active(wa.params, wa.state)
+    wa.run(max_updates=12)
+
+    ckdir = tmp_path / "ck"
+    wb = mk(tmp_path / "b", ckpt=ckdir)
+    wb._action_SendTerm = lambda args: os.kill(os.getpid(), signal.SIGTERM)
+    wb.events = [parse_event_line("u 5 SendTerm")]
+    wb.inject()
+    wb.run(max_updates=12)
+    assert wb.preempted and wb.update < 12
+    # the checkpointed state is canonical [N, L]: the flag-bit tape and
+    # the genome plane round-tripped OUT of packed residency at the
+    # boundary before the save
+    gens = ckpt_mod.list_generations(str(ckdir))
+    manifest = ckpt_mod.verify_generation(gens[-1])
+    assert tuple(manifest["arrays"]["state.tape"]["shape"]) == (64, 200)
+    assert tuple(manifest["arrays"]["state.genome"]["shape"]) == (64, 200)
+
+    wc = mk(tmp_path / "c", ckpt=ckdir)
+    assert wc.resume() == wb.update
+    wc.run(max_updates=12)
     _assert_states_equal(wa.state, wc.state)
 
 

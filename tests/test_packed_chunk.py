@@ -1,0 +1,309 @@
+"""Packed-resident update chunk (ops/packed_chunk.py): the round-6
+tentpole.  The contract under test is BIT-EXACTNESS of the resident-plane
+scan against the per-update pack/unpack path:
+
+    unpack(scan_packed(pack(st), K)) == update_step^K(st)
+
+for every eligible configuration -- mutations on, births crossing chunk
+boundaries, the flight recorder armed, TPU_LANE_PERM>1 present (the
+permutation is superseded: identity on BOTH paths), and sharded vs
+unsharded kernel launches.  Fast tier covers the routing predicate and
+the packed word-plane algebra (SWAR byte ops, the divide-mutation port);
+the kernel-driving trajectory tests run in Pallas interpret mode and are
+slow-tier, like tests/test_pallas.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from avida_tpu.config import AvidaConfig
+from avida_tpu.ops import packed_chunk
+from avida_tpu.world import World
+
+
+def _mk_world(seeds=(10, 11, 20, 21, 27), overrides=(), world=6,
+              max_memory=200):
+    cfg = AvidaConfig()
+    cfg.WORLD_X = world
+    cfg.WORLD_Y = world
+    cfg.TPU_MAX_MEMORY = max_memory
+    cfg.RANDOM_SEED = 3
+    cfg.AVE_TIME_SLICE = 120
+    cfg.TPU_USE_PALLAS = 1            # interpret mode on CPU
+    cfg.set("TPU_SYSTEMATICS", 0)
+    for k, v in overrides:
+        cfg.set(k, v)
+    w = World(cfg=cfg)
+    for c in seeds:
+        w.inject(cell=c)
+    return w
+
+
+def _assert_states_equal(sa, sb, skip=()):
+    for name in sa.__dataclass_fields__:
+        a, b = getattr(sa, name), getattr(sb, name)
+        if a is None or name in skip:
+            continue
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f"field {name}")
+
+
+def _per_update(params, st, neighbors, run_key, K, u0=0):
+    from avida_tpu.ops.update import update_step
+    st = jax.tree.map(jnp.copy, st)
+    for u in range(u0, u0 + K):
+        st, _ = update_step(params, st, jax.random.fold_in(run_key, u),
+                            neighbors, jnp.int32(u))
+    return st
+
+
+# ------------------------------------------------------------ fast tier
+
+def test_active_routing():
+    """The static predicate engages exactly for the supported envelope
+    and every exclusion knob routes back to the per-update path."""
+    w = _mk_world(seeds=(18,))
+    assert packed_chunk.active(w.params, w.state)
+    # the off switch
+    assert not packed_chunk.active(
+        w.params.replace(packed_chunk=0), w.state)
+    # XLA path (TPU_USE_PALLAS=2)
+    assert not packed_chunk.active(w.params.replace(use_pallas=2), w.state)
+    # non-torus geometry loses the roll-based flush
+    assert not packed_chunk.active(w.params.replace(geometry=1), w.state)
+    # per-site point mutations / slip mutations stay canonical
+    assert not packed_chunk.active(
+        w.params.replace(point_mut_prob=0.001), w.state)
+    assert not packed_chunk.active(
+        w.params.replace(divide_slip_prob=0.05), w.state)
+    # a populated newborn ring (systematics on) keeps the per-update path
+    w2 = _mk_world(seeds=(18,), overrides=(("TPU_SYSTEMATICS", 1),))
+    assert not packed_chunk.active(w2.params, w2.state)
+
+
+def test_pack_unpack_chunk_roundtrip():
+    """unpack_chunk(pack_chunk(st)) is the identity on every canonical
+    field (the genome plane rides the chunk; kernel-read-only rows
+    restore through restore_ro)."""
+    w = _mk_world(seeds=(7, 8, 21))
+    st = w.state
+    st2 = packed_chunk.unpack_chunk(w.params,
+                                    packed_chunk.pack_chunk(w.params, st))
+    _assert_states_equal(st, st2)
+
+
+def test_pk_byte_helpers_match_byte_ops():
+    """The SWAR word-plane helpers reproduce plain byte-array algebra:
+    set-byte, funnel shifts, range masks."""
+    from avida_tpu.ops.birth import (_pk_range_mask, _pk_set_byte,
+                                     _pk_shift_l1, _pk_shift_r1)
+    from avida_tpu.ops.pallas_cycles import _pack_words, _unpack_words
+
+    rng = np.random.default_rng(0)
+    n, L = 13, 64
+    LP = L // 4
+    by = rng.integers(0, 64, (n, L), np.uint8)
+    plane = _pack_words(jnp.asarray(by), L).T           # [LP, n]
+
+    # funnel shifts
+    np.testing.assert_array_equal(
+        np.asarray(_unpack_words(_pk_shift_r1(plane).T, L)),
+        np.concatenate([np.zeros((n, 1), np.uint8), by[:, :-1]], axis=1))
+    np.testing.assert_array_equal(
+        np.asarray(_unpack_words(_pk_shift_l1(plane).T, L)),
+        np.concatenate([by[:, 1:], np.zeros((n, 1), np.uint8)], axis=1))
+
+    # set-byte at per-lane positions
+    pos = jnp.asarray(rng.integers(0, L, n), jnp.int32)
+    val = jnp.asarray(rng.integers(0, 64, n), jnp.int32)
+    got = np.asarray(_unpack_words(_pk_set_byte(plane, pos, val).T, L))
+    want = by.copy()
+    want[np.arange(n), np.asarray(pos)] = np.asarray(val, np.uint8)
+    np.testing.assert_array_equal(got, want)
+
+    # range mask == per-byte [lo, hi) selection
+    lo = jnp.asarray(rng.integers(0, L, n), jnp.int32)
+    hi = jnp.asarray(rng.integers(0, L + 4, n), jnp.int32)
+    m = _pk_range_mask(LP, lo, hi)
+    got = np.asarray(_unpack_words((plane & m).T, L))
+    cols = np.arange(L)[None, :]
+    want = np.where((cols >= np.asarray(lo)[:, None])
+                    & (cols < np.asarray(hi)[:, None]), by, 0)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pk_extract_offspring_matches_canonical():
+    """The packed divide-mutation port consumes the identical PRNG
+    stream: same key, same draws, same offspring -- across substitution,
+    insertion, deletion, DIV_MUT and COPY_INS/DEL branches."""
+    from avida_tpu.config.environment import default_logic9_environment
+    from avida_tpu.config.instset import default_instset
+    from avida_tpu.core.state import make_world_params, zeros_population
+    from avida_tpu.ops.birth import _pk_extract_offspring
+    from avida_tpu.ops.interpreter import extract_offspring
+    from avida_tpu.ops.pallas_cycles import _pack_words, _unpack_words
+
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 4
+    cfg.WORLD_Y = 4
+    cfg.TPU_MAX_MEMORY = 64
+    cfg.DIV_MUT_PROB = 0.02
+    cfg.set("COPY_INS_PROB", 0.01)
+    cfg.set("COPY_DEL_PROB", 0.01)
+    params = make_world_params(cfg, default_instset(),
+                               default_logic9_environment())
+    n, L = 16, 64
+    rng = np.random.default_rng(5)
+    st = zeros_population(n, L, params.num_reactions)
+    off_len = rng.integers(10, 40, n).astype(np.int32)
+    off = rng.integers(0, 26, (n, L)).astype(np.uint8)
+    off[np.arange(L)[None, :] >= off_len[:, None]] = 0
+    st = st.replace(
+        off_tape=jnp.asarray(off),
+        off_len=jnp.asarray(off_len),
+        genome_len=jnp.asarray(rng.integers(10, 40, n).astype(np.int32)),
+        divide_pending=jnp.asarray(rng.random(n) < 0.8),
+        alive=jnp.ones(n, bool),
+    )
+    key = jax.random.key(99)
+    want_off, want_len = extract_offspring(params, st, key,
+                                           use_off_tape=True)
+    got_w, got_len = _pk_extract_offspring(
+        params, key, _pack_words(jnp.asarray(off), L).T,
+        st.off_len, st.genome_len, st.divide_pending)
+    np.testing.assert_array_equal(np.asarray(got_len), np.asarray(want_len))
+    np.testing.assert_array_equal(
+        np.asarray(_unpack_words(got_w.T, L)).astype(np.int8),
+        np.asarray(want_off))
+    # mutations actually fired (otherwise this test proves nothing)
+    assert not np.array_equal(np.asarray(want_len), off_len)
+
+
+# ------------------------------------------------------------ slow tier
+
+@pytest.mark.slow
+def test_packed_scan_matches_per_update_mutations_on():
+    """THE tentpole contract: a packed-resident K-update scan is
+    bit-exact vs K per-update update_step calls, with the full default
+    mutation battery on (copy substitutions + divide ins/del riding the
+    packed-native flush)."""
+    from avida_tpu.ops.update import update_scan
+
+    w = _mk_world()
+    params, nb, st0 = w.params, w.neighbors, w.state
+    assert packed_chunk.active(params, st0)
+    run_key = jax.random.key(123)
+    K = 10
+    ref = _per_update(params, st0, nb, run_key, K)
+    got, _ = update_scan(params, jax.tree.map(jnp.copy, st0), K, run_key,
+                         nb, jnp.int32(0))
+    _assert_states_equal(ref, got)
+    assert int(np.asarray(ref.num_divides).sum()) > 0, \
+        "no divide happened -- the flush was never exercised"
+
+
+@pytest.mark.slow
+def test_packed_chunk_boundary_births_bit_exact():
+    """Births landing ACROSS a chunk boundary: splitting the scan at any
+    point (pending divides crossing the unpack/repack) changes nothing."""
+    from avida_tpu.ops.update import update_scan
+
+    w = _mk_world()
+    params, nb, st0 = w.params, w.neighbors, w.state
+    run_key = jax.random.key(7)
+    K = 12
+    ref, _ = update_scan(params, jax.tree.map(jnp.copy, st0), K, run_key,
+                         nb, jnp.int32(0))
+    for split in (1, 5, 7):
+        st1, _ = update_scan(params, jax.tree.map(jnp.copy, st0), split,
+                             run_key, nb, jnp.int32(0))
+        st2, _ = update_scan(params, st1, K - split, run_key, nb,
+                             jnp.int32(split))
+        _assert_states_equal(ref, st2)
+    assert int(np.asarray(ref.num_divides).sum()) >= 10
+
+
+@pytest.mark.slow
+def test_packed_supersedes_lane_perm_bit_exact():
+    """TPU_LANE_PERM > 1 with packed residency: the permutation is
+    superseded on BOTH paths (identity lanes -- perm_phase's mid-chunk /
+    early refresh schedule never engages), so packed-vs-per-update
+    bit-exactness holds and lane_perm stays identity throughout."""
+    from avida_tpu.ops.update import update_scan
+
+    w = _mk_world(overrides=(("TPU_LANE_PERM", 2),
+                             ("TPU_LANE_PERM_MIN_UTIL", 0.99)))
+    params, nb, st0 = w.params, w.neighbors, w.state
+    assert params.lane_perm_k == 2
+    assert packed_chunk.active(params, st0)
+    run_key = jax.random.key(7)
+    K = 10
+    ref = _per_update(params, st0, nb, run_key, K)
+    got, _ = update_scan(params, jax.tree.map(jnp.copy, st0), K, run_key,
+                         nb, jnp.int32(0))
+    _assert_states_equal(ref, got)
+    n = params.num_cells
+    assert np.array_equal(np.asarray(got.lane_perm), np.arange(n))
+    assert np.array_equal(np.asarray(got.lane_inv), np.arange(n))
+
+
+@pytest.mark.slow
+def test_packed_matches_xla_engine():
+    """Cross-ENGINE equivalence: the packed-resident pallas scan equals
+    the XLA micro-step engine trajectory (mutation-free so no PRNG-
+    stream divergence; lane bookkeeping excluded as in test_pallas)."""
+    from avida_tpu.ops.update import update_scan
+
+    muts = (("COPY_MUT_PROB", 0.0), ("DIVIDE_INS_PROB", 0.0),
+            ("DIVIDE_DEL_PROB", 0.0), ("SLICING_METHOD", 0),
+            ("AVE_TIME_SLICE", 120))
+    wp = _mk_world(overrides=muts)
+    wx = _mk_world(overrides=muts + (("TPU_USE_PALLAS", 2),))
+    assert packed_chunk.active(wp.params, wp.state)
+    assert not packed_chunk.active(wx.params, wx.state)
+    run_key = jax.random.key(42)
+    K = 10
+    got, _ = update_scan(wp.params, wp.state, K, run_key, wp.neighbors,
+                         jnp.int32(0))
+    ref = _per_update(wx.params, wx.state, wx.neighbors, run_key, K)
+    _assert_states_equal(ref, got, skip={"lane_perm", "lane_inv"})
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(len(jax.devices()) < 2, reason="needs 2 devices")
+def test_packed_sharded_matches_unsharded():
+    """TPU_KERNEL_SHARDS=2 vs 1 under packed residency: the shard_map'd
+    kernel launches inside the resident chunk (and the GSPMD-sharded
+    roll-based flush around them) reproduce the unsharded trajectory
+    bit-for-bit, boundary-crossing births included (the seed cells sit
+    on the shard-0/1 band boundary).  Mutation-free, as in
+    tests/test_parallel.py (interpret-mode PRNG streams are
+    lane-indexed)."""
+    from avida_tpu.ops.update import update_scan
+    from avida_tpu.parallel import (make_mesh, shard_neighbors,
+                                    shard_population)
+
+    muts = (("COPY_MUT_PROB", 0.0), ("DIVIDE_INS_PROB", 0.0),
+            ("DIVIDE_DEL_PROB", 0.0), ("SLICING_METHOD", 0),
+            ("AVE_TIME_SLICE", 100), ("TPU_MAX_STEPS_PER_UPDATE", 100))
+    # 32x32 = 1024 cells: 512-lane blocks x 2 shards -- the live band
+    # really spans both shards
+    w1 = _mk_world(seeds=(511, 512), world=32,
+                   overrides=muts + (("TPU_KERNEL_SHARDS", 1),))
+    w2 = _mk_world(seeds=(511, 512), world=32,
+                   overrides=muts + (("TPU_KERNEL_SHARDS", 2),))
+    assert packed_chunk.active(w1.params, w1.state)
+    run_key = jax.random.key(17)
+    K = 6
+    ref, _ = update_scan(w1.params, w1.state, K, run_key, w1.neighbors,
+                         jnp.int32(0))
+    mesh = make_mesh(jax.devices()[:2])
+    got, _ = update_scan(w2.params, shard_population(w2.state, mesh), K,
+                         run_key, shard_neighbors(w2.neighbors, mesh),
+                         jnp.int32(0))
+    _assert_states_equal(ref, got)
+    assert int(np.asarray(ref.alive).sum()) > 2, "no birth -- lengthen"

@@ -197,6 +197,10 @@ def _mk_world_lane(use_pallas: int, lane_perm: int) -> World:
     cfg.TPU_USE_PALLAS = use_pallas
     cfg.set("TPU_LANE_PERM", lane_perm)
     cfg.set("TPU_SYSTEMATICS", 0)
+    # these tests target the budget-sort lane permutation specifically;
+    # packed residency (round 6) would supersede it (identity lanes), so
+    # pin it off here (tests/test_packed_chunk.py covers that path)
+    cfg.set("TPU_PACKED_CHUNK", 0)
     w = World(cfg=cfg)
     w.inject()
     return w

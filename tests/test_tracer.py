@@ -235,15 +235,51 @@ def test_trace_bit_exact_pallas_lane_packed(tmp_path):
     and the move gather)."""
     from avida_tpu.ops.update import use_pallas_path
 
-    wa = _world(tmp_path / "off", seed=31, pallas=True)
+    # pin the budget-sort lane-packed path (packed residency would
+    # supersede the permutation; it has its own test below)
+    lp = [("TPU_PACKED_CHUNK", 0)]
+    wa = _world(tmp_path / "off", seed=31, pallas=True, extra=lp)
     assert use_pallas_path(wa.params) and wa.params.lane_perm_k == 1
     wa.inject()
     wa.run(max_updates=12)
 
-    wb = _world(tmp_path / "on", seed=31, trace=1, pallas=True)
+    wb = _world(tmp_path / "on", seed=31, trace=1, pallas=True, extra=lp)
     wb.inject()
     wb.run(max_updates=12)
 
+    for name in wa.state.__dataclass_fields__:
+        if name.startswith("tr_"):
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(getattr(wa.state, name)),
+            np.asarray(getattr(wb.state, name)), err_msg=f"field {name}")
+
+
+@pytest.mark.slow
+def test_trace_bit_exact_packed_chunk(tmp_path):
+    """Flight recorder under PACKED RESIDENCY (ops/packed_chunk.py,
+    mutations ON): trace-on vs trace-off trajectories stay bit-identical,
+    and every update's events reach the runlog through the chunk-boundary
+    drain -- which reads the ring off CANONICAL state, strictly after
+    update_scan's unpack."""
+    from avida_tpu.ops import packed_chunk
+
+    extra = [("COPY_MUT_PROB", 0.0075), ("DIVIDE_INS_PROB", 0.05),
+             ("DIVIDE_DEL_PROB", 0.05), ("SLICING_METHOD", 1),
+             ("TPU_TRACE_STALL_UTIL", 1.1)]
+    wa = _world(tmp_path / "off", seed=29, pallas=True, extra=extra)
+    wa.inject()
+    assert packed_chunk.active(wa.params, wa.state)
+    wa.run(max_updates=12)
+
+    wb = _world(tmp_path / "on", seed=29, trace=1, pallas=True, extra=extra)
+    wb.inject()
+    wb.run(max_updates=12)
+
+    # stall-util 1.1 guarantees at least one event per update: the drain
+    # saw every update of every packed chunk
+    assert {r["update"] for r in _trace_records(tmp_path / "on")} \
+        == set(range(12))
     for name in wa.state.__dataclass_fields__:
         if name.startswith("tr_"):
             continue
