@@ -47,6 +47,16 @@ TPU-build extras (no reference equivalent):
                      missing or older than SEC seconds (0 fresh,
                      1 no metrics file) -- consumable by external
                      watchdogs and cron.
+  --fleet SPOOL      run the fleet orchestrator (service/fleet.py):
+                     drain SPOOL of JSON job specs and drive up to
+                     --max-jobs concurrent supervised runs, each in its
+                     own fault domain, with a crash-safe journal
+                     (fleet.jsonl), admission control, a crash-storm
+                     circuit breaker and graceful SIGTERM drain.
+                     --serve keeps polling an empty spool instead of
+                     exiting.  `--status SPOOL` prints the aggregate
+                     fleet summary; scripts/fleet_tool.py
+                     submits/lists/cancels/requeues jobs.
   --supervise        run under the self-healing supervisor
                      (service/supervisor.py): the remaining arguments
                      become the child run's command line (needs -d DIR
@@ -78,6 +88,11 @@ def main(argv=None):
         # must never load jax (it has to outlive a wedged child runtime)
         from avida_tpu.service.supervisor import supervise_main
         return supervise_main(argv)
+    if "--fleet" in argv:
+        # same host-only rule: the orchestrator multiplexes many
+        # supervised runs and must outlive every one of their runtimes
+        from avida_tpu.service.fleet import fleet_main
+        return fleet_main(argv)
 
     p = argparse.ArgumentParser(prog="avida_tpu", add_help=True)
     p.add_argument("-c", "--config-dir", default=None)
@@ -98,8 +113,15 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     if args.status is not None:
-        # outside-the-process observability: read the metrics.prom
-        # heartbeat only -- no World, no JAX device init
+        # outside-the-process observability: read the metrics.prom /
+        # fleet.prom heartbeat only -- no World, no JAX device init.  A
+        # fleet spool (fleet.prom or fleet.jsonl present) gets the
+        # aggregate per-job summary instead of the single-run view.
+        if os.path.exists(os.path.join(args.status, "fleet.prom")) \
+                or os.path.exists(os.path.join(args.status,
+                                               "fleet.jsonl")):
+            from avida_tpu.service.fleet import fleet_status_main
+            return fleet_status_main(args.status, max_age=args.max_age)
         from avida_tpu.observability.exporter import status_main
         return status_main(args.status, max_age=args.max_age)
 

@@ -90,17 +90,55 @@ def trim_update_records(path: str, max_update: int):
         os.replace(tmp, path)
 
 
-def append_record(path: str, rec: dict):
+def append_record(path: str, rec: dict, max_bytes: int | None = None):
     """Crash-safe single-record append for OUT-OF-PROCESS writers (the
-    run supervisor's {"record": "supervisor"} events): open, append one
-    line, fsync, close -- no handle is held across a child process's
+    run supervisor's {"record": "supervisor"} events, the fleet
+    orchestrator's {"record": "fleet"} journal): open, append one line,
+    fsync, close -- no handle is held across a child process's
     lifetime, and a torn tail can only ever be the final line (which
-    every runlog reader already tolerates)."""
+    every runlog reader already tolerates).
+
+    Rotation: with `max_bytes` set, a file that would grow past the cap
+    is first moved aside to `<path>.1` (atomic rename, clobbering the
+    previous aside) and the record starts a fresh file -- a long heal
+    loop cannot grow supervisor.jsonl/fleet.jsonl without bound, and a
+    crash between the rename and the append loses nothing (both files
+    survive, the record was never acknowledged).  Readers that need
+    history beyond the live file read `<path>.1` first (see
+    read_records)."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    line = json.dumps(rec) + "\n"
+    if max_bytes:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        if size and size + len(line) > max_bytes:
+            os.replace(path, path + ".1")
     with open(path, "a") as f:
-        f.write(json.dumps(rec) + "\n")
+        f.write(line)
         f.flush()
         os.fsync(f.fileno())
+
+
+def read_records(path: str) -> list:
+    """All JSON records across the rotation pair (`<path>.1` then
+    `<path>`), oldest first, torn/garbage lines skipped.  The journal
+    reader for replay-on-restart consumers (service/fleet.py) and the
+    ops tooling (scripts/fleet_tool.py)."""
+    out = []
+    for p in (path + ".1", path):
+        try:
+            f = open(p)
+        except OSError:
+            continue
+        with f:
+            for line in f:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue            # torn tail from a crash
+    return out
 
 
 def emit_event(world, event: str, **fields):

@@ -1,9 +1,12 @@
-"""Run-service layer: the self-healing run supervisor.
+"""Run-service layer: the self-healing supervisor + the fleet orchestrator.
 
 Host-only (no jax import anywhere in this package): the supervisor is
 the process that must stay alive while the run process crashes, hangs
 or corrupts itself, so it watches entirely from outside -- child exit
 codes, the metrics.prom heartbeat file and the checkpoint directory.
+The fleet orchestrator (fleet.py) multiplexes many poll()-mode
+supervisors over a spool of job specs under the same rule: it must
+outlive every tenant's runtime.
 
 Child exit codes (set by avida_tpu/__main__.py so the supervisor can
 classify failures without parsing tracebacks):

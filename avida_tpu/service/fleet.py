@@ -1,0 +1,970 @@
+"""Fleet orchestrator: `python -m avida_tpu --fleet SPOOL_DIR`.
+
+The supervisor (service/supervisor.py) heals ONE run; real Avida
+science is many-seed sweeps and the ROADMAP north star is a service
+handling many tenants' runs at once.  This module is the robustness
+layer for the *fleet*: a host-only orchestrator (never imports jax,
+same rule as the supervisor) that drains a spool directory of JSON job
+specs and multiplexes up to `max_jobs` concurrent supervised runs
+through one poll loop -- each job a poll()-mode Supervisor in its own
+fault domain, so one tenant's crash loop cannot take out another's run
+or the orchestrator itself.
+
+Spool layout (everything lives under SPOOL_DIR)::
+
+    <name>.json         queued job spec (fleet_tool.py submit, or any
+                        atomic writer)
+    <name>/             the job's fault domain, created at admission:
+      job.json            the admitted spec (moved from the spool root)
+      data/               child data dir (metrics.prom heartbeat,
+                          supervised.log, supervisor.jsonl, .dat files)
+      ck/                 checkpoint generations (utils/checkpoint.py)
+    .bad-<name>.json.*  quarantined malformed specs (never retried)
+    <name>.cancelled.json  specs parked by `fleet_tool.py cancel`
+    <name>.cancel / <name>.requeue   operator marker files, consumed by
+                        the orchestrator on its next poll
+    fleet.jsonl[.1]     the crash-safe journal (runlog.append_record,
+                        size-capped rotation)
+    fleet.prom          aggregate Prometheus metrics
+    fleet.lock          single-orchestrator guard (pid)
+
+Job spec schema (README "Fleet runs")::
+
+    {"argv": ["-u", "20000", "-s", "7", "-set", "TPU_CKPT_EVERY", "500"],
+     "fault_plan": ["sigkill@update=5"],      # optional, chaos testing
+     "env": {"TPU_WATCHDOG_SEC": "60"}}       # optional, per-job knobs
+
+The fleet appends `-d <job>/data -set TPU_CKPT_DIR <job>/ck` AFTER the
+spec's argv (last value wins), so a spec cannot escape its fault
+domain; the Supervisor then appends `--resume` and forces the metrics
+heartbeat as it always does -- one fixed spec both starts and restarts
+a job bit-exactly.
+
+Robustness properties, each chaos-tested (tests/test_fleet.py):
+
+  * crash-safe journal + replay: every state transition is an fsync'd
+    `{"record": "fleet"}` line.  A killed orchestrator replays the
+    journal on restart and resumes every admitted job from its newest
+    checkpoint WITHOUT double-spawning: admission is transactional
+    (journal the admit first, then atomically move the spec into the
+    job dir -- replay completes a half-done move), and children run in
+    their own sessions with journaled pids so an orphan left by a
+    SIGKILLed orchestrator is reaped (after a /proc identity check)
+    before its job is respawned.
+  * admission control: jobs past `max_jobs` queue in the spool rather
+    than spawn; malformed specs are quarantined to `.bad-*` once, not
+    retried forever.
+  * crash-storm circuit breaker: `TPU_FLEET_BREAKER_K` same-class
+    failures across jobs within `TPU_FLEET_BREAKER_SEC` seconds opens
+    the breaker -- admissions pause, the fleet is marked degraded in
+    fleet.prom, and a kernel-implicated storm applies the Pallas->XLA
+    degradation FLEET-WIDE once instead of per-job.  The breaker closes
+    after a full quiet window.
+  * graceful drain: SIGTERM forwards to every child (preemption
+    checkpoints), completed jobs finish as `done`, incomplete ones are
+    journaled `requeued` so the next orchestrator resumes them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+from avida_tpu.observability.exporter import (read_metrics,
+                                              render_families,
+                                              write_metrics)
+from avida_tpu.observability.runlog import append_record, read_records
+from avida_tpu.service import FAILURE_CLASSES
+from avida_tpu.service.supervisor import Supervisor, SupervisorConfig
+
+JOURNAL_FILE = "fleet.jsonl"
+FLEET_METRICS_FILE = "fleet.prom"
+LOCK_FILE = "fleet.lock"
+JOB_SPEC_FILE = "job.json"
+
+JOB_STATES = ("queued", "running", "done", "failed", "quarantined",
+              "cancelled")
+
+# job names become directory names and metric labels; the whole
+# "fleet"/"fleet.*" namespace is the orchestrator's own (fleet.jsonl,
+# fleet.prom, fleet.lock) -- a job named after any of those would
+# wedge the spool
+_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
+
+
+def legal_name(name: str) -> bool:
+    # also reserved: the operator-marker / parked-spec suffixes -- a job
+    # named "foo.cancelled" would write a spec the scanner must skip
+    # (and requeue would later resurrect it under the wrong name)
+    return bool(_NAME_RE.match(name)) and name != "fleet" \
+        and not name.startswith("fleet.") \
+        and not name.endswith((".cancel", ".cancelled", ".requeue"))
+
+
+class FleetLockedError(RuntimeError):
+    """Another live orchestrator already owns this spool."""
+
+
+def validate_spec(spec) -> None:
+    """Schema check for one job spec; raises ValueError on anything a
+    Supervisor could not safely run.  Malformed specs are quarantined
+    at scan time, BEFORE they consume an admission slot."""
+    if not isinstance(spec, dict):
+        raise ValueError("job spec must be a JSON object")
+    argv = spec.get("argv")
+    if (not isinstance(argv, list) or not argv
+            or not all(isinstance(a, str) for a in argv)):
+        raise ValueError("job spec needs a non-empty 'argv' list of "
+                         "strings (the child run's command line)")
+    plan = spec.get("fault_plan", [])
+    if (not isinstance(plan, list)
+            or not all(isinstance(s, str) for s in plan)):
+        raise ValueError("'fault_plan' must be a list of TPU_FAULT "
+                         "spec strings")
+    env = spec.get("env", {})
+    if (not isinstance(env, dict)
+            or not all(isinstance(k, str) and isinstance(v, str)
+                       for k, v in env.items())):
+        raise ValueError("'env' must be a string-to-string object")
+
+
+class FleetConfig:
+    """Knobs, all overridable via the environment (README "Fleet
+    runs")."""
+
+    def __init__(self, max_jobs: int = 2, poll_sec: float = 0.5,
+                 breaker_k: int = 3, breaker_sec: float = 300.0,
+                 drain_sec: float = 600.0, serve: bool = False,
+                 journal_max_bytes: int = 64 << 20):
+        self.max_jobs = max(int(max_jobs), 1)
+        self.poll_sec = float(poll_sec)
+        self.breaker_k = int(breaker_k)
+        self.breaker_sec = float(breaker_sec)
+        self.drain_sec = float(drain_sec)
+        self.serve = bool(serve)
+        self.journal_max_bytes = int(journal_max_bytes)
+
+    @classmethod
+    def from_env(cls, env) -> "FleetConfig":
+        def f(name, default):
+            return float(env.get(name, default))
+        return cls(
+            max_jobs=int(f("TPU_FLEET_MAX_JOBS", 2)),
+            poll_sec=f("TPU_FLEET_POLL_SEC", 0.5),
+            breaker_k=int(f("TPU_FLEET_BREAKER_K", 3)),
+            breaker_sec=f("TPU_FLEET_BREAKER_SEC", 300.0),
+            drain_sec=f("TPU_FLEET_DRAIN_SEC", 600.0),
+            journal_max_bytes=int(f("TPU_RUNLOG_MAX_BYTES", 64 << 20)),
+        )
+
+
+class CircuitBreaker:
+    """Crash-storm detector: K failures of ONE class (across jobs)
+    within a sliding window opens the breaker; it closes again after a
+    full quiet window with no same-class failure.  Pure host state
+    driven by an injected clock value -- fake-clock unit-testable."""
+
+    def __init__(self, k: int, window_sec: float):
+        self.k = max(int(k), 1)
+        self.window_sec = float(window_sec)
+        self._times: dict = {}          # class -> recent failure times
+        self.open_class = None
+        self.opened_at = None
+        self.last_failure_t = None
+        self.trips = 0
+
+    def note_failure(self, cls: str, now: float) -> bool:
+        """Record one classified failure at `now`; True exactly when
+        this failure trips the breaker open (rising edge)."""
+        times = [t for t in self._times.get(cls, ())
+                 if now - t < self.window_sec]
+        times.append(now)
+        self._times[cls] = times
+        if self.open_class is not None:
+            if cls == self.open_class:
+                self.last_failure_t = now    # the storm continues
+            return False
+        if len(times) >= self.k:
+            self.open_class = cls
+            self.opened_at = now
+            self.last_failure_t = now
+            self.trips += 1
+            return True
+        return False
+
+    def is_open(self, now: float) -> bool:
+        return (self.open_class is not None
+                and now - self.last_failure_t < self.window_sec)
+
+    def maybe_close(self, now: float):
+        """Close after a quiet window; returns the failure class just
+        closed (None when nothing changed)."""
+        if self.open_class is not None \
+                and now - self.last_failure_t >= self.window_sec:
+            cls, self.open_class = self.open_class, None
+            self._times.pop(cls, None)
+            return cls
+        return None
+
+
+class Job:
+    """One tenant run: its fault domain paths + orchestration state."""
+
+    def __init__(self, name: str, spool: str):
+        self.name = name
+        self.spool = spool
+        self.dir = os.path.join(spool, name)
+        self.state = "queued"
+        self.spec = None
+        self.sup: Supervisor | None = None
+        self.pid = None                 # newest child pid (journaled)
+        self.cancel_requested = False
+        self._fail_snapshot: dict = {}
+
+    @property
+    def data_dir(self):
+        return os.path.join(self.dir, "data")
+
+    @property
+    def ckpt_dir(self):
+        return os.path.join(self.dir, "ck")
+
+    @property
+    def spec_path(self):
+        return os.path.join(self.dir, JOB_SPEC_FILE)
+
+    @property
+    def spool_spec_path(self):
+        return os.path.join(self.spool, self.name + ".json")
+
+
+def journal_states(journal_path: str) -> tuple:
+    """Replay the fleet journal into (job_state, job_pid, xla_fallback).
+    Shared by the orchestrator's restart replay, `fleet_tool.py list`
+    and the --status fleet view; reads the rotation pair."""
+    state: dict = {}
+    pids: dict = {}
+    xla = False
+    for rec in read_records(journal_path):
+        if rec.get("record") != "fleet":
+            continue
+        ev = rec.get("event")
+        name = rec.get("job")
+        if ev == "snapshot":
+            # compaction record written at rotation: authoritative full
+            # state at that instant -- replay survives every older
+            # record being gone (the .1 aside is clobbered per rotation)
+            state = {n: v.get("state") for n, v in rec["jobs"].items()}
+            pids = {n: v.get("pid") for n, v in rec["jobs"].items()
+                    if v.get("pid")}
+            xla = bool(rec.get("xla_fallback"))
+        elif ev == "admit":
+            state[name] = "running"
+        elif ev == "spawn":
+            pids[name] = rec.get("pid")
+        elif ev == "cancel_requested":
+            # a cancel whose graceful stop was still in flight: must not
+            # be resurrected as "running" if the orchestrator dies here
+            state[name] = "cancelling"
+        elif ev in ("done", "failed", "cancelled", "quarantined",
+                    "requeued"):
+            state[name] = ev
+        elif ev == "xla_fallback":
+            xla = True
+    return state, pids, xla
+
+
+def spool_job_states(spool: str) -> dict:
+    """{job: state} for one spool: the journal replay merged with a
+    scan for not-yet-admitted specs (queued) and parked ones
+    (cancelled).  The single source for every read-only job table --
+    the --status fleet view and `fleet_tool.py list` both render
+    this."""
+    state, _, _ = journal_states(os.path.join(spool, JOURNAL_FILE))
+    if os.path.isdir(spool):
+        for fn in sorted(os.listdir(spool)):
+            if fn.startswith("."):
+                continue
+            if fn.endswith(".cancelled.json"):
+                state.setdefault(fn[:-len(".cancelled.json")],
+                                 "cancelled")
+            elif fn.endswith(".json"):
+                state.setdefault(fn[:-len(".json")], "queued")
+    return state
+
+
+class FleetOrchestrator:
+    def __init__(self, spool: str, cfg: FleetConfig | None = None,
+                 env=None, clock=time.time, sleep=time.sleep,
+                 spawn_factory=None):
+        # canonical spool path: children's command lines embed it, and
+        # the orphan reaper's /proc identity check compares against it
+        # -- a restart from a differently-spelled path ("runs" vs
+        # "./runs" vs a symlink) must still recognize its own orphans
+        self.spool = os.path.realpath(str(spool))
+        base_env = dict(os.environ if env is None else env)
+        self.cfg = cfg or FleetConfig.from_env(base_env)
+        self._base_env = base_env
+        self._clock = clock
+        self._sleep = sleep
+        # tests inject stub children here: factory(job) -> spawn fn with
+        # the Supervisor._spawn_default signature (argv, env, logf)
+        self._spawn_factory = spawn_factory or self._make_spawn
+        self.jobs: dict = {}
+        self._stop = False
+        self.breaker = CircuitBreaker(self.cfg.breaker_k,
+                                      self.cfg.breaker_sec)
+        self.xla_fallback = False
+        self.admissions_paused = False
+        self.failures = {c: 0 for c in FAILURE_CLASSES}
+        self.journal_path = os.path.join(self.spool, JOURNAL_FILE)
+        self.metrics_path = os.path.join(self.spool, FLEET_METRICS_FILE)
+        os.makedirs(self.spool, exist_ok=True)
+        self._pending_recovery: dict = {}
+        self._recovered = False
+        self._replay()
+
+    # ---- journal ----
+
+    def journal(self, event: str, **fields):
+        rec = {"record": "fleet", "event": event, "time": self._clock(),
+               **fields}
+        try:
+            # rotation is done here rather than via append_record's
+            # max_bytes: the fresh file must START with a compaction
+            # snapshot, or a second rotation would clobber the .1 aside
+            # holding a live job's admit/spawn records and replay would
+            # lose the job (and its orphan's pid) entirely
+            try:
+                size = os.path.getsize(self.journal_path)
+            except OSError:
+                size = 0
+            if size and size + len(json.dumps(rec)) + 1 \
+                    > self.cfg.journal_max_bytes:
+                os.replace(self.journal_path, self.journal_path + ".1")
+                append_record(self.journal_path, {
+                    "record": "fleet", "event": "snapshot",
+                    "time": self._clock(),
+                    "xla_fallback": self.xla_fallback,
+                    "jobs": {n: {"state": j.state, "pid": j.pid}
+                             for n, j in self.jobs.items()}})
+            append_record(self.journal_path, rec)
+        except OSError:
+            pass                        # logging must not kill the fleet
+        detail = " ".join(f"{k}={v}" for k, v in fields.items())
+        print(f"[fleet] {event}" + (f": {detail}" if detail else ""),
+              file=sys.stderr)
+
+    def _replay(self):
+        """Rebuild job state from the journal -- READ-ONLY: no journal
+        writes, no process kills, so constructing an orchestrator (or a
+        status/list view over its guts) cannot disturb a live fleet.
+        The destructive half (orphan reaping, half-done spec moves,
+        replay_resume records) is _recover(), which runs behind the
+        fleet.lock at the first poll."""
+        state, pids, self.xla_fallback = journal_states(self.journal_path)
+        for name, st in state.items():
+            job = Job(name, self.spool)
+            self.jobs[name] = job
+            if st in ("done", "failed", "cancelled", "quarantined"):
+                job.state = st
+                continue
+            if st == "cancelling":
+                # the cancel's graceful stop was mid-flight when the
+                # last orchestrator died: honor it (never resurrect),
+                # but the child may still be alive -- reap at recovery
+                job.state = "cancelled"
+            else:
+                # admitted (or drained-requeued): back to the queue;
+                # the Supervisor always appends --resume, so the job
+                # continues from its newest checkpoint
+                job.state = "queued"
+            self._pending_recovery[name] = (pids.get(name), st)
+
+    def _recover(self):
+        """The destructive half of replay, run once behind fleet.lock:
+        reap orphans left by a killed orchestrator, complete half-done
+        admission moves, journal what was resumed."""
+        if self._recovered:
+            return
+        self._recovered = True
+        for name, (pid, st) in self._pending_recovery.items():
+            job = self.jobs[name]
+            self._reap_orphan(name, pid)
+            if st == "cancelling":
+                self.journal("cancelled", job=name, reason="replayed")
+                continue
+            if not os.path.exists(job.spec_path) \
+                    and os.path.exists(job.spool_spec_path):
+                os.makedirs(job.dir, exist_ok=True)
+                os.replace(job.spool_spec_path, job.spec_path)
+            if st == "running":
+                self.journal("replay_resume", job=name)
+        self._pending_recovery = {}
+
+    def _reap_orphan(self, name: str, pid):
+        """A SIGKILLed orchestrator leaves children running detached; a
+        resumed job must never have TWO children writing one checkpoint
+        dir.  Children are spawned in their own session (pgid == pid),
+        so kill the group -- but only after /proc confirms the pid
+        still belongs to this job (pid reuse must not kill an
+        innocent)."""
+        if not pid:
+            return
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode("utf-8",
+                                                           "replace")
+        except OSError:
+            return                      # gone (or no /proc): nothing up
+        if os.path.join(self.spool, name) not in cmd:
+            return                      # pid reused by someone else
+        self.journal("orphan_killed", job=name, pid=pid)
+        try:
+            os.killpg(pid, signal.SIGKILL)
+        except OSError:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+        deadline = time.time() + 5.0
+        while os.path.exists(f"/proc/{pid}") and time.time() < deadline:
+            time.sleep(0.05)
+
+    # ---- admission ----
+
+    def _scan_spool(self):
+        """Pick up newly submitted specs; quarantine malformed ones NOW
+        (a spec that cannot run must not be retried forever, and must
+        not wait for an admission slot to be found out)."""
+        for fn in sorted(os.listdir(self.spool)):
+            if not fn.endswith(".json") or fn.startswith(".") \
+                    or fn.endswith(".cancelled.json"):
+                continue
+            name = fn[:-len(".json")]
+            if name in self.jobs:
+                continue                # known: admitted jobs moved
+                                        # their spec, so this is a
+                                        # resubmit race -- never a
+                                        # double spawn
+            path = os.path.join(self.spool, fn)
+            job = Job(name, self.spool)
+            try:
+                if not legal_name(name):
+                    raise ValueError(f"illegal job name {name!r}")
+                with open(path) as f:
+                    spec = json.load(f)
+                validate_spec(spec)
+            except (ValueError, OSError) as e:
+                self._quarantine_spec(job, path, str(e))
+                continue
+            job.spec = spec
+            self.jobs[name] = job
+
+    def _quarantine_spec(self, job: Job, path: str, error: str):
+        dst = os.path.join(
+            self.spool,
+            f".bad-{os.path.basename(path)}.{int(self._clock())}")
+        try:
+            os.replace(path, dst)
+        except OSError:
+            dst = ""
+        job.state = "quarantined"
+        self.jobs[job.name] = job
+        self.journal("quarantined", job=job.name, error=error,
+                     moved_to=os.path.basename(dst))
+
+    def _admit(self, now: float):
+        """Admission control: fill free slots from the queue unless the
+        circuit breaker holds admissions."""
+        self.admissions_paused = self.breaker.is_open(now)
+        if self.admissions_paused:
+            return
+        running = sum(1 for j in self.jobs.values()
+                      if j.state == "running")
+        for name in sorted(self.jobs):
+            if running >= self.cfg.max_jobs:
+                break
+            job = self.jobs[name]
+            if job.state != "queued":
+                continue
+            if self._start(job):
+                running += 1
+
+    def _start(self, job: Job) -> bool:
+        """Admit one queued job: transactional spec move + Supervisor
+        construction + first child launch."""
+        if not os.path.exists(job.spec_path):
+            # journal-first admission: if we die between these two
+            # steps, replay finds the admit record and completes the
+            # move before respawning
+            self.journal("admit", job=job.name)
+            try:
+                os.makedirs(job.dir, exist_ok=True)
+                os.replace(job.spool_spec_path, job.spec_path)
+            except OSError as e:
+                # e.g. the job-dir path is blocked by a file: quarantine
+                # rather than crash-loop the whole orchestrator
+                self._quarantine_spec(job, job.spool_spec_path,
+                                      f"spec move failed: {e}")
+                return False
+        if job.spec is None:
+            try:
+                with open(job.spec_path) as f:
+                    job.spec = json.load(f)
+                validate_spec(job.spec)
+            except (ValueError, OSError) as e:
+                job.state = "quarantined"
+                self.journal("quarantined", job=job.name, error=str(e))
+                return False
+        argv = list(job.spec["argv"]) + [
+            "-d", job.data_dir, "-set", "TPU_CKPT_DIR", job.ckpt_dir]
+        env = dict(self._base_env)
+        env.update(job.spec.get("env") or {})
+        try:
+            sup = Supervisor(argv,
+                             fault_plan=job.spec.get("fault_plan") or (),
+                             cfg=SupervisorConfig.from_env(env), env=env,
+                             spawn=self._spawn_factory(job),
+                             clock=self._clock, sleep=self._sleep)
+        except ValueError as e:
+            job.state = "quarantined"
+            self.journal("quarantined", job=job.name, error=str(e))
+            return False
+        if self.xla_fallback:
+            sup._xla_fallback = True    # fleet-wide degradation applies
+        job.sup = sup
+        job._fail_snapshot = dict(sup.failures)
+        job.state = "running"
+        sup.publish_metrics()
+        return True
+
+    def _make_spawn(self, job: Job):
+        def spawn(argv, env, logf):
+            # own session => pgid == pid: the whole child tree is
+            # reapable after an orchestrator crash, and a terminal ^C
+            # cannot fan out to every tenant
+            proc = subprocess.Popen(argv, env=env, stdout=logf,
+                                    stderr=logf, start_new_session=True)
+            job.pid = proc.pid
+            self.journal("spawn", job=job.name, pid=proc.pid,
+                         boot=job.sup.boots - 1 if job.sup else 0)
+            return proc
+        return spawn
+
+    # ---- operator markers (fleet_tool.py cancel/requeue) ----
+
+    def _consume_markers(self):
+        # act (journal) FIRST, remove the marker after: a crash in
+        # between re-consumes an already-journaled marker on restart (a
+        # no-op -- _cancel/_requeue are idempotent), whereas the other
+        # order would silently lose the operator's request
+        for fn in sorted(os.listdir(self.spool)):
+            if fn.endswith(".cancel"):
+                self._cancel(fn[:-len(".cancel")])
+                os.remove(os.path.join(self.spool, fn))
+            elif fn.endswith(".requeue"):
+                self._requeue(fn[:-len(".requeue")], reason="operator")
+                os.remove(os.path.join(self.spool, fn))
+
+    def _cancel(self, name: str):
+        job = self.jobs.get(name)
+        if job is None or job.state in ("done", "failed", "cancelled",
+                                        "quarantined"):
+            return
+        if job.state == "queued":
+            # park an unadmitted spec so a rescan cannot resurrect it
+            if os.path.exists(job.spool_spec_path):
+                os.replace(job.spool_spec_path,
+                           os.path.join(self.spool,
+                                        name + ".cancelled.json"))
+            job.state = "cancelled"
+            self.journal("cancelled", job=name)
+            return
+        # running: graceful stop; _poll_job records the terminal state
+        # once the child has written its preemption checkpoint
+        job.cancel_requested = True
+        job.sup.request_stop()
+        self.journal("cancel_requested", job=name)
+
+    def _requeue(self, name: str, reason: str):
+        job = self.jobs.get(name)
+        if job is None or job.state not in ("failed", "cancelled"):
+            return
+        parked = os.path.join(self.spool, name + ".cancelled.json")
+        if not os.path.exists(job.spec_path) and os.path.exists(parked):
+            os.replace(parked, job.spool_spec_path)
+        job.sup = None
+        job.spec = None
+        job.cancel_requested = False
+        job.state = "queued"
+        self.journal("requeued", job=name, reason=reason)
+
+    # ---- the poll loop ----
+
+    def _poll_job(self, job: Job, now: float):
+        try:
+            state = job.sup.poll()
+        except Exception as e:
+            # one job's supervisor blowing up must not sink the fleet;
+            # journaled as "failed" (not a bespoke event) so replay and
+            # the job tables agree it is terminal
+            job.state = "failed"
+            self.journal("failed", job=job.name, error=str(e))
+            return
+        self._note_failures(job, now)
+        if state not in ("done", "failed"):
+            return
+        if state == "failed":
+            job.state = "failed"
+            self.journal("failed", job=job.name,
+                         failures=dict(job.sup.failures))
+        elif job.sup.succeeded:
+            job.state = "done"
+            self.journal("done", job=job.name)
+        elif job.cancel_requested:
+            job.state = "cancelled"
+            self.journal("cancelled", job=job.name)
+        else:
+            # supervisor preempted (drain): incomplete but resumable
+            job.state = "queued"
+            job.sup = None
+            self.journal("requeued", job=job.name, reason="drain")
+
+    def _note_failures(self, job: Job, now: float):
+        """Diff the job supervisor's per-class failure counters into the
+        fleet aggregates + the circuit breaker."""
+        for cls, n in job.sup.failures.items():
+            delta = n - job._fail_snapshot.get(cls, 0)
+            if delta <= 0:
+                continue
+            job._fail_snapshot[cls] = n
+            self.failures[cls] = self.failures.get(cls, 0) + delta
+            for _ in range(delta):
+                if self.breaker.note_failure(cls, now):
+                    self._open_breaker(cls, job)
+
+    def _open_breaker(self, cls: str, job: Job):
+        self.journal("breaker_open", failure_class=cls,
+                     k=self.breaker.k,
+                     window_sec=self.breaker.window_sec, job=job.name)
+        out = job.sup.last_outcome
+        pallas_storm = (job.sup._xla_fallback
+                        or (out is not None and out.pallas))
+        if pallas_storm and not self.xla_fallback:
+            # a kernel-implicated crash storm: degrade the WHOLE fleet
+            # to the XLA path once, instead of letting every job burn a
+            # discovery crash on the same broken kernel
+            self.xla_fallback = True
+            self.journal("xla_fallback",
+                         detail="fleet-wide -set TPU_USE_PALLAS 2 "
+                                "(kernel-implicated crash storm)")
+            for j in self.jobs.values():
+                if j.sup is not None:
+                    j.sup._xla_fallback = True
+
+    def poll_once(self) -> bool:
+        """One orchestration step: scan, consume markers, admit, poll
+        every running job.  Returns True while any job is live."""
+        self._recover()
+        now = self._clock()
+        self._scan_spool()
+        self._consume_markers()
+        closed = self.breaker.maybe_close(now)
+        if closed is not None:
+            self.journal("breaker_close", failure_class=closed)
+        self._admit(now)
+        for job in [j for j in self.jobs.values()
+                    if j.state == "running"]:
+            self._poll_job(job, now)
+        self.publish_metrics()
+        return any(j.state in ("queued", "running")
+                   for j in self.jobs.values())
+
+    # ---- metrics / status ----
+
+    def publish_metrics(self):
+        counts = {s: 0 for s in JOB_STATES}
+        for j in self.jobs.values():
+            counts[j.state] = counts.get(j.state, 0) + 1
+        fams = [
+            ("avida_fleet_jobs", "gauge", "jobs by orchestration state",
+             {f'state="{s}"': n for s, n in sorted(counts.items())}),
+            ("avida_fleet_failures_total", "counter",
+             "classified child failures across all jobs",
+             {f'class="{c}"': n for c, n in self.failures.items()}),
+            ("avida_fleet_breaker_open", "gauge",
+             "1 while the crash-storm circuit breaker holds admissions",
+             int(self.breaker.open_class is not None)),
+            ("avida_fleet_breaker_trips_total", "counter",
+             "circuit breaker openings", self.breaker.trips),
+            ("avida_fleet_admissions_paused", "gauge",
+             "1 while admission control is refusing new jobs",
+             int(self.admissions_paused)),
+            ("avida_fleet_xla_fallback", "gauge",
+             "1 after the fleet-wide Pallas->XLA degradation",
+             int(self.xla_fallback)),
+            ("avida_fleet_max_jobs", "gauge",
+             "admission-control concurrency budget", self.cfg.max_jobs),
+            ("avida_fleet_heartbeat_timestamp_seconds", "gauge",
+             "unix time of the last orchestrator export",
+             round(time.time(), 3)),
+        ]
+        try:
+            write_metrics(self.metrics_path, render_families(fams),
+                          durable=False)
+        except OSError:
+            pass
+
+    # ---- lifecycle ----
+
+    def _acquire_lock(self):
+        """Two orchestrators draining one spool would double-spawn every
+        job -- refuse to start while a live one holds the lock.  The
+        acquire is an O_CREAT|O_EXCL create (atomic: two racers cannot
+        both win); a lock whose pid is dead, recycled by a non-fleet
+        process, or our own is stale and taken over."""
+        path = os.path.join(self.spool, LOCK_FILE)
+        for _attempt in range(2):
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                try:
+                    with open(path) as f:
+                        pid = int(f.read().strip() or 0)
+                except (OSError, ValueError):
+                    pid = 0
+                if pid and pid != os.getpid() \
+                        and self._pid_owns_spool(pid):
+                    raise FleetLockedError(
+                        f"orchestrator pid {pid} already owns "
+                        f"{self.spool!r} ({LOCK_FILE})")
+                try:
+                    os.remove(path)     # stale: take over, then re-race
+                except OSError:
+                    pass
+                continue
+            with os.fdopen(fd, "w") as f:
+                f.write(f"{os.getpid()}\n")
+            return
+        raise FleetLockedError(
+            f"could not acquire {LOCK_FILE} under {self.spool!r}")
+
+    def _pid_owns_spool(self, pid: int) -> bool:
+        """Is `pid` a live fleet orchestrator of THIS spool?  Resolves
+        the --fleet argument out of /proc/<pid>/cmdline (relative paths
+        against that process's own cwd) so a recycled pid running a
+        DIFFERENT spool's fleet does not wedge this one forever.
+        Conservative on ambiguity: an unresolvable --fleet argument
+        still counts as the owner -- wrongly stealing a live lock
+        (double orchestrator) is worse than wrongly refusing to start."""
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                args = [a.decode("utf-8", "replace")
+                        for a in f.read().split(b"\0") if a]
+        except OSError:
+            return False                # process gone: stale lock
+        if "--fleet" not in args:
+            return False                # pid recycled by something else
+        i = args.index("--fleet")
+        if i + 1 >= len(args):
+            return True
+        raw = args[i + 1]
+        try:
+            if not os.path.isabs(raw):
+                raw = os.path.join(os.readlink(f"/proc/{pid}/cwd"), raw)
+            return os.path.realpath(raw) == self.spool
+        except OSError:
+            return True
+
+    def _release_lock(self):
+        try:
+            os.remove(os.path.join(self.spool, LOCK_FILE))
+        except OSError:
+            pass
+
+    def request_stop(self):
+        self._stop = True
+
+    def _drain(self) -> int:
+        """Graceful shutdown: SIGTERM every child (they write preemption
+        checkpoints), wait up to drain_sec, requeue whatever did not
+        complete.  Exit 0 -- a drained fleet is a resumable fleet."""
+        running = [j for j in self.jobs.values() if j.state == "running"]
+        self.journal("drain", jobs_running=len(running),
+                     drain_sec=self.cfg.drain_sec)
+        for job in running:
+            job.sup.request_stop()
+        deadline = self._clock() + self.cfg.drain_sec
+        while self._clock() < deadline:
+            live = [j for j in self.jobs.values()
+                    if j.state == "running"]
+            if not live:
+                break
+            for job in live:
+                self._poll_job(job, self._clock())
+            self.publish_metrics()
+            self._sleep(min(self.cfg.poll_sec, 0.5))
+        for job in [j for j in self.jobs.values()
+                    if j.state == "running"]:
+            # drain deadline blown: hard-stop, then one last poll so
+            # the kill flows through the supervisor's _finish (child
+            # log closed, classified exit record written) and the job
+            # lands in the normal requeue path
+            proc = job.sup._proc
+            if proc is not None:
+                job.sup._kill_child(proc)
+            self._poll_job(job, self._clock())
+            if job.state == "running":          # supervisor stuck: force
+                job.state = "queued"
+                job.sup = None
+                self.journal("requeued", job=job.name,
+                             reason="drain_kill")
+        self.publish_metrics()
+        self.journal("fleet_stop", reason="drain")
+        return 0
+
+    def run(self) -> int:
+        """Orchestrate until the spool is drained (or forever with
+        cfg.serve).  Returns 0 when every known job ended well
+        (done/cancelled/requeued), 1 when any failed or was
+        quarantined, 2 when another orchestrator holds the lock."""
+        try:
+            self._acquire_lock()
+        except FleetLockedError as e:
+            print(f"[fleet] {e}", file=sys.stderr)
+            return 2
+        saved = {}
+
+        def on_signal(signum, frame):
+            self._stop = True
+
+        for s in (signal.SIGTERM, signal.SIGINT):
+            try:
+                saved[s] = signal.signal(s, on_signal)
+            except ValueError:
+                pass
+        self.journal("fleet_start", max_jobs=self.cfg.max_jobs,
+                     jobs_known=len(self.jobs))
+        try:
+            while True:
+                if self._stop:
+                    return self._drain()
+                active = self.poll_once()
+                if not active and not self.cfg.serve:
+                    break
+                self._sleep(self.cfg.poll_sec)
+            bad = [j.name for j in self.jobs.values()
+                   if j.state in ("failed", "quarantined")]
+            self.journal("fleet_stop", reason="spool drained",
+                         failed=sorted(bad))
+            return 1 if bad else 0
+        finally:
+            for s, h in saved.items():
+                try:
+                    signal.signal(s, h)
+                except (ValueError, OSError):
+                    pass
+            self.publish_metrics()
+            self._release_lock()
+
+
+# ---------------------------------------------------------------------------
+# --status fleet view (host-only, no orchestrator required)
+# ---------------------------------------------------------------------------
+
+def format_fleet_status(spool: str, now: float | None = None) -> str:
+    """Human-readable fleet summary: aggregate gauges from fleet.prom +
+    a per-job table reconstructed from the journal and the spool."""
+    now = time.time() if now is None else now
+    lines = []
+    metrics = {}
+    mpath = os.path.join(spool, FLEET_METRICS_FILE)
+    if os.path.exists(mpath):
+        metrics = read_metrics(mpath)
+        hb = metrics.get("avida_fleet_heartbeat_timestamp_seconds")
+        age = f"{now - hb:.1f}s ago" if hb else "unknown"
+        counts = {k.split('state="', 1)[1].rstrip('"}'): int(v)
+                  for k, v in metrics.items()
+                  if k.startswith("avida_fleet_jobs{")}
+        lines.append("fleet       "
+                     + ", ".join(f"{s} {n}" for s, n in
+                                 sorted(counts.items()) if n))
+        if metrics.get("avida_fleet_breaker_open"):
+            lines.append("breaker     OPEN (admissions paused)")
+        if metrics.get("avida_fleet_xla_fallback"):
+            lines.append("degraded    fleet-wide XLA fallback active")
+        lines.append(f"heartbeat   {age}")
+    state = spool_job_states(spool)
+    for name in sorted(state):
+        st = state[name]
+        extra = ""
+        sup_prom = os.path.join(spool, name, "data", "supervisor.prom")
+        if os.path.exists(sup_prom):
+            sup = read_metrics(sup_prom)
+            boots = int(sup.get("avida_supervisor_boots_total", 0))
+            fails = int(sum(v for k, v in sup.items()
+                            if k.startswith(
+                                "avida_supervisor_failures_total")))
+            extra = f"  (boots {boots}, failures {fails})"
+        lines.append(f"  {name:<24} {st}{extra}")
+    return "\n".join(lines) if lines else f"empty spool {spool!r}"
+
+
+def fleet_status_main(spool: str, max_age: float | None = None) -> int:
+    """The --status view for a spool dir: 0 = fleet metrics present
+    (and fresh when --max-age is given), 1 = no fleet.prom, 2 = stale
+    orchestrator heartbeat."""
+    mpath = os.path.join(spool, FLEET_METRICS_FILE)
+    if not os.path.exists(mpath):
+        # journal-only view (orchestrator never ran / metrics removed):
+        # still show the job table, but exit 1 so watchdogs see it
+        print(format_fleet_status(spool))
+        print(f"no {FLEET_METRICS_FILE} under {spool!r} (orchestrator "
+              f"not started?)")
+        return 1
+    print(format_fleet_status(spool))
+    if max_age is not None:
+        hb = read_metrics(mpath).get(
+            "avida_fleet_heartbeat_timestamp_seconds")
+        age = None if hb is None else time.time() - hb
+        if age is None or age > max_age:
+            shown = "missing" if age is None else f"{age:.1f}s"
+            print(f"STALE: orchestrator heartbeat {shown} exceeds "
+                  f"--max-age {max_age}s")
+            return 2
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# CLI entry (dispatched from avida_tpu/__main__.py before any jax import)
+# ---------------------------------------------------------------------------
+
+def fleet_main(argv: list) -> int:
+    argv = list(argv)
+    i = argv.index("--fleet")
+    if i + 1 >= len(argv) or argv[i + 1].startswith("--"):
+        print("--fleet needs a spool directory argument", file=sys.stderr)
+        return 2
+    spool = argv[i + 1]
+    del argv[i:i + 2]
+    cfg = FleetConfig.from_env(os.environ)
+    if "--max-jobs" in argv:
+        i = argv.index("--max-jobs")
+        if i + 1 >= len(argv) or not argv[i + 1].isdigit():
+            print("--max-jobs needs an integer argument", file=sys.stderr)
+            return 2
+        cfg.max_jobs = max(int(argv[i + 1]), 1)
+        del argv[i:i + 2]
+    if "--serve" in argv:
+        cfg.serve = True
+        argv.remove("--serve")
+    if argv:
+        print(f"unrecognized --fleet arguments: {argv}", file=sys.stderr)
+        return 2
+    return FleetOrchestrator(spool, cfg=cfg).run()

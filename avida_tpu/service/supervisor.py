@@ -113,7 +113,8 @@ class SupervisorConfig:
                  poll_sec: float | None = None, grace_sec: float = 900.0,
                  max_retries: int = 8, backoff_base: float = 1.0,
                  backoff_cap: float = 60.0, healthy_sec: float = 300.0,
-                 seed: int = 0, anomaly_watch: bool = True):
+                 seed: int = 0, anomaly_watch: bool = True,
+                 progress_sec: float = 0.0):
         self.watchdog_sec = float(watchdog_sec)
         self.poll_sec = (min(max(self.watchdog_sec / 8, 0.2), 5.0)
                          if poll_sec is None else float(poll_sec))
@@ -124,6 +125,11 @@ class SupervisorConfig:
         self.healthy_sec = float(healthy_sec)
         self.seed = int(seed)
         self.anomaly_watch = bool(anomaly_watch)
+        # livelock watchdog (default OFF): a child can wedge while still
+        # republishing its heartbeat file -- with progress_sec > 0 the
+        # watchdog also requires the avida_update counter to ADVANCE
+        # within this window once heartbeats have started
+        self.progress_sec = float(progress_sec)
 
     @classmethod
     def from_env(cls, env) -> "SupervisorConfig":
@@ -140,6 +146,7 @@ class SupervisorConfig:
             healthy_sec=f("TPU_SUPERVISE_HEALTHY_SEC", 300.0),
             seed=int(f("TPU_SUPERVISE_SEED", 0)),
             anomaly_watch=bool(int(f("TPU_SUPERVISE_ANOM", 1))),
+            progress_sec=f("TPU_PROGRESS_SEC", 0.0),
         )
 
 
@@ -170,6 +177,38 @@ class Outcome:
         self.pallas = pallas
         self.corrupt_seen = corrupt_seen
         self.update = update
+
+
+# postmortem context: failure-class exit records carry this much of the
+# child's log tail (bytes, utf-8) so the crash evidence survives log
+# truncation/rotation alongside the taxonomy class
+STDERR_TAIL_RECORD_BYTES = 2048
+
+
+class _Boot:
+    """Per-boot watch state for the non-blocking poll() machine: one
+    instance lives from _launch() to _finish()."""
+
+    __slots__ = ("proc", "logf", "log_start", "t0", "hb0",
+                 "watchdog_killed", "anomaly_killed", "anom0",
+                 "healthy_since", "term_deadline", "hb_max", "hb_fresh_t",
+                 "prog_val", "prog_t")
+
+    def __init__(self, proc, logf, log_start, t0, hb0):
+        self.proc = proc
+        self.logf = logf
+        self.log_start = log_start
+        self.t0 = t0
+        self.hb0 = hb0
+        self.watchdog_killed = False
+        self.anomaly_killed = False
+        self.anom0 = None
+        self.healthy_since = None
+        self.term_deadline = None       # set after a graceful SIGTERM
+        self.hb_max = None              # newest heartbeat timestamp seen
+        self.hb_fresh_t = None          # our clock at its last advance
+        self.prog_val = None            # last avida_update counter value
+        self.prog_t = None              # our clock at its last advance
 
 
 class Supervisor:
@@ -229,10 +268,26 @@ class Supervisor:
         self._proc = None
         self._stop = False
         self._corrupt_counted = set()   # generation paths already tallied
+        # ---- poll() state machine ----
+        # "idle" (next poll launches) -> "running" -> "backoff" -> ... ->
+        # "done" (exit_rc 0) | "failed" (exit_rc 1).  The blocking run()
+        # is a thin sleep-between-polls wrapper; a fleet orchestrator
+        # (service/fleet.py) multiplexes many supervisors by calling
+        # poll() round-robin instead.
+        self.state = "idle"
+        self.exit_rc = None
+        self.succeeded = False          # True only after a "done" record
+        self.last_outcome = None        # newest Outcome (fleet breaker)
+        self._ctx = None                # _Boot while state == "running"
+        self._backoff_until = 0.0
         self.runlog_path = os.path.join(self.data_dir, RUNLOG_FILE)
         self.metrics_path = os.path.join(self.data_dir,
                                          SUPERVISOR_METRICS_FILE)
         self.child_log_path = os.path.join(self.data_dir, "supervised.log")
+        # size-capped rotation (runlog.append_record): a long heal loop
+        # must not grow supervisor.jsonl without bound
+        self.runlog_max_bytes = int(
+            self._base_env.get("TPU_RUNLOG_MAX_BYTES", 16 << 20))
 
     # ---- plumbing ----
 
@@ -245,7 +300,8 @@ class Supervisor:
         rec = {"record": "supervisor", "event": event,
                "time": self._clock(), "boot": self.boots, **fields}
         try:
-            append_record(self.runlog_path, rec)
+            append_record(self.runlog_path, rec,
+                          max_bytes=self.runlog_max_bytes)
         except OSError:
             pass                        # logging must not kill recovery
         detail = " ".join(f"{k}={v}" for k, v in fields.items())
@@ -334,9 +390,9 @@ class Supervisor:
             pass
         return proc.wait()
 
-    # ---- one boot ----
+    # ---- one boot, decomposed for the poll() machine ----
 
-    def run_once(self) -> Outcome:
+    def _launch(self):
         boot = self.boots
         self.boots += 1
         fault = self.fault_plan[boot] if boot < len(self.fault_plan) else None
@@ -359,80 +415,123 @@ class Supervisor:
         # own export, so liveness only switches from the boot-grace
         # clock to the heartbeat clock once the timestamp ADVANCES
         hb0 = (self._read_heartbeat() or {}).get(_HEARTBEAT)
-        with open(self.child_log_path, "a") as logf:
+        logf = open(self.child_log_path, "a")
+        try:
             logf.write(f"--- supervisor boot {boot} ---\n")
             logf.flush()
             log_start = logf.tell()
             proc = self._spawn(argv, env, logf)
-            self._proc = proc
-            t0 = self._clock()
-            watchdog_killed = anomaly_killed = False
-            anom0 = None
-            healthy_since = None
-            while True:
-                rc = proc.poll()
-                if rc is not None:
-                    break
-                now = self._clock()
-                metrics = self._read_heartbeat()
-                hb = None if metrics is None else metrics.get(_HEARTBEAT)
-                if hb is None or (hb0 is not None and hb <= hb0):
-                    if now - t0 > self.cfg.grace_sec:
-                        self.record("watchdog_kill", reason="no heartbeat",
-                                    grace_sec=self.cfg.grace_sec)
-                        rc = self._kill_child(proc)
-                        watchdog_killed = True
-                        break
-                else:
-                    age = now - hb
-                    if age > self.cfg.watchdog_sec:
-                        self.record("watchdog_kill", reason="stale heartbeat",
-                                    age_sec=round(age, 3),
-                                    watchdog_sec=self.cfg.watchdog_sec)
-                        rc = self._kill_child(proc)
-                        watchdog_killed = True
-                        break
-                    if self.cfg.anomaly_watch:
-                        anom = _anomaly_total(metrics)
-                        if anom0 is None:
-                            anom0 = anom
-                        elif anom > anom0:
-                            # flight-recorder anomaly onset: stop the run
-                            # GRACEFULLY (SIGTERM -> final checkpoint) and
-                            # roll back -- by the time a NaN shows up in
-                            # the trace it is already in the state
-                            self.record("anomaly_detected",
-                                        anomalies=anom - anom0)
-                            try:
-                                proc.terminate()
-                            except OSError:
-                                pass
-                            try:
-                                rc = proc.wait(timeout=max(
-                                    self.cfg.watchdog_sec, 30))
-                            except subprocess.TimeoutExpired:
-                                rc = self._kill_child(proc)
-                            anomaly_killed = True
-                            break
-                    if healthy_since is None:
-                        healthy_since = now
-                    elif self.policy.note_healthy(now - healthy_since):
-                        self.record("budget_reset",
-                                    healthy_sec=round(now - healthy_since, 1))
-                        healthy_since = now
-                self._sleep(self.cfg.poll_sec)
-            if rc is None:
-                rc = proc.wait()
+        except BaseException:
+            logf.close()
+            raise
+        self._proc = proc
+        self._ctx = _Boot(proc, logf, log_start, self._clock(), hb0)
+        self.state = "running"
+
+    def _watch(self):
+        """One non-blocking watch step: poll the child, enforce the
+        liveness/anomaly policies.  Returns the exit code once the boot
+        is over (child exited or was killed), None while it runs."""
+        ctx = self._ctx
+        proc = ctx.proc
+        rc = proc.poll()
+        if rc is not None:
+            return rc
+        now = self._clock()
+        if ctx.term_deadline is not None:
+            # graceful anomaly stop in flight: the child got SIGTERM and
+            # is writing its final checkpoint -- only the kill deadline
+            # still applies
+            if now > ctx.term_deadline:
+                return self._kill_child(proc)
+            return None
+        metrics = self._read_heartbeat()
+        hb = None if metrics is None else metrics.get(_HEARTBEAT)
+        if hb is None or (ctx.hb0 is not None and hb <= ctx.hb0):
+            if now - ctx.t0 > self.cfg.grace_sec:
+                self.record("watchdog_kill", reason="no heartbeat",
+                            grace_sec=self.cfg.grace_sec)
+                ctx.watchdog_killed = True
+                return self._kill_child(proc)
+            return None
+        if ctx.hb_max is not None and hb < ctx.hb_max:
+            # the heartbeat timestamp moved BACKWARDS (a stepped host
+            # clock): that is never evidence of freshness -- measure
+            # staleness from OUR clock at the last true advance
+            if now - ctx.hb_fresh_t > self.cfg.watchdog_sec:
+                self.record("watchdog_kill",
+                            reason="heartbeat moved backwards",
+                            last_advance_sec=round(now - ctx.hb_fresh_t, 3),
+                            watchdog_sec=self.cfg.watchdog_sec)
+                ctx.watchdog_killed = True
+                return self._kill_child(proc)
+            return None
+        if ctx.hb_max is None or hb > ctx.hb_max:
+            ctx.hb_max = hb
+            ctx.hb_fresh_t = now
+        age = now - hb
+        if age > self.cfg.watchdog_sec:
+            self.record("watchdog_kill", reason="stale heartbeat",
+                        age_sec=round(age, 3),
+                        watchdog_sec=self.cfg.watchdog_sec)
+            ctx.watchdog_killed = True
+            return self._kill_child(proc)
+        if self.cfg.progress_sec > 0:
+            # livelock watchdog: fresh heartbeats whose update counter
+            # never advances are a wedged scheduler, not a live run
+            upd = metrics.get("avida_update")
+            if ctx.prog_val is None or (upd is not None
+                                        and upd > ctx.prog_val):
+                ctx.prog_val = upd
+                ctx.prog_t = now
+            elif now - ctx.prog_t > self.cfg.progress_sec:
+                self.record("watchdog_kill", reason="no progress",
+                            update=ctx.prog_val,
+                            progress_sec=self.cfg.progress_sec)
+                ctx.watchdog_killed = True
+                return self._kill_child(proc)
+        if self.cfg.anomaly_watch:
+            anom = _anomaly_total(metrics)
+            if ctx.anom0 is None:
+                ctx.anom0 = anom
+            elif anom > ctx.anom0:
+                # flight-recorder anomaly onset: stop the run
+                # GRACEFULLY (SIGTERM -> final checkpoint) and
+                # roll back -- by the time a NaN shows up in
+                # the trace it is already in the state
+                self.record("anomaly_detected", anomalies=anom - ctx.anom0)
+                try:
+                    proc.terminate()
+                except OSError:
+                    pass
+                ctx.anomaly_killed = True
+                ctx.term_deadline = now + max(self.cfg.watchdog_sec, 30)
+                return proc.poll()
+        if ctx.healthy_since is None:
+            ctx.healthy_since = now
+        elif self.policy.note_healthy(now - ctx.healthy_since):
+            self.record("budget_reset",
+                        healthy_sec=round(now - ctx.healthy_since, 1))
+            ctx.healthy_since = now
+        return None
+
+    def _finish(self, rc) -> Outcome:
+        ctx, self._ctx = self._ctx, None
+        try:
+            ctx.logf.close()
+        except OSError:
+            pass
         self._proc = None
         self.last_exit_code = rc
 
-        tail = self._stderr_tail(start=log_start)
+        tail = self._stderr_tail(start=ctx.log_start)
         metrics = self._read_heartbeat() or {}
         preempted = bool(metrics.get("avida_preempted", 0)) \
             or "] preempted at update" in tail
-        cls = classify(rc, watchdog_killed=watchdog_killed,
-                       anomaly_killed=anomaly_killed, preempted=preempted)
-        if watchdog_killed:
+        cls = classify(rc, watchdog_killed=ctx.watchdog_killed,
+                       anomaly_killed=ctx.anomaly_killed,
+                       preempted=preempted)
+        if ctx.watchdog_killed:
             self.watchdog_kills += 1
         # CRC/manifest fallbacks the child logged at resume time: count
         # each corrupt GENERATION once, not once per boot -- the corrupt
@@ -456,10 +555,119 @@ class Supervisor:
         if cls in self.failures and not (cls == "corrupt_ckpt"
                                          and out.corrupt_seen):
             self.failures[cls] += 1
-        self.record("exit", **{"class": cls, "code": rc,
-                               "update": out.update,
-                               "pallas_suspect": out.pallas})
+        exit_fields = {"class": cls, "code": rc, "update": out.update,
+                       "pallas_suspect": out.pallas}
+        if cls in FAILURE_CLASSES and cls != "preempt":
+            # postmortem context rides the taxonomy record: the tail end
+            # of this boot's log (bounded, so a heal loop cannot bloat
+            # the runlog), where the death traceback lands
+            exit_fields["stderr_tail"] = tail.encode(
+                "utf-8", "replace")[-STDERR_TAIL_RECORD_BYTES:].decode(
+                "utf-8", "replace")
+        self.record("exit", **exit_fields)
+        self.last_outcome = out
         return out
+
+    def _dispatch(self, out: Outcome):
+        """Recovery policy: decide the next state from one boot's
+        outcome.  Exactly the decision ladder the blocking loop ran --
+        relaunch-now paths (preempt, the one free Pallas->XLA
+        degradation) launch inline so run() behavior is unchanged."""
+        if out.cls == "success":
+            self.record("done", update=out.update)
+            self.succeeded = True
+            self._terminal("done", 0)
+            return
+        if self._stop:
+            # our own SIGTERM, forwarded: the child saved its
+            # preemption checkpoint; leave cleanly so the next
+            # supervisor invocation resumes bit-exactly
+            self.record("supervisor_preempted", update=out.update)
+            self._terminal("done", 0)
+            return
+        if out.cls == "preempt":
+            self.restarts += 1
+            self.record("restart", reason="preempt")
+            self._launch()
+            return
+        if out.cls == "audit_violation":
+            self._rollback()
+        if out.pallas and not self._xla_fallback:
+            # graceful degradation: one free retry on the XLA
+            # path with a LOUD warning -- slower, but alive
+            self._xla_fallback = True
+            self.pallas_fallbacks += 1
+            self.restarts += 1
+            self.record(
+                "pallas_fallback",
+                detail="kernel-path failure: retrying on the XLA "
+                       "path (-set TPU_USE_PALLAS 2); expect "
+                       "reduced throughput")
+            self._launch()
+            return
+        if not self.policy.can_retry():
+            self.record("giving_up", failures=dict(self.failures),
+                        max_retries=self.cfg.max_retries)
+            self._terminal("failed", 1)
+            return
+        delay = self.policy.next_delay()
+        self.restarts += 1
+        self.record("backoff", delay_sec=round(delay, 3),
+                    budget_left=self.policy.budget_left())
+        self._backoff_until = self._clock() + delay
+        self.state = "backoff"
+
+    def _terminal(self, state: str, rc: int):
+        self.state = state
+        self.exit_rc = rc
+
+    # ---- the non-blocking interface (one supervisor among many) ----
+
+    def poll(self) -> str:
+        """Advance the supervision state machine one non-blocking step
+        and return the current state ("idle"/"running"/"backoff" are
+        live, "done"/"failed" terminal with the exit code in
+        `exit_rc`).  Never sleeps: callers own the pacing -- run()
+        sleeps poll_sec between steps, a fleet orchestrator
+        (service/fleet.py) round-robins many supervisors through one
+        loop."""
+        if self.state in ("done", "failed"):
+            return self.state
+        if self.state == "idle":
+            if self._stop:
+                # preempted before the first boot: exit NOW -- launching
+                # a boot would outlive the cluster's grace window
+                self.record("supervisor_preempted")
+                self._terminal("done", 0)
+            else:
+                self._launch()
+            return self.state
+        if self.state == "backoff":
+            if self._stop:
+                # preempted while no child was alive (mid-backoff)
+                self.record("supervisor_preempted")
+                self._terminal("done", 0)
+            elif self._clock() >= self._backoff_until:
+                self._launch()
+            return self.state
+        rc = self._watch()
+        if rc is None:
+            return self.state
+        self._dispatch(self._finish(rc))
+        return self.state
+
+    def request_stop(self):
+        """Graceful drain (the fleet's SIGTERM forwarding): exactly what
+        the supervisor's own signal handler does -- flag the stop and
+        SIGTERM the live child so it writes a preemption checkpoint."""
+        import signal as _signal
+        self._stop = True
+        proc = self._proc
+        if proc is not None:
+            try:
+                proc.send_signal(_signal.SIGTERM)
+            except OSError:
+                pass
 
     # ---- recovery policies ----
 
@@ -495,13 +703,7 @@ class Supervisor:
         saved = {}
 
         def forward(signum, frame):
-            self._stop = True
-            proc = self._proc
-            if proc is not None:
-                try:
-                    proc.send_signal(_signal.SIGTERM)
-                except OSError:
-                    pass
+            self.request_stop()
 
         for s in (_signal.SIGTERM, _signal.SIGINT):
             try:
@@ -511,63 +713,28 @@ class Supervisor:
         return saved
 
     def run(self) -> int:
-        """Supervise to completion.  Returns 0 on run success (or when
-        the supervisor itself was preempted after a clean child
-        checkpoint), 1 when the retry budget is exhausted."""
+        """Supervise to completion (the blocking `--supervise` entry, a
+        thin sleep-between-polls wrapper over the poll() machine).
+        Returns 0 on run success (or when the supervisor itself was
+        preempted after a clean child checkpoint), 1 when the retry
+        budget is exhausted."""
         import signal as _signal
         saved = self._install_signal_forwarding()
         self.publish_metrics()
         try:
             while True:
-                if self._stop:
-                    # preempted while no child was alive (mid-backoff or
-                    # between boots): exit NOW -- launching another boot
-                    # would outlive the cluster's grace window
-                    self.record("supervisor_preempted")
-                    return 0
-                out = self.run_once()
-                if out.cls == "success":
-                    self.record("done", update=out.update)
-                    return 0
-                if self._stop:
-                    # our own SIGTERM, forwarded: the child saved its
-                    # preemption checkpoint; leave cleanly so the next
-                    # supervisor invocation resumes bit-exactly
-                    self.record("supervisor_preempted", update=out.update)
-                    return 0
-                if out.cls == "preempt":
-                    self.restarts += 1
-                    self.record("restart", reason="preempt")
-                    continue
-                if out.cls == "audit_violation":
-                    self._rollback()
-                if out.pallas and not self._xla_fallback:
-                    # graceful degradation: one free retry on the XLA
-                    # path with a LOUD warning -- slower, but alive
-                    self._xla_fallback = True
-                    self.pallas_fallbacks += 1
-                    self.restarts += 1
-                    self.record(
-                        "pallas_fallback",
-                        detail="kernel-path failure: retrying on the XLA "
-                               "path (-set TPU_USE_PALLAS 2); expect "
-                               "reduced throughput")
-                    continue
-                if not self.policy.can_retry():
-                    self.record("giving_up", failures=dict(self.failures),
-                                max_retries=self.cfg.max_retries)
-                    return 1
-                delay = self.policy.next_delay()
-                self.restarts += 1
-                self.record("backoff", delay_sec=round(delay, 3),
-                            budget_left=self.policy.budget_left())
-                # chunked so a SIGTERM mid-backoff is honored within a
-                # second, not after the full (up to backoff_cap) sleep
-                remaining = delay
-                while remaining > 0 and not self._stop:
-                    step = min(remaining, 0.5)
-                    self._sleep(step)
-                    remaining -= step
+                state = self.poll()
+                if state in ("done", "failed"):
+                    return self.exit_rc
+                if state == "running":
+                    self._sleep(self.cfg.poll_sec)
+                elif state == "backoff":
+                    # chunked so a SIGTERM mid-backoff is honored within
+                    # a second, not after the full (up to backoff_cap)
+                    # sleep
+                    remaining = self._backoff_until - self._clock()
+                    if remaining > 0 and not self._stop:
+                        self._sleep(min(remaining, 0.5))
         finally:
             for s, h in saved.items():
                 try:

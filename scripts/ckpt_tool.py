@@ -7,6 +7,10 @@ Usage:
     python scripts/ckpt_tool.py <ckpt_dir> --prune [--keep N]
                                                       # sweep strays +
                                                       # retention overflow
+    python scripts/ckpt_tool.py --prune --all SPOOL [--keep N]
+                                                      # one pass over every
+                                                      # checkpoint dir under
+                                                      # a fleet spool
 
 List mode shows, per generation: update number, save time, array count,
 total bytes and a cheap manifest-presence status.  --verify re-reads
@@ -27,12 +31,18 @@ generation beyond the retention window (--keep N, default TPU_CKPT_KEEP
 or 2).  The newest VERIFYING generation is never removed, even when
 newer-but-corrupt generations fill the keep window.  Prints every path
 it removes; exit 0.
+
+--prune --all walks a whole tree (a fleet spool: SPOOL/<job>/ck per
+job, service/fleet.py) and runs the same sweep on every directory that
+looks like a checkpoint dir -- one janitor pass for an entire sweep's
+debris instead of one invocation per job.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
 import shutil
 import sys
 import time
@@ -110,6 +120,32 @@ def prune(base: str, keep: int) -> list:
     return removed
 
 
+_GEN_ENTRY_RE = re.compile(r"^(\.(tmp|bad|old)-)?ckpt-\d{12}")
+
+
+def _is_ckpt_entry(name: str) -> bool:
+    """A published generation (`ckpt-<12 digits>`) or its publish/
+    quarantine debris.  Deliberately strict about the digit format: a
+    fleet job DIRECTORY merely named `ckpt-something` must not make its
+    parent look like a checkpoint dir (prune would rmtree whole fault
+    domains as 'retention overflow')."""
+    return _GEN_ENTRY_RE.match(name) is not None
+
+
+def prune_all(base: str, keep: int) -> dict:
+    """Walk `base` and prune every directory that looks like a
+    checkpoint dir (published generations or stranded
+    `.tmp-*`/`.bad-*`/`.old-*` debris in the engine's naming).  The
+    one-pass janitor for a fleet spool, where every job keeps its own
+    `<job>/ck`.  Returns {ckpt_dir: removed_paths}."""
+    swept = {}
+    for root, dirs, _files in os.walk(base):
+        if any(_is_ckpt_entry(d) for d in dirs):
+            swept[root] = prune(root, keep)
+            dirs[:] = []        # generations hold only files: done here
+    return swept
+
+
 def main(argv=None) -> int:
     _repo_path()
     from avida_tpu.utils.checkpoint import MANIFEST, list_generations
@@ -123,6 +159,9 @@ def main(argv=None) -> int:
     do_verify = "--verify" in argv
     do_manifest = "--manifest" in argv
 
+    if "--all" in argv and "--prune" not in argv:
+        print("--all only applies to --prune")
+        return 2
     if "--prune" in argv:
         if "--keep" in argv:
             i = argv.index("--keep")
@@ -137,6 +176,19 @@ def main(argv=None) -> int:
             print(__doc__)
             return 1
         base = args[0]
+        if "--all" in argv:
+            swept = prune_all(base, keep)
+            total = 0
+            for ckdir in sorted(swept):
+                for p in swept[ckdir]:
+                    print(f"pruned {p}")
+                total += len(swept[ckdir])
+                print(f"{ckdir}: {len(swept[ckdir])} path(s) removed, "
+                      f"{len(list_generations(ckdir))} generation(s) "
+                      f"kept")
+            print(f"{total} path(s) removed across "
+                  f"{len(swept)} checkpoint dir(s)")
+            return 0
         removed = prune(base, keep)
         for p in removed:
             print(f"pruned {p}")

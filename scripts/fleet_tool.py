@@ -1,0 +1,143 @@
+"""Fleet spool CLI: submit / list / cancel / requeue jobs (service/fleet.py).
+
+Usage:
+    python scripts/fleet_tool.py submit SPOOL NAME [--fault-plan S/S...]
+            [--env K=V]... -- CHILD_ARGV...
+    python scripts/fleet_tool.py list SPOOL
+    python scripts/fleet_tool.py cancel SPOOL NAME
+    python scripts/fleet_tool.py requeue SPOOL NAME
+
+`submit` writes `SPOOL/NAME.json` atomically (tmp + rename), so a live
+orchestrator can never pick up a half-written spec.  Everything after
+`--` is the child run's command line exactly as `--supervise` takes it,
+MINUS `-d`/`-set TPU_CKPT_DIR` (the fleet assigns the job's fault
+domain itself).  `cancel`/`requeue` drop marker files the orchestrator
+consumes on its next poll -- they work while it runs; a `requeue` of a
+failed job left over from a dead orchestrator is honored by the next
+one's startup scan.
+
+`list` needs no orchestrator at all: it reconstructs job states from
+the fleet journal plus the spool contents, so it answers "what happened
+to my sweep?" after everything has exited.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def _repo_path():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+
+
+def submit(spool: str, name: str, argv: list, fault_plan=(),
+           env=None) -> str:
+    """Write one job spec atomically; returns its path.  Validates with
+    the orchestrator's own schema check so a typo is caught here, not
+    quarantined later."""
+    _repo_path()
+    from avida_tpu.service.fleet import legal_name, validate_spec
+    if not legal_name(name):
+        raise ValueError(f"illegal job name {name!r}")
+    spec = {"argv": list(argv)}
+    if fault_plan:
+        spec["fault_plan"] = list(fault_plan)
+    if env:
+        spec["env"] = dict(env)
+    validate_spec(spec)
+    os.makedirs(spool, exist_ok=True)
+    path = os.path.join(spool, name + ".json")
+    if os.path.exists(path) or os.path.isdir(os.path.join(spool, name)):
+        raise ValueError(f"job {name!r} already exists in {spool!r}")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(spec, f, indent=1)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def list_jobs(spool: str) -> list:
+    """(name, state) pairs from the journal + spool scan (the same
+    merge the --status fleet view renders)."""
+    _repo_path()
+    from avida_tpu.service.fleet import spool_job_states
+    return sorted(spool_job_states(spool).items())
+
+
+def _marker(spool: str, name: str, kind: str) -> str:
+    path = os.path.join(spool, f"{name}.{kind}")
+    with open(path, "w"):
+        pass
+    return path
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if len(argv) < 2:
+        print(__doc__)
+        return 2
+    cmd, spool = argv[0], argv[1]
+    rest = argv[2:]
+    if cmd == "submit":
+        if not rest or "--" not in rest or rest[0].startswith("-"):
+            print("submit needs: SPOOL NAME [flags] -- CHILD_ARGV...")
+            return 2
+        name = rest[0]
+        sep = rest.index("--")
+        flags, child = rest[1:sep], rest[sep + 1:]
+        fault_plan, env = (), {}
+        i = 0
+        while i < len(flags):
+            if flags[i] == "--fault-plan" and i + 1 < len(flags):
+                fault_plan = tuple(flags[i + 1].split("/"))
+                i += 2
+            elif flags[i] == "--env" and i + 1 < len(flags) \
+                    and "=" in flags[i + 1]:
+                k, _, v = flags[i + 1].partition("=")
+                env[k] = v
+                i += 2
+            else:
+                print(f"unknown submit flag {flags[i]!r}")
+                return 2
+        try:
+            path = submit(spool, name, child, fault_plan=fault_plan,
+                          env=env)
+        except ValueError as e:
+            print(f"submit rejected: {e}")
+            return 2
+        print(f"submitted {path}")
+        return 0
+    if cmd == "list":
+        jobs = list_jobs(spool)
+        if not jobs:
+            print(f"no jobs in {spool!r}")
+            return 0
+        for name, state in jobs:
+            print(f"{name:<24} {state}")
+        return 0
+    if cmd in ("cancel", "requeue"):
+        if not rest:
+            print(f"{cmd} needs: SPOOL NAME")
+            return 2
+        name = rest[0]
+        known = dict(list_jobs(spool))
+        if name not in known:
+            print(f"no such job {name!r} in {spool!r}")
+            return 2
+        path = _marker(spool, name, cmd)
+        print(f"{cmd} marker written: {path} (consumed by the "
+              f"orchestrator's next poll)")
+        return 0
+    print(__doc__)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

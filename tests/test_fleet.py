@@ -1,0 +1,697 @@
+"""Fleet orchestrator tier (service/fleet.py + scripts/fleet_tool.py).
+
+Tier-1 here is host-only: fake clock, fake sleeps, SCRIPTED stub
+children injected through the Supervisor's spawn seam -- no jax, no
+real subprocesses, so nothing compiles a world in-budget (the 1-core
+host rule).  The end-to-end chaos proof with REAL children -- three
+concurrent faulted jobs plus a SIGKILL of the orchestrator itself,
+each job bit-exact versus its uninterrupted reference -- is the slow
+test at the bottom.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import test_supervisor as ts
+from avida_tpu.observability.exporter import read_metrics
+from avida_tpu.observability.runlog import append_record, read_records
+from avida_tpu.service.fleet import (JOURNAL_FILE, CircuitBreaker,
+                                     FleetConfig, FleetOrchestrator,
+                                     fleet_status_main, journal_states,
+                                     validate_spec)
+from avida_tpu.utils import checkpoint as ckpt_mod
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+import fleet_tool  # noqa: E402
+
+# every job supervisor in the fake-time tests runs with tight knobs so
+# crash loops resolve in a handful of fake seconds
+SUP_ENV = {"TPU_WATCHDOG_SEC": "10", "TPU_SUPERVISE_POLL_SEC": "0.5",
+           "TPU_SUPERVISE_GRACE_SEC": "30",
+           "TPU_SUPERVISE_MAX_RETRIES": "3",
+           "TPU_SUPERVISE_BACKOFF_BASE": "0.1",
+           "TPU_SUPERVISE_BACKOFF_CAP": "0.5",
+           "TPU_SUPERVISE_HEALTHY_SEC": "1000000000"}
+
+
+def _cfg(**kw):
+    base = dict(max_jobs=2, poll_sec=0.5, breaker_k=3, breaker_sec=60.0,
+                drain_sec=30.0)
+    base.update(kw)
+    return FleetConfig(**base)
+
+
+class StubChildren:
+    """Per-job scripted children: job name -> list of FakeProc
+    factories, popped one per boot.  Tracks spawn order and the
+    concurrency high-water mark (the admission-control proof)."""
+
+    def __init__(self, clock, scripts):
+        self.clock = clock
+        self.scripts = {k: list(v) for k, v in scripts.items()}
+        self.spawned = []               # (job_name, proc, argv)
+        self.max_concurrent = 0
+
+    def factory(self, job):
+        def spawn(argv, env, logf):
+            proc = self.scripts[job.name].pop(0)()
+            proc._spawned(argv, env, logf)
+            if "-d" in argv:
+                proc._data = argv[argv.index("-d") + 1]
+            live = 1 + sum(1 for _, p, _ in self.spawned
+                           if p.returncode is None)
+            self.max_concurrent = max(self.max_concurrent, live)
+            self.spawned.append((job.name, proc, argv))
+            return proc
+        return spawn
+
+
+class PreemptibleProc(ts.FakeProc):
+    """A stub child that honors SIGTERM the way a real run does: write
+    the preemption heartbeat, then exit 0."""
+
+    def terminate(self):
+        if self.returncode is None:
+            ts._write_metrics(self._data, hb=self.clock(), preempted=1)
+            self.returncode = 0
+
+
+def _mk_fleet(tmp_path, clock, scripts, **cfg_kw):
+    spool = str(tmp_path / "spool")
+    stubs = StubChildren(clock, scripts)
+    fleet = FleetOrchestrator(spool, cfg=_cfg(**cfg_kw), env=dict(SUP_ENV),
+                              clock=clock, sleep=clock.sleep,
+                              spawn_factory=stubs.factory)
+    return fleet, spool, stubs
+
+
+def _events(spool):
+    recs = [r for r in read_records(os.path.join(spool, JOURNAL_FILE))
+            if r.get("record") == "fleet"]
+    return [(r["event"], r.get("job")) for r in recs], recs
+
+
+# ---------------------------------------------------------------------------
+# spec validation + quarantine
+# ---------------------------------------------------------------------------
+
+def test_validate_spec_rejects_garbage():
+    validate_spec({"argv": ["-u", "1"]})
+    validate_spec({"argv": ["-u", "1"], "fault_plan": ["crash"],
+                   "env": {"A": "1"}})
+    for bad in ([], {"argv": []}, {"argv": "nope"}, {"argv": [1, 2]},
+                {"x": 1}, {"argv": ["-u"], "fault_plan": "crash"},
+                {"argv": ["-u"], "env": {"A": 1}}):
+        with pytest.raises(ValueError):
+            validate_spec(bad)
+
+
+def test_fleet_quarantines_malformed_specs_once(tmp_path):
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool)
+    with open(os.path.join(spool, "broken.json"), "w") as f:
+        f.write("{this is not json")
+    with open(os.path.join(spool, "noargv.json"), "w") as f:
+        json.dump({"x": 1}, f)
+    fleet_tool.submit(spool, "good", ["-u", "5"])
+    fleet, spool, stubs = _mk_fleet(
+        tmp_path, clk,
+        {"good": [lambda: ts.FakeProc(clk, code=0, runtime=1.0)]})
+    assert fleet.run() == 1                 # quarantines poison the exit
+    states = {n: j.state for n, j in fleet.jobs.items()}
+    assert states == {"broken": "quarantined", "noargv": "quarantined",
+                      "good": "done"}
+    # moved aside, not retried forever: exactly one quarantine each
+    bad = [f for f in os.listdir(spool) if f.startswith(".bad-")]
+    assert len(bad) == 2
+    events, _ = _events(spool)
+    assert events.count(("quarantined", "broken")) == 1
+    assert events.count(("quarantined", "noargv")) == 1
+    m = read_metrics(os.path.join(spool, "fleet.prom"))
+    assert m['avida_fleet_jobs{state="quarantined"}'] == 2
+    assert m['avida_fleet_jobs{state="done"}'] == 1
+
+
+def test_fleet_tool_submit_validates(tmp_path):
+    spool = str(tmp_path / "spool")
+    # the orchestrator's own namespace is reserved: a job named
+    # fleet.prom / fleet.jsonl / fleet.lock would wedge the spool
+    for bad in ("bad name", "fleet", "fleet.prom", "fleet.jsonl",
+                "fleet.lock", ".hidden"):
+        with pytest.raises(ValueError, match="illegal job name"):
+            fleet_tool.submit(spool, bad, ["-u", "1"])
+    fleet_tool.submit(spool, "ok", ["-u", "1"])
+    with pytest.raises(ValueError, match="already exists"):
+        fleet_tool.submit(spool, "ok", ["-u", "1"])
+
+
+def test_fleet_reserved_name_spec_is_quarantined_not_fatal(tmp_path):
+    """A hand-written fleet.prom.json spec (bypassing fleet_tool) must
+    be quarantined at scan, never admitted over the orchestrator's own
+    files."""
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool)
+    with open(os.path.join(spool, "fleet.prom.json"), "w") as f:
+        json.dump({"argv": ["-u", "1"]}, f)
+    fleet, spool, stubs = _mk_fleet(tmp_path, clk, {})
+    assert fleet.run() == 1
+    assert fleet.jobs["fleet.prom"].state == "quarantined"
+    assert not stubs.spawned
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+def test_fleet_runs_spool_to_completion_within_budget(tmp_path):
+    clk = ts.FakeClock()
+    names = ("j1", "j2", "j3", "j4")
+    spool = str(tmp_path / "spool")
+    for n in names:
+        fleet_tool.submit(spool, n, ["-u", "10"])
+    fleet, spool, stubs = _mk_fleet(
+        tmp_path, clk,
+        {n: [lambda: ts.FakeProc(clk, code=0, runtime=3.0)]
+         for n in names},
+        max_jobs=2)
+    assert fleet.run() == 0
+    assert all(j.state == "done" for j in fleet.jobs.values())
+    assert len(stubs.spawned) == 4
+    # the admission-control core claim: never more than max_jobs live
+    assert stubs.max_concurrent == 2
+    state, _, _ = journal_states(os.path.join(spool, JOURNAL_FILE))
+    assert state == {n: "done" for n in names}
+    m = read_metrics(os.path.join(spool, "fleet.prom"))
+    assert m['avida_fleet_jobs{state="done"}'] == 4
+    assert m["avida_fleet_max_jobs"] == 2
+    # every child got its own fault domain + the supervisor essentials
+    for name, _, argv in stubs.spawned:
+        i = argv.index("-d")
+        assert argv[i + 1] == os.path.join(spool, name, "data")
+        assert "TPU_CKPT_DIR" in argv and "--resume" in argv
+
+
+# ---------------------------------------------------------------------------
+# journal replay: a killed orchestrator resumes without double-spawning
+# ---------------------------------------------------------------------------
+
+def test_fleet_replay_resumes_jobs_without_double_spawn(tmp_path):
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    for n in ("j1", "j2"):
+        fleet_tool.submit(spool, n, ["-u", "10"])
+    # orchestrator 1: children run forever; abandon it mid-flight (the
+    # in-process equivalent of SIGKILL -- no drain, no cleanup)
+    f1, spool, stubs1 = _mk_fleet(
+        tmp_path, clk,
+        {n: [lambda: ts.FakeProc(clk, runtime=None)]
+         for n in ("j1", "j2")})
+    for _ in range(3):
+        f1.poll_once()
+    assert all(j.state == "running" for j in f1.jobs.values())
+    assert not os.path.exists(os.path.join(spool, "j1.json"))
+    # orchestrator 2 replays the journal: both jobs queued for resume,
+    # each spawned exactly ONCE more, no re-admission records
+    stubs2 = StubChildren(clk, {n: [lambda: ts.FakeProc(clk, code=0,
+                                                        runtime=1.0)]
+                                for n in ("j1", "j2")})
+    f2 = FleetOrchestrator(spool, cfg=_cfg(), env=dict(SUP_ENV),
+                           clock=clk, sleep=clk.sleep,
+                           spawn_factory=stubs2.factory)
+    assert {n: j.state for n, j in f2.jobs.items()} == \
+        {"j1": "queued", "j2": "queued"}
+    assert f2.run() == 0
+    assert len(stubs2.spawned) == 2
+    events, _ = _events(spool)
+    assert [e for e, _ in events].count("admit") == 2       # from f1 only
+    assert events.count(("replay_resume", "j1")) == 1
+    assert {n: j.state for n, j in f2.jobs.items()} == \
+        {"j1": "done", "j2": "done"}
+
+
+def test_fleet_replay_completes_half_done_admission(tmp_path):
+    """Crash window between the (fsync'd) admit record and the spec
+    move: replay must complete the move, and the job must not be
+    spawned twice."""
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    fleet_tool.submit(spool, "j1", ["-u", "10"])
+    append_record(os.path.join(spool, JOURNAL_FILE),
+                  {"record": "fleet", "event": "admit", "job": "j1",
+                   "time": 0.0})
+    fleet, spool, stubs = _mk_fleet(
+        tmp_path, clk,
+        {"j1": [lambda: ts.FakeProc(clk, code=0, runtime=1.0)]})
+    assert fleet.jobs["j1"].state == "queued"
+    assert fleet.run() == 0
+    # recovery (behind the lock) completed the half-done spec move
+    assert os.path.exists(os.path.join(spool, "j1", "job.json"))
+    assert not os.path.exists(os.path.join(spool, "j1.json"))
+    assert len(stubs.spawned) == 1
+    events, _ = _events(spool)
+    assert [e for e, _ in events].count("admit") == 1       # no re-admit
+
+
+def test_fleet_replay_honors_in_flight_cancellation(tmp_path):
+    """An orchestrator killed between cancel_requested and the child's
+    exit must NOT resurrect the job on restart -- the cancel marker was
+    already consumed, so losing it here would make the cancellation
+    silently un-reissuable."""
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool)
+    jp = os.path.join(spool, JOURNAL_FILE)
+    for rec in ({"event": "admit", "job": "c1"},
+                {"event": "cancel_requested", "job": "c1"}):
+        append_record(jp, {"record": "fleet", "time": 0.0, **rec})
+    fleet = FleetOrchestrator(spool, cfg=_cfg(), env=dict(SUP_ENV),
+                              clock=clk, sleep=clk.sleep,
+                              spawn_factory=StubChildren(clk, {}).factory)
+    assert fleet.jobs["c1"].state == "cancelled"
+    assert fleet.run() == 0                     # cancelled is not a failure
+    events, _ = _events(spool)
+    assert ("cancelled", "c1") in events
+    assert ("replay_resume", "c1") not in events
+
+
+def test_fleet_journal_rotation_snapshot_keeps_replay_whole(tmp_path):
+    """Rotation clobbers the .1 aside, so a long heal loop could lose a
+    live job's admit/spawn records entirely -- the compaction snapshot
+    written at every rotation must keep replay authoritative."""
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    fleet_tool.submit(spool, "longrun", ["-u", "10"])
+    fleet_tool.submit(spool, "noisy", ["-u", "10"])
+    # a tiny cap rotates on every record, so noisy's terminal-failure
+    # traffic pushes longrun's admit record out of BOTH files of the
+    # rotation pair while longrun is still live
+    scripts = {"longrun": [lambda: ts.FakeProc(clk, runtime=None)],
+               "noisy": [lambda: ts.FakeProc(clk, code=1, runtime=0.5)
+                         for _ in range(9)]}
+    fleet, spool, stubs = _mk_fleet(tmp_path, clk, scripts,
+                                    journal_max_bytes=10)
+    for _ in range(60):
+        fleet.poll_once()
+        clk.sleep(0.5)              # poll_once alone never advances time
+    assert os.path.exists(os.path.join(spool, JOURNAL_FILE + ".1"))
+    assert fleet.jobs["longrun"].state == "running"
+    recs = read_records(os.path.join(spool, JOURNAL_FILE))
+    assert not any(r.get("event") == "admit" and r.get("job") == "longrun"
+                   for r in recs)                   # raw record rotated away
+    assert any(r.get("event") == "snapshot" for r in recs)
+    # abandon the orchestrator (SIGKILL equivalent): the journal pair
+    # no longer holds longrun's admit record, only snapshots do
+    f2 = FleetOrchestrator(spool, cfg=_cfg(), env=dict(SUP_ENV),
+                           clock=clk, sleep=clk.sleep,
+                           spawn_factory=StubChildren(clk, {}).factory)
+    assert "longrun" in f2.jobs and f2.jobs["longrun"].state == "queued"
+    assert f2.jobs["noisy"].state in ("queued", "failed")
+
+
+def test_fleet_supervisor_exception_is_terminal_across_replay(tmp_path):
+    """A job whose supervisor machinery itself blows up is journaled
+    `failed` (a state replay understands), not resurrected forever."""
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    fleet_tool.submit(spool, "cursed", ["-u", "1"])
+
+    def exploding_factory(job):
+        def spawn(argv, env, logf):
+            raise RuntimeError("spawn machinery broken")
+        return spawn
+
+    fleet = FleetOrchestrator(spool, cfg=_cfg(), env=dict(SUP_ENV),
+                              clock=clk, sleep=clk.sleep,
+                              spawn_factory=exploding_factory)
+    assert fleet.run() == 1
+    assert fleet.jobs["cursed"].state == "failed"
+    f2 = FleetOrchestrator(spool, cfg=_cfg(), env=dict(SUP_ENV),
+                           clock=clk, sleep=clk.sleep,
+                           spawn_factory=StubChildren(clk, {}).factory)
+    assert f2.jobs["cursed"].state == "failed"      # stays terminal
+    assert f2.run() == 1
+
+
+def test_fleet_terminal_states_survive_replay(tmp_path):
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    fleet_tool.submit(spool, "ok", ["-u", "1"])
+    fleet_tool.submit(spool, "boom", ["-u", "1"])
+    scripts = {"ok": [lambda: ts.FakeProc(clk, code=0, runtime=1.0)],
+               "boom": [lambda: ts.FakeProc(clk, code=1, runtime=0.5)
+                        for _ in range(9)]}
+    fleet, spool, stubs = _mk_fleet(tmp_path, clk, scripts)
+    assert fleet.run() == 1
+    assert fleet.jobs["boom"].state == "failed"
+    f2 = FleetOrchestrator(spool, cfg=_cfg(), env=dict(SUP_ENV),
+                           clock=clk, sleep=clk.sleep,
+                           spawn_factory=StubChildren(clk, {}).factory)
+    # nothing to do: done stays done, failed stays failed (until an
+    # operator requeues it)
+    assert {n: j.state for n, j in f2.jobs.items()} == \
+        {"ok": "done", "boom": "failed"}
+    assert f2.run() == 1
+
+
+# ---------------------------------------------------------------------------
+# crash-storm circuit breaker
+# ---------------------------------------------------------------------------
+
+def test_circuit_breaker_trips_on_k_same_class_in_window():
+    br = CircuitBreaker(3, 60.0)
+    assert not br.note_failure("crash", 0.0)
+    assert not br.note_failure("crash", 10.0)
+    assert br.note_failure("crash", 20.0)           # rising edge at K
+    assert br.is_open(21.0) and br.trips == 1
+    # same-class failures while open extend it, without re-tripping
+    assert not br.note_failure("crash", 50.0)
+    assert br.maybe_close(100.0) is None            # quiet < window
+    assert br.maybe_close(110.0) == "crash"
+    assert not br.is_open(110.0)
+
+
+def test_circuit_breaker_needs_same_class_within_window():
+    br = CircuitBreaker(2, 60.0)
+    assert not br.note_failure("crash", 0.0)
+    assert not br.note_failure("hang", 10.0)        # class isolation
+    assert not br.note_failure("crash", 70.0)       # first one expired
+    assert br.note_failure("crash", 80.0)
+
+
+def test_fleet_breaker_pauses_admissions_then_recovers(tmp_path):
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    for n in ("a-boom", "b-boom", "c-late"):
+        fleet_tool.submit(spool, n, ["-u", "10"])
+    scripts = {
+        "a-boom": [lambda: ts.FakeProc(clk, code=1, runtime=0.5)
+                   for _ in range(9)],
+        "b-boom": [lambda: ts.FakeProc(clk, code=1, runtime=0.5)
+                   for _ in range(9)],
+        "c-late": [lambda: ts.FakeProc(clk, code=0, runtime=1.0)],
+    }
+    fleet, spool, stubs = _mk_fleet(tmp_path, clk, scripts,
+                                    max_jobs=2, breaker_k=2,
+                                    breaker_sec=40.0)
+    assert fleet.run() == 1                         # the two crash loops
+    states = {n: j.state for n, j in fleet.jobs.items()}
+    assert states == {"a-boom": "failed", "b-boom": "failed",
+                      "c-late": "done"}
+    events, recs = _events(spool)
+    names = [e for e, _ in events]
+    assert "breaker_open" in names and "breaker_close" in names
+    # admission control actually held: c-late was only admitted after
+    # the breaker closed
+    assert names.index("breaker_close") < events.index(("admit", "c-late"))
+    # fleet aggregates saw every classified failure (4 boots per loop)
+    assert fleet.failures["crash"] == 8
+    m = read_metrics(os.path.join(spool, "fleet.prom"))
+    assert m['avida_fleet_failures_total{class="crash"}'] == 8
+    assert m["avida_fleet_breaker_trips_total"] == 1
+    assert m["avida_fleet_breaker_open"] == 0       # closed by the end
+
+
+def test_fleet_pallas_storm_degrades_fleet_wide_once(tmp_path):
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    for n in ("p1", "p2", "z-late"):
+        fleet_tool.submit(spool, n, ["-u", "10"])
+
+    def pallas_boom(proc, argv, env, logf):
+        logf.write("jax._src.pallas.mosaic.lowering.LoweringError: bad\n")
+        logf.flush()
+
+    def pallas_pair():
+        return [lambda: ts.FakeProc(clk, code=1, runtime=0.5,
+                                    on_spawn=pallas_boom),
+                lambda: ts.FakeProc(clk, code=0, runtime=1.0)]
+
+    scripts = {"p1": pallas_pair(), "p2": pallas_pair(),
+               "z-late": [lambda: ts.FakeProc(clk, code=0, runtime=1.0)]}
+    fleet, spool, stubs = _mk_fleet(tmp_path, clk, scripts,
+                                    max_jobs=2, breaker_k=2,
+                                    breaker_sec=20.0)
+    assert fleet.run() == 0
+    assert fleet.xla_fallback
+    events, _ = _events(spool)
+    assert [e for e, _ in events].count("xla_fallback") == 1
+    # the late admission inherited the fleet-wide degradation: its
+    # FIRST boot already carries -set TPU_USE_PALLAS 2
+    late_argv = [argv for name, _, argv in stubs.spawned
+                 if name == "z-late"][0]
+    i = late_argv.index("TPU_USE_PALLAS")
+    assert late_argv[i - 1] == "-set" and late_argv[i + 1] == "2"
+    m = read_metrics(os.path.join(spool, "fleet.prom"))
+    assert m["avida_fleet_xla_fallback"] == 1
+
+
+# ---------------------------------------------------------------------------
+# graceful drain + operator markers
+# ---------------------------------------------------------------------------
+
+def test_fleet_drain_requeues_incomplete_jobs(tmp_path):
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    fleet_tool.submit(spool, "run1", ["-u", "1000"])
+    fleet_tool.submit(spool, "wait2", ["-u", "1000"])
+    fleet, spool, stubs = _mk_fleet(
+        tmp_path, clk,
+        {"run1": [lambda: PreemptibleProc(clk, runtime=None)]},
+        max_jobs=1)
+    sleeps = []
+    real_sleep = fleet._sleep
+
+    def stopping_sleep(s):
+        real_sleep(s)
+        sleeps.append(s)
+        if len(sleeps) >= 3:
+            fleet._stop = True                      # SIGTERM arrives
+
+    fleet._sleep = stopping_sleep
+    assert fleet.run() == 0                         # drained, not failed
+    assert fleet.jobs["run1"].state == "queued"     # requeued, resumable
+    assert fleet.jobs["wait2"].state == "queued"    # never admitted
+    proc = stubs.spawned[0][1]
+    assert proc.returncode == 0                     # SIGTERM, not SIGKILL
+    events, recs = _events(spool)
+    assert ("requeued", "run1") in events
+    reasons = [r.get("reason") for r in recs if r["event"] == "requeued"]
+    assert "drain" in reasons
+    # a fresh orchestrator picks both up and finishes them.  run1's
+    # resumed child must republish its heartbeat with preempted=0 (as
+    # every real run does on exit) -- the stale preemption marker from
+    # the drained boot would otherwise classify its clean exit as
+    # another preempt
+    def finish(proc, argv, env, logf):
+        ts._write_metrics(os.path.dirname(logf.name), hb=clk(),
+                          preempted=0)
+
+    stubs2 = StubChildren(
+        clk, {n: [lambda: ts.FakeProc(clk, code=0, runtime=1.0,
+                                      on_spawn=finish)]
+              for n in ("run1", "wait2")})
+    f2 = FleetOrchestrator(spool, cfg=_cfg(), env=dict(SUP_ENV),
+                           clock=clk, sleep=clk.sleep,
+                           spawn_factory=stubs2.factory)
+    assert f2.run() == 0
+    assert all(j.state == "done" for j in f2.jobs.values())
+
+
+def test_fleet_cancel_and_requeue_markers(tmp_path, capsys):
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    fleet_tool.submit(spool, "c1", ["-u", "1000"])
+    fleet_tool.submit(spool, "c2", ["-u", "1000"])
+    scripts = {"c1": [lambda: PreemptibleProc(clk, runtime=None)],
+               "c2": [lambda: ts.FakeProc(clk, code=0, runtime=1.0)]}
+    fleet, spool, stubs = _mk_fleet(tmp_path, clk, scripts, max_jobs=1)
+    fleet.poll_once()                               # admit c1
+    assert fleet.jobs["c1"].state == "running"
+    assert fleet_tool.main(["cancel", spool, "c1"]) == 0
+    assert fleet_tool.main(["cancel", spool, "c2"]) == 0
+    for _ in range(4):
+        fleet.poll_once()
+    assert fleet.jobs["c1"].state == "cancelled"
+    assert fleet.jobs["c2"].state == "cancelled"
+    assert os.path.exists(os.path.join(spool, "c2.cancelled.json"))
+    # an operator requeue resurrects the parked spec
+    assert fleet_tool.main(["requeue", spool, "c2"]) == 0
+    assert fleet.run() == 0
+    assert fleet.jobs["c2"].state == "done"
+    assert fleet.jobs["c1"].state == "cancelled"    # stays cancelled
+    capsys.readouterr()
+    assert fleet_tool.main(["list", spool]) == 0
+    out = capsys.readouterr().out
+    assert "c1" in out and "cancelled" in out and "done" in out
+    # marker for an unknown job is refused
+    assert fleet_tool.main(["cancel", spool, "ghost"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# status view + CLI plumbing
+# ---------------------------------------------------------------------------
+
+def test_fleet_status_view_and_main_dispatch(tmp_path, capsys):
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    fleet_tool.submit(spool, "jv", ["-u", "1"])
+    fleet, spool, stubs = _mk_fleet(
+        tmp_path, clk,
+        {"jv": [lambda: ts.FakeProc(clk, code=0, runtime=1.0)]})
+    assert fleet.run() == 0
+    capsys.readouterr()
+    assert fleet_status_main(spool) == 0
+    out = capsys.readouterr().out
+    assert "jv" in out and "done" in out and "fleet" in out
+    # __main__ --status routes a spool dir to the fleet view
+    from avida_tpu.__main__ import main
+    assert main(["--status", spool]) == 0
+    assert "jv" in capsys.readouterr().out
+    assert main(["--status", spool, "--max-age", "3600"]) == 0
+    # stale orchestrator heartbeat -> exit 2
+    mpath = os.path.join(spool, "fleet.prom")
+    text = open(mpath).read()
+    with open(mpath, "w") as f:
+        f.write("".join(
+            "avida_fleet_heartbeat_timestamp_seconds 1.0\n"
+            if line.startswith("avida_fleet_heartbeat") else line + "\n"
+            for line in text.splitlines()))
+    assert fleet_status_main(spool, max_age=60.0) == 2
+    assert "STALE" in capsys.readouterr().out
+
+
+def test_fleet_main_cli_parse(tmp_path):
+    from avida_tpu.service.fleet import fleet_main
+    spool = str(tmp_path / "spool")
+    assert fleet_main(["--fleet"]) == 2
+    assert fleet_main(["--fleet", spool, "--max-jobs", "x"]) == 2
+    assert fleet_main(["--fleet", spool, "--bogus"]) == 2
+    # an empty spool drains immediately (exit 0, lock released)
+    assert fleet_main(["--fleet", spool, "--max-jobs", "3"]) == 0
+    assert not os.path.exists(os.path.join(spool, "fleet.lock"))
+
+
+# ---------------------------------------------------------------------------
+# slow: the end-to-end chaos proof with real children
+# ---------------------------------------------------------------------------
+
+# world config shared by every job and its uninterrupted reference --
+# mirrors tests/test_chaos.py: small world, chunk boundaries every 2
+# updates, auto-save every 4, final generation published
+_SETS = [
+    ("WORLD_X", "8"), ("WORLD_Y", "8"), ("TPU_MAX_MEMORY", "256"),
+    ("AVE_TIME_SLICE", "100"), ("TPU_MAX_STEPS_PER_UPDATE", "100"),
+    ("TPU_SYSTEMATICS", "0"), ("TPU_MAX_STRETCH", "2"),
+    ("TPU_CKPT_EVERY", "4"), ("TPU_CKPT_FINAL", "1"),
+]
+_UPDATES = 20
+
+
+def _child_argv(seed):
+    argv = ["-s", str(seed), "-u", str(_UPDATES)]
+    for name, value in _SETS:
+        argv += ["-set", name, value]
+    return argv
+
+
+def _env():
+    env = dict(os.environ)
+    env.pop("TPU_FAULT", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    # NO persistent jax compilation cache: see tests/test_chaos.py::_env
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    return env
+
+
+def _final_arrays(ckpt_dir):
+    gens = ckpt_mod.list_generations(str(ckpt_dir))
+    assert gens, f"no generations under {ckpt_dir}"
+    manifest, arrays, _files = ckpt_mod.read_generation(gens[-1])
+    return manifest, arrays
+
+
+@pytest.mark.slow
+def test_fleet_chaos_three_faulted_jobs_plus_orchestrator_sigkill(tmp_path):
+    """The acceptance drill: >= 3 concurrent jobs, each with its own
+    injected fault (crash / hang / corrupt-ckpt+sigkill), plus one
+    SIGKILL of the orchestrator itself mid-flight.  Everything
+    completes unattended and every job's final state is BIT-EXACT
+    versus its uninterrupted reference run."""
+    jobs = {
+        "j-crash": (13, ["crash@update=7"]),
+        "j-hang": (17, ["hang@chunk=3"]),
+        "j-corrupt": (19,
+                      ["corrupt-ckpt:leaf=merit@update=8;sigkill@update=9"]),
+    }
+    env = _env()
+    # uninterrupted references, sequential (1-core-host rule)
+    refs = {}
+    for name, (seed, _plan) in jobs.items():
+        data = str(tmp_path / f"ref-{name}" / "data")
+        ck = str(tmp_path / f"ref-{name}" / "ck")
+        proc = subprocess.run(
+            [sys.executable, "-m", "avida_tpu"] + _child_argv(seed)
+            + ["-d", data, "-set", "TPU_CKPT_DIR", ck],
+            env=env, capture_output=True, text=True, timeout=900)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        refs[name] = _final_arrays(ck)
+
+    spool = str(tmp_path / "spool")
+    knobs = {"TPU_WATCHDOG_SEC": "20", "TPU_SUPERVISE_POLL_SEC": "0.25",
+             "TPU_SUPERVISE_GRACE_SEC": "600",
+             "TPU_SUPERVISE_BACKOFF_BASE": "0.05",
+             "TPU_SUPERVISE_BACKOFF_CAP": "0.2"}
+    for name, (seed, plan) in jobs.items():
+        fleet_tool.submit(spool, name, _child_argv(seed),
+                          fault_plan=plan, env=knobs)
+    cmd = [sys.executable, "-m", "avida_tpu", "--fleet", spool,
+           "--max-jobs", "3"]
+    with open(os.path.join(spool, "orchestrator.log"), "w") as logf:
+        orch = subprocess.Popen(cmd, env=env, stdout=logf, stderr=logf)
+        # wait for real progress (every job has published a checkpoint
+        # generation), then SIGKILL the orchestrator itself
+        deadline = time.time() + 900
+        while time.time() < deadline:
+            if orch.poll() is not None:
+                break
+            if all(ckpt_mod.list_generations(
+                    os.path.join(spool, n, "ck")) for n in jobs):
+                break
+            time.sleep(1.0)
+        killed = False
+        if orch.poll() is None:
+            orch.kill()
+            orch.wait()
+            killed = True
+    assert killed, "orchestrator finished before the kill window -- " \
+                   "the drill proved nothing"
+
+    # restart: journal replay + orphan reaping + resume to completion
+    proc2 = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                           timeout=1800)
+    assert proc2.returncode == 0, \
+        proc2.stdout[-1000:] + proc2.stderr[-2000:]
+    state, _, _ = journal_states(os.path.join(spool, JOURNAL_FILE))
+    assert state == {n: "done" for n in jobs}
+    for name in jobs:
+        manifest, arrays = _final_arrays(os.path.join(spool, name, "ck"))
+        ref_manifest, ref_arrays = refs[name]
+        assert manifest["update"] == ref_manifest["update"] == _UPDATES
+        assert set(arrays) == set(ref_arrays)
+        for key in sorted(arrays):
+            np.testing.assert_array_equal(
+                arrays[key], ref_arrays[key],
+                err_msg=f"job {name} array {key}")

@@ -258,11 +258,11 @@ class FakeProc:
         self.terminate()
 
 
-def _write_metrics(data_dir, hb, preempted=0, anomalies=None):
+def _write_metrics(data_dir, hb, preempted=0, anomalies=None, update=42):
     os.makedirs(data_dir, exist_ok=True)
     lines = [f"avida_heartbeat_timestamp_seconds {hb}",
              f"avida_preempted {preempted}",
-             "avida_update 42"]
+             f"avida_update {update}"]
     if anomalies is not None:
         lines.append(
             f'avida_trace_code_total{{code="anom_merit"}} {anomalies}')
@@ -599,6 +599,208 @@ def test_healthy_interval_resets_budget(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# liveness-gap watchdogs: progress counter + backwards heartbeat
+# ---------------------------------------------------------------------------
+
+def test_progress_watchdog_kills_livelocked_child(tmp_path):
+    """A livelocked child can keep touching its heartbeat file while
+    making no progress -- with TPU_PROGRESS_SEC set, the watchdog also
+    requires the avida_update counter to ADVANCE."""
+    clk = FakeClock()
+    data = str(tmp_path / "data")
+
+    def livelocked(proc, elapsed):
+        # fresh heartbeats forever, update counter frozen at 42
+        _write_metrics(data, hb=clk(), update=42)
+
+    def finish(proc, argv, env, logf):
+        _write_metrics(data, hb=clk(), update=100)
+
+    procs = [FakeProc(clk, runtime=None, poll_hook=livelocked),
+             FakeProc(clk, code=0, runtime=0.0, on_spawn=finish)]
+    sup, _, _, _ = _mk_sup(tmp_path, procs, clk,
+                           watchdog_sec=30.0, progress_sec=5.0)
+    t0 = clk()
+    assert sup.run() == 0
+    assert sup.failures["hang"] == 1 and sup.watchdog_kills == 1
+    assert procs[0].returncode == -9
+    # killed on the progress clock, well before heartbeat staleness
+    # could ever fire (heartbeats stayed fresh throughout)
+    assert clk() - t0 < 30.0
+    events, recs = _runlog_events(data)
+    kills = [r for r in recs if r["event"] == "watchdog_kill"]
+    assert kills[0]["reason"] == "no progress"
+
+
+def test_progress_watchdog_spares_advancing_child(tmp_path):
+    clk = FakeClock()
+    data = str(tmp_path / "data")
+
+    def advancing(proc, elapsed):
+        _write_metrics(data, hb=clk(), update=int(elapsed))
+        if elapsed >= 20.0:
+            proc.returncode = 0
+
+    procs = [FakeProc(clk, runtime=None, poll_hook=advancing)]
+    sup, _, _, _ = _mk_sup(tmp_path, procs, clk,
+                           watchdog_sec=30.0, progress_sec=5.0)
+    assert sup.run() == 0
+    assert sup.watchdog_kills == 0
+
+
+def test_progress_watchdog_defaults_off():
+    cfg = SupervisorConfig.from_env({})
+    assert cfg.progress_sec == 0.0
+    cfg = SupervisorConfig.from_env({"TPU_PROGRESS_SEC": "7.5"})
+    assert cfg.progress_sec == 7.5
+
+
+def test_backwards_heartbeat_is_stale_not_fresh(tmp_path):
+    """A heartbeat timestamp that moves BACKWARDS (stepped host clock)
+    must never count as fresh: without the hb_max guard, `now - hb`
+    stays small and a wedged child with a back-stepped clock would look
+    alive forever."""
+    clk = FakeClock()
+    data = str(tmp_path / "data")
+
+    def stepped_clock(proc, elapsed):
+        if elapsed < 3.0:
+            _write_metrics(data, hb=clk())
+        else:
+            # the child's clock stepped back 15s (> watchdog_sec): every
+            # later beat regresses below the max already seen, so none
+            # may count as an advance -- the kill must fire on the
+            # last-true-advance clock, BEFORE the stepped timestamps
+            # crawl back past the old maximum
+            _write_metrics(data, hb=clk() - 15.0)
+
+    def finish(proc, argv, env, logf):
+        _write_metrics(data, hb=clk())
+
+    procs = [FakeProc(clk, runtime=None, poll_hook=stepped_clock),
+             FakeProc(clk, code=0, runtime=0.0, on_spawn=finish)]
+    sup, _, _, _ = _mk_sup(tmp_path, procs, clk, watchdog_sec=10.0)
+    assert sup.run() == 0
+    assert sup.failures["hang"] == 1 and sup.watchdog_kills == 1
+    events, recs = _runlog_events(data)
+    kills = [r for r in recs if r["event"] == "watchdog_kill"]
+    assert kills[0]["reason"] == "heartbeat moved backwards"
+
+
+def test_backwards_heartbeat_transient_step_self_heals(tmp_path):
+    """A small clock step (shorter than the watchdog window) must NOT
+    kill: once the stepped clock catches back up past the old maximum,
+    the heartbeat is fresh again."""
+    clk = FakeClock()
+    data = str(tmp_path / "data")
+
+    def small_step(proc, elapsed):
+        if elapsed < 5.0:
+            _write_metrics(data, hb=clk())
+        else:
+            _write_metrics(data, hb=clk() - 3.0)   # catches up at ~8s
+        if elapsed >= 15.0:
+            proc.returncode = 0
+
+    procs = [FakeProc(clk, runtime=None, poll_hook=small_step)]
+    sup, _, _, _ = _mk_sup(tmp_path, procs, clk, watchdog_sec=10.0)
+    assert sup.run() == 0
+    assert sup.watchdog_kills == 0
+
+
+# ---------------------------------------------------------------------------
+# postmortem stderr tail on failure-class exit records
+# ---------------------------------------------------------------------------
+
+def test_crash_exit_record_carries_bounded_stderr_tail(tmp_path):
+    from avida_tpu.service.supervisor import STDERR_TAIL_RECORD_BYTES
+    clk = FakeClock()
+    filler = "x" * 120
+
+    def chatty_crash(proc, argv, env, logf):
+        for i in range(64):
+            logf.write(f"{filler} line {i}\n")
+        logf.write("FATAL: the actual traceback\n")
+        logf.flush()
+
+    procs = [FakeProc(clk, code=1, runtime=0.0, on_spawn=chatty_crash),
+             FakeProc(clk, code=0, runtime=0.0)]
+    sup, data, _, _ = _mk_sup(tmp_path, procs, clk)
+    assert sup.run() == 0
+    _, recs = _runlog_events(data)
+    exits = [r for r in recs if r["event"] == "exit"]
+    crash = [r for r in exits if r["class"] == "crash"][0]
+    tail = crash["stderr_tail"]
+    assert len(tail.encode()) <= STDERR_TAIL_RECORD_BYTES   # bounded
+    assert "FATAL: the actual traceback" in tail            # the evidence
+    assert "line 0\n" not in tail                           # truncated
+    # success exits carry no tail (no failure to explain)
+    ok = [r for r in exits if r["class"] == "success"][0]
+    assert "stderr_tail" not in ok
+
+
+# ---------------------------------------------------------------------------
+# runlog size-capped rotation
+# ---------------------------------------------------------------------------
+
+def test_append_record_rotates_at_cap_mid_append(tmp_path):
+    from avida_tpu.observability.runlog import append_record, read_records
+    path = str(tmp_path / "log.jsonl")
+    recs = [{"record": "supervisor", "i": i, "pad": "p" * 40}
+            for i in range(60)]
+    for rec in recs:
+        append_record(path, rec, max_bytes=600)
+    assert os.path.exists(path + ".1")              # rotated mid-append
+    assert os.path.getsize(path) <= 600
+    assert os.path.getsize(path + ".1") <= 600
+    # the rotation pair preserves a contiguous, in-order SUFFIX of the
+    # stream (each rotation clobbers the previous .1 aside): the newest
+    # record is always present, and both files contribute
+    got = [r["i"] for r in read_records(path)]
+    assert got == list(range(got[0], 60))
+    n_current = len(open(path).readlines())
+    assert 0 < n_current < len(got)                 # .1 contributes too
+
+
+def test_append_record_no_cap_never_rotates(tmp_path):
+    from avida_tpu.observability.runlog import append_record
+    path = str(tmp_path / "log.jsonl")
+    for i in range(50):
+        append_record(path, {"i": i})
+    assert not os.path.exists(path + ".1")
+    assert len(open(path).readlines()) == 50
+
+
+def test_supervisor_runlog_rotation_is_wired(tmp_path):
+    """A long heal loop must not grow supervisor.jsonl without bound:
+    TPU_RUNLOG_MAX_BYTES caps it via append_record rotation."""
+    clk = FakeClock()
+    data = tmp_path / "data"
+    ck = tmp_path / "ck"
+    os.makedirs(ck, exist_ok=True)
+    procs = [FakeProc(clk, code=1, runtime=0.0) for _ in range(9)]
+    seq = list(procs)
+
+    def spawn(argv, env, logf):
+        proc = seq.pop(0)
+        proc._spawned(argv, env, logf)
+        return proc
+
+    sup = Supervisor(
+        ["-d", str(data), "-set", "TPU_CKPT_DIR", str(ck), "-u", "9"],
+        cfg=SupervisorConfig(watchdog_sec=10.0, poll_sec=0.5,
+                             grace_sec=30.0, max_retries=8,
+                             backoff_base=0.1, backoff_cap=0.2,
+                             healthy_sec=1e9),
+        env={"TPU_RUNLOG_MAX_BYTES": "2000"}, spawn=spawn,
+        clock=clk, sleep=clk.sleep)
+    assert sup.runlog_max_bytes == 2000
+    assert sup.run() == 1                           # budget exhausted
+    assert os.path.exists(str(data / "supervisor.jsonl.1"))
+    assert os.path.getsize(str(data / "supervisor.jsonl")) <= 2000
+
+
+# ---------------------------------------------------------------------------
 # --status exit codes (external watchdog contract)
 # ---------------------------------------------------------------------------
 
@@ -692,6 +894,49 @@ def test_ckpt_tool_prune(tmp_path, capsys):
     assert "1 generation(s) kept" in capsys.readouterr().out
     assert ckpt_tool.main([str(base), "--prune", "--keep"]) == 2
     assert "integer argument" in capsys.readouterr().out
+
+
+def test_ckpt_tool_prune_all_sweeps_a_spool(tmp_path, capsys):
+    """`--prune --all SPOOL` sweeps every job's checkpoint debris in
+    one pass (fleet spools keep one ck dir per job)."""
+    spool = tmp_path / "spool"
+    cks = []
+    for job in ("j1", "j2", "j3"):
+        ck = spool / job / "ck"
+        for u in (10, 20, 30):
+            _gen(ck, update=u, keep=10)
+        os.makedirs(ck / f".tmp-ckpt-000000000099.{job}")
+        os.makedirs(ck / f".bad-ckpt-000000000010.{job}")
+        cks.append(ck)
+    # spool clutter that is NOT a checkpoint dir must be untouched
+    (spool / "j1" / "data").mkdir()
+    (spool / "j1" / "data" / "metrics.prom").write_text("x 1\n")
+    # a JOB merely named ckpt-something must not make the spool root
+    # look like a checkpoint dir (its whole fault domain would be
+    # rmtree'd as retention overflow)
+    (spool / "ckpt-seedjob" / "ck").mkdir(parents=True)
+    (spool / "ckpt-seedjob" / "keep.txt").write_text("precious\n")
+    swept = ckpt_tool.prune_all(str(spool), keep=2)
+    assert str(spool) not in swept
+    assert os.path.exists(spool / "ckpt-seedjob" / "keep.txt")
+    assert sorted(swept) == [str(ck) for ck in cks]
+    for ck in cks:
+        removed = swept[str(ck)]
+        assert len(removed) == 3                   # 2 strays + 1 old gen
+        names = [os.path.basename(p)
+                 for p in ckpt_mod.list_generations(str(ck))]
+        assert names == ["ckpt-000000000020", "ckpt-000000000030"]
+        assert not [d for d in os.listdir(ck) if d.startswith(".")]
+    assert os.path.exists(spool / "j1" / "data" / "metrics.prom")
+    # CLI plumbing: --prune --all with --keep, order-insensitive
+    for u in (40, 50, 60):
+        _gen(cks[0], update=u, keep=10)
+    assert ckpt_tool.main(["--prune", "--all", str(spool),
+                           "--keep", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "checkpoint dir(s)" in out
+    assert len(ckpt_mod.list_generations(str(cks[0]))) == 1
+    assert ckpt_tool.main(["--all", str(spool)]) == 2   # needs --prune
 
 
 def _aside(base, update=10):
