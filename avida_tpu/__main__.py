@@ -14,6 +14,16 @@ Avida::Util::ProcessCmdLineArgs, source/util/CmdLine.cc:205):
   -u N       stop after N updates (overrides events-driven exit)
   -a         analyze mode: run ANALYZE_FILE (analyze.cfg) through the
              batch VM instead of an evolution run (ANALYZE_MODE=1)
+  -a CKPT_DIR / --analyze CKPT_DIR
+             checkpoint-native analytics (analyze/pipeline.py): load the
+             newest CRC-valid native checkpoint generation (falling back
+             past corrupt ones exactly like --resume), reconstruct the
+             population + systematics tables, and run the batched
+             phenotype census, knockout attribution and dominant-lineage
+             replay offline -- census/knockout/lineage .dat tables under
+             DATA_DIR/analysis/, {"record":"analytics"} runlog lines and
+             DATA_DIR/analytics.prom.  No World.run, no donated-buffer
+             compile; the update_step jaxpr is untouched.
   -v         verbose
 
 TPU-build extras (no reference equivalent):
@@ -101,7 +111,8 @@ def main(argv=None):
                    default=[], metavar=("NAME", "VALUE"))
     p.add_argument("-d", "--data-dir", default=None)
     p.add_argument("-u", "--updates", type=int, default=None)
-    p.add_argument("-a", "--analyze", action="store_true")
+    p.add_argument("-a", "--analyze", nargs="?", const=True, default=None,
+                   metavar="CKPT_DIR")
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("--telemetry", action="store_true")
     p.add_argument("--profile-dir", default=None)
@@ -135,6 +146,25 @@ def main(argv=None):
     if args.profile_dir:
         overrides.append(("TPU_TELEMETRY", 1))
         overrides.append(("TPU_PROFILE_DIR", args.profile_dir))
+
+    if isinstance(args.analyze, str):
+        # checkpoint-native analytics (analyze/pipeline.py): offline
+        # census/knockout/lineage over an archived run's native
+        # checkpoints -- builds its own config-resolved World (never run).
+        # Guard against argparse swallowing a non-directory token (e.g.
+        # the legacy bundled `-av`, which now parses as analyze='v'):
+        # fail LOUDLY instead of silently rerouting
+        if not os.path.isdir(args.analyze):
+            print(f"[avida-tpu] --analyze: {args.analyze!r} is not a "
+                  f"checkpoint directory (bare -a runs the analyze VM; "
+                  f"-a/--analyze CKPT_DIR runs checkpoint analytics -- "
+                  f"note bundled short flags like -av no longer parse)",
+                  file=sys.stderr)
+            return 2
+        from avida_tpu.analyze.pipeline import cli_main as analyze_ckpt
+        return analyze_ckpt(args.analyze, config_dir=args.config_dir,
+                            overrides=overrides, data_dir=args.data_dir,
+                            verbose=args.verbose)
 
     from avida_tpu.world import World
     world = World(config_dir=args.config_dir, overrides=overrides,

@@ -1,1 +1,16 @@
-from avida_tpu.analyze.testcpu import evaluate_genomes, TestResult  # noqa: F401
+"""Analyze package: batched Test CPU, the analyze VM and the
+checkpoint-native analytics pipeline.
+
+Lazy re-exports (PEP 562, the avida_tpu/__init__ pattern): importing
+`avida_tpu.analyze.pipeline` for its host-only pieces
+(checkpoint_detail, the .dat writers -- scripts/ckpt_tool.py's --detail
+triage column) must not pull jax in through an eager testcpu import;
+`from avida_tpu.analyze import evaluate_genomes` still resolves on
+first touch."""
+
+
+def __getattr__(name):
+    if name in ("evaluate_genomes", "TestResult"):
+        from avida_tpu.analyze import testcpu
+        return getattr(testcpu, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -19,6 +19,13 @@ pattern makes additions one-liners):
   TRACE [dir]               per-cycle hardware trace of each genotype
   LANDSCAPE [file]          one-step mutational landscape of the batch
   ANALYZE_KNOCKOUTS [file]  per-site knockout viability/fitness
+  CENSUS [file]             pipeline-backed phenotype-census table of
+                            the batch (task profile / fitness /
+                            gestation per genotype; analyze/pipeline.py)
+  LINEAGE [file [field]]    pipeline-backed lineage replay: reduce to
+                            the ancestral lineage (FIND_LINEAGE),
+                            RECALCULATE each step, write the per-depth
+                            fitness/task-acquisition table
   VERBOSE / SYSTEM <cmd>    utility commands
 """
 
@@ -132,6 +139,7 @@ class Analyzer:
                 g = AnalyzeGenotype(o["genome"], self._take_id())
                 g.src_id = o.get("id", -1)
                 g.parent_src = o.get("parent", -1)
+                g.depth = o.get("depth", -1)
                 seen[key] = g
                 self.batch.append(g)
 
@@ -254,30 +262,78 @@ class Analyzer:
 
     def _cmd_ANALYZE_KNOCKOUTS(self, args):
         """Replace each site with the null instruction and test viability
-        (ref cAnalyze KNOCKOUT machinery)."""
+        (ref cAnalyze KNOCKOUT machinery; classification shared with the
+        checkpoint-native pipeline via pipeline.knockout_profile)."""
+        from avida_tpu.analyze.pipeline import knockout_profile
         fname = args[0] if args else "knockouts.dat"
         f = DatFile(os.path.join(self.data_dir, fname), "Knockout analysis",
                     ["genotype id", "length", "num lethal", "num detrimental",
                      "num neutral", "num beneficial"])
-        nop = 0  # op 0 (nop-A) is the neutral filler instruction
         for g in self.batch:
             base = self._recalc_one(g)
-            kos = []
-            for site in range(g.length):
-                m = g.sequence.copy()
-                m[site] = nop
-                kos.append(AnalyzeGenotype(m))
-            buf, lens = self._padded(kos)
-            r = evaluate_genomes(self.params, buf, lens)
-            fit = np.where(r.viable, r.fitness, 0.0)
-            base_f = max(base, 1e-30)
-            rel = fit / base_f
+            prof = knockout_profile(self.params, g.sequence, base)
+            # length column = SITES SWEPT (knockout_profile truncates
+            # genomes wider than the memory buffer), so the four class
+            # counts always partition it
             f.write_row([
-                g.id, g.length, int((fit <= 0).sum()),
-                int(((fit > 0) & (rel < 0.95)).sum()),
-                int(((rel >= 0.95) & (rel <= 1.05)).sum()),
-                int((rel > 1.05).sum())])
+                g.id, prof["length"], prof["lethal"],
+                prof["detrimental"], prof["neutral"],
+                prof["beneficial"]])
         f.close()
+
+    def _cmd_CENSUS(self, args):
+        """CENSUS [file]: pipeline-backed phenotype-census table of the
+        current batch (analyze/pipeline.write_census_dat -- the same
+        schema `--analyze CKPT_DIR` writes, with num_cpus standing in
+        for live units and src depth when the batch came from a .spop)."""
+        from avida_tpu.analyze.pipeline import tasks_mask, write_census_dat
+        fname = args[0] if args else "census.dat"
+        self._recalc_missing()
+        rows = []
+        for g in self.batch:
+            tasks = (np.asarray(g.task_counts)
+                     if g.task_counts is not None
+                     else np.zeros(self.params.num_reactions, np.int64))
+            rows.append({
+                "gid": g.id, "num_units": g.num_cpus,
+                "depth": getattr(g, "depth", -1), "length": g.length,
+                "viable": bool(g.viable), "fitness": g.fitness,
+                "merit": g.merit, "gestation": g.gestation_time,
+                "tasks_mask": tasks_mask(tasks),
+                "task_counts": [int(x) for x in tasks],
+            })
+        write_census_dat(os.path.join(self.data_dir, fname), rows)
+
+    def _cmd_LINEAGE(self, args):
+        """LINEAGE [file [field]]: pipeline-backed lineage replay over
+        the loaded batch -- FIND_LINEAGE's parent-link walk, then a
+        RECALCULATE of every step and the per-depth fitness /
+        task-acquisition table (analyze/pipeline.write_lineage_dat)."""
+        from avida_tpu.analyze.pipeline import tasks_mask, write_lineage_dat
+        fname = args[0] if args else "lineage.dat"
+        self._cmd_FIND_LINEAGE(args[1:2])
+        self._recalc_missing()
+        rows, prev_mask = [], 0
+        for depth, g in enumerate(self.batch):       # root first
+            tasks = (np.asarray(g.task_counts)
+                     if g.task_counts is not None
+                     else np.zeros(self.params.num_reactions, np.int64))
+            mask = tasks_mask(tasks)
+            # id columns stay in ONE id space: the .spop source ids when
+            # the batch was LOADed (parent_src lives there), else the
+            # batch ids (parent then -1) -- so Parent ID always joins
+            # against a Genotype ID row
+            src = getattr(g, "src_id", -1)
+            rows.append({
+                "depth": depth, "gid": src if src >= 0 else g.id,
+                "parent_gid": (getattr(g, "parent_src", -1)
+                               if src >= 0 else -1),
+                "update_born": -1, "length": g.length,
+                "fitness": g.fitness, "gestation": g.gestation_time,
+                "tasks_mask": mask, "tasks_gained": mask & ~prev_mask,
+            })
+            prev_mask = mask
+        write_lineage_dat(os.path.join(self.data_dir, fname), rows)
 
     def _cmd_ANALYZE_MODULARITY(self, args):
         """Functional modularity via site knockouts
@@ -339,6 +395,13 @@ class Analyzer:
                 (total_all / total_task) if total_task else 0.0,
                 (sum_overlap / total_task) if total_task else 0.0])
         f.close()
+
+    def _recalc_missing(self):
+        """RECALCULATE only when some batch member has never been
+        scored: `RECALCULATE; CENSUS; LINEAGE` scripts must not pay the
+        batched gestation sweep three times over the same genotypes."""
+        if any(g.task_counts is None for g in self.batch):
+            self._cmd_RECALCULATE([])
 
     def _recalc_one(self, g) -> float:
         buf, lens = self._padded([g])

@@ -30,6 +30,29 @@ from avida_tpu.ops.interpreter import extract_offspring, micro_step
 
 TEST_CPU_GENERATIONS = 3   # ref nHardware::TEST_CPU_GENERATIONS
 
+# Compile-count probe: bumped once per (re)trace of the jitted gestation
+# oracle (the increment is a Python side effect, so it runs at TRACE time
+# only -- a cache hit never touches it).  Census sweeps over many batch
+# sizes must stay O(log G) compiles thanks to the bucket padding in
+# evaluate_genomes; tests/test_analyze_pipeline.py asserts it through
+# gestation_trace_count().
+_GESTATION_TRACES = 0
+
+
+def gestation_trace_count() -> int:
+    """How many times the gestation oracle has been traced (compiled)
+    in this process."""
+    return _GESTATION_TRACES
+
+
+def _bucket(n: int) -> int:
+    """Power-of-two batch bucket: the jitted gestation oracle compiles
+    one program per distinct batch SHAPE, so padding every batch up to
+    the next power of two caps the compile count at O(log G_max) instead
+    of one per distinct batch size (dead padded lanes never execute:
+    lens == 0 means alive is False from the first cycle)."""
+    return 1 << max(int(n) - 1, 0).bit_length()
+
 
 @dataclass
 class TestResult:
@@ -46,6 +69,21 @@ class TestResult:
     generations: np.ndarray     # int32[G] generations to reach a fixed point
 
 
+def _sandbox_inputs(key, g):
+    """Per-lane sandbox IO inputs, COUNTER-STABLE in the batch size:
+    lane i draws from fold_in(key, i), so its inputs depend only on
+    (key, i) -- never on how many other lanes share the batch.  A flat
+    make_cell_inputs(key, g) draw would make every lane's values a
+    function of g (threefry pairs counter i with i + n/2), so bucket
+    padding -- or simply evaluating the same genotype in batches of
+    different sizes -- would silently change input-dependent task
+    profiles.  With this construction the padding in evaluate_genomes
+    is value-preserving by design."""
+    return jax.vmap(
+        lambda i: make_cell_inputs(jax.random.fold_in(key, i), 1)[0]
+    )(jnp.arange(g))
+
+
 def _sandbox_state(params, genomes, lens, key):
     g = genomes.shape[0]
     st = zeros_population(g, params.max_memory, params.num_reactions,
@@ -53,7 +91,7 @@ def _sandbox_state(params, genomes, lens, key):
                           n_deme_res=params.num_deme_res)
     k_in, _ = jax.random.split(key)
     st = st.replace(
-        inputs=make_cell_inputs(k_in, g),
+        inputs=_sandbox_inputs(k_in, g),
         deme_resources=jnp.broadcast_to(
             jnp.asarray(params.dres_initial, jnp.float32)[None, :],
             (1, params.num_deme_res)),
@@ -80,6 +118,8 @@ def _run_gestation(params, genomes, lens, time_mod, key):
     Returns (state-after, divided[G], gestation[G], offspring[G, L],
     off_len[G]).  Mirrors cTestCPU::ProcessGestation (cTestCPU.cc:144).
     """
+    global _GESTATION_TRACES
+    _GESTATION_TRACES += 1          # trace-time only (compile probe)
     st = _sandbox_state(params, genomes, lens, key)
     budget = time_mod * jnp.maximum(lens, 1)
     max_t = budget.max()
@@ -120,6 +160,18 @@ def evaluate_genomes(params, genomes, lens=None, seed: int = 0,
     if lens is None:
         lens = (genomes != 0).cumsum(axis=1).argmax(axis=1) + 1
     lens = jnp.asarray(lens, jnp.int32)
+    # bucket-pad the batch to a power of two so sweeps over many batch
+    # sizes (census over G genotypes, knockouts over L sites, lineage
+    # walks) reuse O(log G) compiled gestation programs instead of
+    # paying one compile per distinct size.  Padded lanes have lens == 0
+    # -> never alive -> never execute; results are sliced back to G.
+    G0 = G
+    Gp = _bucket(G)
+    if Gp != G:
+        genomes = jnp.concatenate(
+            [genomes, jnp.zeros((Gp - G, L), genomes.dtype)])
+        lens = jnp.concatenate([lens, jnp.zeros(Gp - G, jnp.int32)])
+        G = Gp
     key = jax.random.key(seed)
 
     cur_g, cur_len = genomes, lens
@@ -170,16 +222,17 @@ def evaluate_genomes(params, genomes, lens=None, seed: int = 0,
         nxt_len = np.where(done, len_np, off_len_np)
         cur_g, cur_len = jnp.asarray(nxt), jnp.asarray(nxt_len)
 
-    gest = out["gestation"]
+    gest = out["gestation"][:G0]
+    merit = out["merit"][:G0]
     return TestResult(
-        viable=out["divided"] & (gest > 0),
+        viable=out["divided"][:G0] & (gest > 0),
         gestation_time=gest,
-        merit=out["merit"],
-        fitness=np.where(gest > 0, out["merit"] / np.maximum(gest, 1), 0.0),
-        task_counts=out["tasks"],
-        copied_size=out["copied"],
-        executed_size=out["executed"],
-        offspring_genome=out["off"],
-        offspring_len=out["off_len"],
-        generations=generations,
+        merit=merit,
+        fitness=np.where(gest > 0, merit / np.maximum(gest, 1), 0.0),
+        task_counts=out["tasks"][:G0],
+        copied_size=out["copied"][:G0],
+        executed_size=out["executed"][:G0],
+        offspring_genome=out["off"][:G0],
+        offspring_len=out["off_len"][:G0],
+        generations=generations[:G0],
     )

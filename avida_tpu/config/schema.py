@@ -304,6 +304,23 @@ class AvidaConfig:
     # by TPU_TRACE=1.
     TPU_METRICS: int = 0
 
+    # In-run analytics (analyze/pipeline.py): 1 = refresh an incremental
+    # phenotype census + dominant-lineage replay at checkpoint
+    # boundaries and run exit (needs TPU_CKPT_DIR/TPU_CKPT_EVERY for the
+    # mid-run cadence), publishing DATA_DIR/analytics.prom and
+    # DATA_DIR/analysis/analytics.jsonl for `--status` and the fleet
+    # view.  Host-side only: trajectories are bit-identical on or off.
+    TPU_ANALYTICS: int = 0
+    # Live-mode knockout sweeps over the top-N genotypes per refresh
+    # (0 = census/lineage only; sweeps cost one sandbox evaluation per
+    # genome site -- memoized by genome content, so a stable dominant
+    # only pays once -- and are opt-in while the run is alive).
+    TPU_ANALYTICS_KNOCKOUT_TOP: int = 0
+    # Sandbox PRNG seed for the live census/knockout evaluations (the
+    # offline CLI's --seed); per-lane inputs are counter-stable, so a
+    # given (seed, genotype) always scores identically.
+    TPU_ANALYTICS_SEED: int = 0
+
     extras: dict = field(default_factory=dict)
 
     _FIELD_NAMES = None  # class-level cache

@@ -173,6 +173,40 @@ def format_status(metrics: dict, now: float | None = None) -> str:
     return "\n".join(lines)
 
 
+def analytics_census_digest(analytics: dict,
+                            metrics: dict | None = None) -> dict:
+    """The census facts every status surface renders
+    (analyze/pipeline.py's analytics.prom families): census update, its
+    age against the run heartbeat's update counter (None without one),
+    dominant gid/lineage depth, and the tasks-held mask + popcount.
+    Shared by the single-run `--status` line below and the fleet
+    per-tenant column (service/fleet.py) so the derivation lives once."""
+    cu = int(analytics.get("avida_analytics_census_update", 0))
+    age = None
+    if metrics and "avida_update" in metrics:
+        age = max(int(metrics["avida_update"]) - cu, 0)
+    held = int(analytics.get("avida_analytics_tasks_held_mask", 0))
+    return {
+        "update": cu,
+        "age": age,
+        "gid": int(analytics.get("avida_analytics_dominant_genotype_id",
+                                 -1)),
+        "depth": int(analytics.get(
+            "avida_analytics_dominant_lineage_depth", 0)),
+        "tasks_mask": held,
+        "tasks_held": bin(held).count("1"),
+    }
+
+
+def format_analytics_status(metrics: dict, analytics: dict) -> str:
+    """One-line digest of an analytics.prom census for `--status`."""
+    d = analytics_census_digest(analytics, metrics)
+    age = "" if d["age"] is None else f" (age {d['age']} updates)"
+    return (f"analytics   census @ update {d['update']}{age}, "
+            f"dominant gid {d['gid']} depth {d['depth']}, "
+            f"tasks {d['tasks_mask']:#x} ({d['tasks_held']} held)")
+
+
 def status_main(data_dir: str, max_age: float | None = None) -> int:
     """`python -m avida_tpu --status DIR [--max-age SEC]`: print the
     last heartbeat.  Exit status is machine-consumable so external
@@ -194,6 +228,9 @@ def status_main(data_dir: str, max_age: float | None = None) -> int:
         print(f"supervisor  boots {int(sup.get('avida_supervisor_boots_total', 0))}, "
               f"failures {int(fails)}, "
               f"budget {int(sup.get('avida_supervisor_retry_budget', 0))}")
+    ana_path = os.path.join(data_dir, "analytics.prom")
+    if os.path.exists(ana_path):
+        print(format_analytics_status(metrics, read_metrics(ana_path)))
     if max_age is not None:
         hb = metrics.get("avida_heartbeat_timestamp_seconds")
         age = None if hb is None else time.time() - hb

@@ -139,6 +139,58 @@ def measure_trace_drain(cap=4096, n_updates=16, reps=5):
     return ms
 
 
+def measure_analytics(genotypes=12, reps=1, mem=320):
+    """census_ms / knockout_ms of the analytics pipeline's two batched
+    passes (analyze/pipeline.py) on a synthetic genotype table: a cold
+    census over `genotypes` distinct ancestor variants (fresh
+    content-keyed cache each rep, so every genotype pays a sandbox
+    gestation -- the worst case; live incremental refreshes only pay for
+    NEW genotypes) and one full per-site knockout sweep of the stock
+    ancestor.  Compile time is excluded by a warm pass; reps vary the
+    sandbox seed so no dispatch repeats an input (module-docstring
+    caveat).  bench.py's BENCH_ANALYZE=1 reports both fields."""
+    import time
+
+    import numpy as np
+
+    from avida_tpu.analyze.pipeline import knockout_profile
+    from avida_tpu.config import AvidaConfig
+    from avida_tpu.config.environment import default_logic9_environment
+    from avida_tpu.config.instset import default_instset
+    from avida_tpu.core.state import make_world_params
+    from avida_tpu.systematics.test_metrics import GenomeTestMetrics
+    from avida_tpu.world import default_ancestor
+
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 1
+    cfg.WORLD_Y = 1
+    cfg.TPU_MAX_MEMORY = mem
+    iset = default_instset()
+    params = make_world_params(cfg, iset, default_logic9_environment())
+    anc = default_ancestor(iset)
+    L = len(anc)
+    buf = np.zeros((genotypes, params.max_memory), np.int8)
+    lens = np.full(genotypes, L, np.int32)
+    for i in range(genotypes):
+        buf[i, :L] = anc
+        if i:                          # single-site variants of the stock
+            site = 10 + (i % 60)       # replicator (mostly viable)
+            buf[i, site] = (int(anc[site]) + i) % params.num_insts
+    base = GenomeTestMetrics(params).get_records(buf, lens)[0]["fitness"]
+    t0 = time.perf_counter()
+    for r in range(reps):
+        GenomeTestMetrics(params).get_records(buf, lens, seed=r + 1)
+    census_ms = (time.perf_counter() - t0) * 1e3 / reps
+
+    knockout_profile(params, anc, base)                   # compile warm
+    t0 = time.perf_counter()
+    for r in range(reps):
+        knockout_profile(params, anc, base, seed=r + 1)
+    knockout_ms = (time.perf_counter() - t0) * 1e3 / reps
+    return {"census_ms": round(census_ms, 2),
+            "knockout_ms": round(knockout_ms, 2)}
+
+
 def _timeit_chain(fn, st, key, u0, reps):
     """Mean wall time of the FUSED update over a chain of evolving states
     (distinct inputs per call; one fence at the end of the chain)."""

@@ -65,8 +65,16 @@ def trim_update_records(path: str, max_update: int):
     with the index of the update being executed, so a checkpoint at
     update N owns records 0..N-1 and the resumed run re-emits from N.
     Flight-recorder {"record": "trace"} lines carry the same per-update
-    labeling and trim identically.  Meta/event records carry no update
-    number and are kept.  Atomic rewrite; missing file is a no-op."""
+    labeling and trim identically.  Analytics census records
+    ({"record": "analytics"}, analyze/pipeline.py) trim on a STRICT
+    cutoff instead (update > max_update): a census is labeled with the
+    checkpoint boundary it DESCRIBES, so the census at the restored
+    update is valid evidence of exactly the state the resume restores
+    (and is never re-emitted until the next boundary), while censuses
+    past it describe a rolled-back timeline and must not survive as
+    evidence of what the replayed run evolved.  Meta/event records
+    carry no update number and are kept.  Atomic rewrite; missing file
+    is a no-op."""
     if not os.path.exists(path):
         return
     kept = []
@@ -78,8 +86,10 @@ def trim_update_records(path: str, max_update: int):
             except json.JSONDecodeError:
                 dropped += 1          # torn tail line from the crash
                 continue
-            if rec.get("record") in ("update", "trace") \
-                    and int(rec.get("update", -1)) >= max_update:
+            kind = rec.get("record")
+            u = int(rec.get("update", -1))
+            if (kind in ("update", "trace") and u >= max_update) \
+                    or (kind == "analytics" and u > max_update):
                 dropped += 1
                 continue
             kept.append(line)

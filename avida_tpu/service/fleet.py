@@ -75,7 +75,8 @@ import subprocess
 import sys
 import time
 
-from avida_tpu.observability.exporter import (read_metrics,
+from avida_tpu.observability.exporter import (analytics_census_digest,
+                                              read_metrics,
                                               render_families,
                                               write_metrics)
 from avida_tpu.observability.runlog import append_record, read_records
@@ -912,6 +913,19 @@ def format_fleet_status(spool: str, now: float | None = None) -> str:
                             if k.startswith(
                                 "avida_supervisor_failures_total")))
             extra = f"  (boots {boots}, failures {fails})"
+        ana_prom = os.path.join(spool, name, "data", "analytics.prom")
+        if os.path.exists(ana_prom):
+            # per-tenant census column (analyze/pipeline.py live mode):
+            # dominant lineage depth / census age / tasks-held, derived
+            # by the same digest helper as the single-run --status line
+            run_prom = os.path.join(spool, name, "data", "metrics.prom")
+            d = analytics_census_digest(
+                read_metrics(ana_prom),
+                read_metrics(run_prom) if os.path.exists(run_prom)
+                else None)
+            age = "?" if d["age"] is None else str(d["age"])
+            extra += (f"  census u{d['update']} age {age}u "
+                      f"depth {d['depth']} tasks {d['tasks_held']}")
         lines.append(f"  {name:<24} {st}{extra}")
     return "\n".join(lines) if lines else f"empty spool {spool!r}"
 

@@ -1,12 +1,14 @@
 """Cached per-genotype Test-CPU metrics.
 
 TPU-native equivalent of Systematics::GenomeTestMetrics
-(avida-core/source/systematics/GenomeTestMetrics.cc): sandbox fitness for
-a genotype is computed once and memoized by genome content, so reversion
-tests (cHardwareBase::Divide_TestFitnessMeasures cc:866) and analyze-mode
-recalculation don't re-run gestations for genotypes already scored.
-Uncached genotypes are evaluated in ONE batched Test-CPU run
-(analyze/testcpu.evaluate_genomes).
+(avida-core/source/systematics/GenomeTestMetrics.cc): sandbox metrics for
+a genotype are computed once and memoized by genome content, so reversion
+tests (cHardwareBase::Divide_TestFitnessMeasures cc:866), analyze-mode
+recalculation and the checkpoint-native census (analyze/pipeline.py)
+don't re-run gestations for genotypes already scored.  Uncached genotypes
+are evaluated in ONE batched Test-CPU run
+(analyze/testcpu.evaluate_genomes, which bucket-pads the batch so repeat
+sweeps reuse O(log G) compiled gestation programs).
 """
 
 from __future__ import annotations
@@ -15,35 +17,74 @@ import numpy as np
 
 
 class GenomeTestMetrics:
-    """Host-side genome-bytes -> (viable, fitness, gestation) cache."""
+    """Host-side (genome bytes, seed) -> sandbox-record cache.
+
+    A record is {"viable": bool, "fitness": float (0 when inviable),
+    "gestation": int, "merit": float, "tasks": int64[R] task counts at
+    divide} -- everything the census/knockout/lineage passes and the
+    reversion test read."""
 
     def __init__(self, params):
         self.params = params
-        self._cache: dict[bytes, tuple[bool, float, int]] = {}
+        self._cache: dict[bytes, dict] = {}
+        self.evaluations = 0    # genotypes actually run in the sandbox
 
     def __len__(self):
         return len(self._cache)
 
-    def get_fitness(self, genomes: np.ndarray, lens: np.ndarray,
-                    seed: int = 0) -> np.ndarray:
-        """f64[G] sandbox fitness for each genome row (0 = inviable)."""
+    def get_records(self, genomes: np.ndarray, lens: np.ndarray,
+                    seed: int = 0) -> list:
+        """One cached record per genome row, content-keyed.  All uncached
+        DISTINCT genotypes are evaluated in a single batched Test-CPU
+        run; repeat genotypes (the common case in census sweeps) cost
+        nothing."""
         from avida_tpu.analyze.testcpu import evaluate_genomes
 
-        keys = [genomes[i, : int(lens[i])].tobytes()
+        # cache key includes the seed: sandbox inputs are seed-derived,
+        # so records computed under one seed must never answer a query
+        # for another (every in-tree caller holds one seed per instance,
+        # but the API advertises the parameter)
+        keys = [(genomes[i, : int(lens[i])].tobytes(), int(seed))
                 for i in range(genomes.shape[0])]
+        # every uncached row gets its own sandbox lane, DUPLICATES
+        # INCLUDED (last write wins): sandbox inputs are LANE-indexed
+        # (testcpu._sandbox_inputs -- batch-size-invariant but still a
+        # function of the lane number), so preserving the historical
+        # row-assignment discipline keeps a given call sequence scoring
+        # deterministically across this cache-layer refactor.  Note the
+        # PR-9 one-time re-base: the sandbox input construction itself
+        # changed (per-lane fold_in replaced the flat batch draw, see
+        # _sandbox_inputs), so sandbox scores -- and reversion-enabled
+        # trajectories -- are NOT comparable with pre-PR-9 builds at
+        # the same seed; within this build they are fully
+        # deterministic.  Census callers pass unique genotypes, so no
+        # lane is wasted where it matters.
         miss = [i for i, k in enumerate(keys) if k not in self._cache]
         if miss:
-            # pad the batch to a power of two so the jitted gestation run
-            # compiles O(log N) shapes, not one per distinct miss count
-            G = 1 << max(len(miss) - 1, 0).bit_length()
+            G = len(miss)
             sub = np.zeros((G, self.params.max_memory), np.int8)
             sub_lens = np.zeros(G, np.int32)
             for j, i in enumerate(miss):
-                sub[j, : int(lens[i])] = genomes[i, : int(lens[i])]
-                sub_lens[j] = lens[i]
-            res = evaluate_genomes(self.params, sub, sub_lens, seed=seed)
+                n = int(lens[i])
+                sub[j, :n] = genomes[i, :n]
+                sub_lens[j] = n
+            res = evaluate_genomes(self.params, sub, sub_lens,
+                                   seed=int(seed))
+            self.evaluations += G
             for j, i in enumerate(miss):
-                fit = float(res.fitness[j]) if bool(res.viable[j]) else 0.0
-                self._cache[keys[i]] = (bool(res.viable[j]), fit,
-                                        int(res.gestation_time[j]))
-        return np.asarray([self._cache[k][1] for k in keys], np.float64)
+                viable = bool(res.viable[j])
+                self._cache[keys[i]] = {
+                    "viable": viable,
+                    "fitness": float(res.fitness[j]) if viable else 0.0,
+                    "gestation": int(res.gestation_time[j]),
+                    "merit": float(res.merit[j]),
+                    "tasks": np.asarray(res.task_counts[j], np.int64),
+                }
+        return [self._cache[k] for k in keys]
+
+    def get_fitness(self, genomes: np.ndarray, lens: np.ndarray,
+                    seed: int = 0) -> np.ndarray:
+        """f64[G] sandbox fitness for each genome row (0 = inviable)."""
+        return np.asarray(
+            [r["fitness"] for r in self.get_records(genomes, lens, seed)],
+            np.float64)

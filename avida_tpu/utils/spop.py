@@ -129,6 +129,7 @@ def load_population(path: str, params, key):
                 orgs.append({"cell": c, "genome": seq, "merit": merit,
                              "gest_offset": off, "generation": gen_born,
                              "id": int(t[0]),
+                             "depth": int(t[13]),
                              "parent": int(parents.split(",")[0])
                              if parents not in ("(none)", "") else -1})
     return orgs

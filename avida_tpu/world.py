@@ -248,6 +248,19 @@ class World:
             from avida_tpu.observability.exporter import MetricsExporter
             self.exporter = MetricsExporter(self)
 
+        # in-run analytics (analyze/pipeline.py): with TPU_ANALYTICS=1,
+        # World.run refreshes an incremental phenotype census (+ the
+        # dominant-lineage replay) at checkpoint boundaries and run
+        # exit, publishing analytics.prom / analysis/analytics.jsonl so
+        # `--status` answers "what evolved?" no staler than one
+        # checkpoint interval.  Pure host read at already-synced
+        # boundaries: no PRNG draw, no state write -- trajectories are
+        # bit-identical with it on or off.
+        self.analytics = None
+        if int(cfg.get("TPU_ANALYTICS", 0)):
+            from avida_tpu.analyze.pipeline import LiveAnalytics
+            self.analytics = LiveAnalytics(self)
+
         # deterministic fault injection (utils/faultinject.py): None in
         # every production run -- with TPU_FAULT unset no hook fires and
         # the update program is untouched (the `nan:` kind rides
@@ -1212,6 +1225,21 @@ class World:
         from avida_tpu.observability.runlog import trim_update_records
         trim_update_records(os.path.join(self.data_dir, "telemetry.jsonl"),
                             update)
+        # analytics census continuity: censuses PAST the restored
+        # update describe a rolled-back timeline (the resumed run may
+        # evolve differently) -- trim them so downstream consumers
+        # (compare_equ's census-native side) never count a dead
+        # branch's discovery; the census AT the restored update
+        # describes exactly the restored state and is kept (strict
+        # cutoff for analytics records inside trim_update_records).
+        # The rotation aside is trimmed too: a 16MB rotation firing
+        # between the restored generation and the crash would
+        # otherwise preserve dead-branch censuses that
+        # native_from_analytics explicitly reads (journal + '.1').
+        ana_log = os.path.join(self.data_dir, "analysis",
+                               "analytics.jsonl")
+        trim_update_records(ana_log, update)
+        trim_update_records(ana_log + ".1", update)
         if audit is None:
             audit = bool(int(self.cfg.get("TPU_CKPT_AUDIT", 1)))
         if audit:
@@ -1326,6 +1354,11 @@ class World:
                         and self.update - last_ckpt >= ckpt_every:
                     self.save_checkpoint(ckpt_base)
                     last_ckpt = self.update
+                    if self.analytics is not None:
+                        # checkpoint boundary = census boundary: the
+                        # save just synced the host view, so the
+                        # incremental census reads it for free
+                        self.analytics.refresh(self)
                 if self.faults is not None:
                     # injected failures fire at chunk boundaries, AFTER
                     # any auto-save due at the same boundary (so e.g.
@@ -1349,6 +1382,10 @@ class World:
                 # reads the end state without re-running the world
                 self.save_checkpoint(ckpt_base)
             self.preempted = self._preempt
+            if self.analytics is not None and self.state is not None:
+                # exit census: the freshness contract holds through the
+                # end of the run (durable -- this is the last word)
+                self.analytics.refresh(self, durable=True)
             if self.exporter is not None and self.state is not None:
                 self.exporter.export(self)    # final heartbeat (preempted=1)
         finally:

@@ -230,6 +230,8 @@ def main():
                                           updates=64 if on_tpu else 16))
     if os.environ.get("BENCH_SUPERVISE", "0") == "1":
         line.update(supervisor_restart_fields())
+    if os.environ.get("BENCH_ANALYZE", "0") == "1":
+        line.update(analytics_fields())
     if os.environ.get("BENCH_PHASES", "1") != "0":
         phases = phase_breakdown(world)
         line["phases"] = phases
@@ -276,6 +278,19 @@ def supervisor_restart_fields():
         dt = time.perf_counter() - t0
         assert rc == 1 and sup.boots == cycles + 1
     return {"supervisor_restart_ms": round(dt / sup.boots * 1e3, 2)}
+
+
+def analytics_fields():
+    """BENCH_ANALYZE=1: the run-analytics tax in the perf trajectory --
+    census_ms (cold batched phenotype census over a synthetic genotype
+    table; live incremental refreshes only pay this for NEW genotypes)
+    and knockout_ms (one full per-site knockout sweep of the stock
+    ancestor), both through observability/harness.measure_analytics.
+    Measured after -- and without perturbing -- the headline numbers;
+    the analytics pipeline runs in separate jits, so nothing here
+    touches the update program."""
+    from avida_tpu.observability.harness import measure_analytics
+    return measure_analytics()
 
 
 def ckpt_audit_overhead(params, st):

@@ -2,6 +2,9 @@
 
 Usage:
     python scripts/ckpt_tool.py <ckpt_dir>            # list generations
+    python scripts/ckpt_tool.py <ckpt_dir> --detail   # + census triage
+                                                      # column per
+                                                      # generation
     python scripts/ckpt_tool.py <ckpt_dir> --verify   # full CRC sweep
     python scripts/ckpt_tool.py <ckpt_dir> --manifest # dump newest manifest
     python scripts/ckpt_tool.py <ckpt_dir> --prune [--keep N]
@@ -36,6 +39,13 @@ it removes; exit 0.
 job, service/fleet.py) and runs the same sweep on every directory that
 looks like a checkpoint dir -- one janitor pass for an entire sweep's
 debris instead of one invocation per job.
+
+--detail appends a triage column sourced from the analytics pipeline's
+cheap reader (analyze/pipeline.checkpoint_detail: manifest + two state
+arrays + the systematics sidecar, NO Test-CPU evaluation, no jax):
+dominant genotype id/units/depth, live organism count and the
+tasks-held bitmask -- so spool triage ("which of these 40 jobs evolved
+EQU?") doesn't require a full `--analyze` run per checkpoint.
 """
 
 from __future__ import annotations
@@ -158,6 +168,7 @@ def main(argv=None) -> int:
     base = args[0]
     do_verify = "--verify" in argv
     do_manifest = "--manifest" in argv
+    do_detail = "--detail" in argv
 
     if "--all" in argv and "--prune" not in argv:
         print("--all only applies to --prune")
@@ -219,9 +230,25 @@ def main(argv=None) -> int:
         any_ok = True
         saved = time.strftime("%Y-%m-%d %H:%M:%S",
                               time.localtime(manifest.get("saved_at", 0)))
+        detail = ""
+        if do_detail:
+            from avida_tpu.analyze.pipeline import checkpoint_detail
+            try:
+                d = checkpoint_detail(path)
+            except Exception as e:      # triage stays best-effort: a
+                d = None                # bad sidecar must not kill list
+                detail = f", detail unavailable ({e})"
+            if d is not None:
+                dom = ("-" if d["dominant_gid"] is None else
+                       f"gid {d['dominant_gid']} x{d['dominant_units']} "
+                       f"depth {d['dominant_depth']}")
+                mask = d["tasks_mask"]
+                detail = (f", live {d['live']}, dominant {dom}, tasks "
+                          + ("-" if mask is None else
+                             f"{mask:#x} ({bin(mask).count('1')})"))
         print(f"{name}: update {manifest.get('update')}, saved {saved}, "
               f"{len(manifest.get('arrays', {}))} arrays, "
-              f"{_dir_bytes(path) / 1e6:.2f} MB, {status}")
+              f"{_dir_bytes(path) / 1e6:.2f} MB, {status}{detail}")
 
     if do_manifest and any_ok:
         for path in reversed(gens):

@@ -1,10 +1,30 @@
 """Reference-vs-TPU updates-to-EQU distribution comparison.
 
 Inputs:
-  - refbuild/ref_equ_results.txt  (reference CPU build, one "seed update"
-    line per seed; -1 = EQU not discovered within the update budget)
-  - an EQU_r*.json from scripts/equ_harness.py (TPU build; per-seed
-    first_task_update.equ, null = censored)
+  - refbuild/ref_equ_results.txt  (reference CPU build, one
+    "seed first_equ last_update" line per seed from
+    scripts/harvest_ref_equ.py; -1 = EQU not discovered; resumable over
+    partial seed sweeps via that script's --merge)
+  - the native side, either of:
+      * an EQU_r*.json from scripts/equ_harness.py (per-seed
+        first_task_update.equ, null = censored), or
+      * run-analytics output (analyze/pipeline.py): a single
+        analytics.jsonl, a run data dir, or a sweep/fleet-spool root --
+        every analytics.jsonl found below it is one run, and the first
+        {"record":"analytics"} census whose tasks_held_mask carries the
+        EQU bit (bit 8 in the stock logic-9 ladder; --equ-bit overrides)
+        is that run's discovery update.
+
+        SEMANTICS CAVEAT (labeled in the output as native_semantics):
+        the census mask is the SANDBOX Test-CPU capability of live
+        genotypes, while the reference side (and equ_harness) records
+        live in-world task performance; tasks are input-dependent, so
+        the two can disagree for individual genotypes and the census
+        update is NOT a guaranteed late bound -- census granularity
+        (one checkpoint interval) additionally quantizes it.  Use the
+        census path for coarse sweep triage; publishable comparisons
+        (EQU_COMPARE_r*.json) should use equ_harness live data, which
+        measures the same event as the reference.
 
 Both sides are right-censored at their update budget, so the primary test
 is a Mann-Whitney U on the censored values with censored runs ranked
@@ -13,13 +33,21 @@ SciPy is not in the image; the U statistic, its normal approximation, and
 the hypergeometric tail are computed directly (they are exact enough at
 n = 20 + 20).
 
-Usage: python scripts/compare_equ.py refbuild/ref_equ_results.txt EQU_r05.json
+The output labels its horizon explicitly (censor_budget_updates plus the
+per-side non-discovering horizons) so a partially-extended sweep is
+never mistaken for a full 20k-update comparison.
+
+Usage:
+    python scripts/compare_equ.py refbuild/ref_equ_results.txt EQU_r05.json
+    python scripts/compare_equ.py ref_results.txt SWEEP_DIR \
+        [--equ-bit 8] [--out EQU_COMPARE_rN.json] [--note "..."]
 """
 
 from __future__ import annotations
 
 import json
 import math
+import os
 import sys
 
 
@@ -81,8 +109,89 @@ def fisher_exact(a_hit, a_n, b_hit, b_n):
                if (p := prob(k)) <= p_obs + 1e-12)
 
 
+def _analytics_journals(path: str) -> list:
+    """Every analytics.jsonl at or below `path` (one per run): a single
+    file, a run's data dir, a sweep root or a fleet spool all work."""
+    if os.path.isfile(path):
+        return [path]
+    out = set()
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            # a run killed inside append_record's rotation window can
+            # leave ONLY the .1 aside; it is still that run's journal
+            # (native_from_analytics reads the pair), so match both
+            if f in ("analytics.jsonl", "analytics.jsonl.1"):
+                out.add(os.path.join(root, "analytics.jsonl"))
+    return sorted(out)
+
+
+def native_from_analytics(path: str, equ_bit: int = 8) -> list:
+    """Native-side runs from run-analytics output (analyze/pipeline.py),
+    shaped like equ_harness results: one dict per run with
+    first_task_update.equ (the update of the FIRST census holding the
+    EQU bit; None = not seen) and updates_run (the last census's update,
+    the run's censoring horizon).  Reads the rotation pair
+    (analytics.jsonl.1 then analytics.jsonl, runlog.append_record
+    semantics) without importing the engine."""
+    runs = []
+    for journal in _analytics_journals(path):
+        first, last, n_records = None, 0, 0
+        for p in (journal + ".1", journal):
+            if not os.path.exists(p):
+                continue        # rotation pair: either side may be absent
+            for line in open(p):
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue            # torn tail from a crash
+                if rec.get("record") != "analytics":
+                    continue
+                n_records += 1
+                u = int(rec.get("update", 0))
+                last = max(last, u)
+                if first is None \
+                        and int(rec.get("tasks_held_mask", 0)) \
+                        & (1 << equ_bit):
+                    first = u
+        if n_records == 0:
+            # a journal with no census yet (freshly started run, torn
+            # tail) is NOT an observation: including it as updates_run=0
+            # would collapse the common censor budget to 0 and
+            # degenerate the whole comparison
+            print(f"[compare_equ] skipping {journal}: no analytics "
+                  f"records yet", file=sys.stderr)
+            continue
+        runs.append({"source": journal,
+                     "first_task_update": {"equ": first},
+                     "updates_run": last})
+    return runs
+
+
 def main():
-    ref_path, tpu_path = sys.argv[1], sys.argv[2]
+    argv = list(sys.argv[1:])
+    out_path = None
+    note = None
+    equ_bit = 8
+    for flag in ("--out", "--note", "--equ-bit"):
+        if flag in argv:
+            i = argv.index(flag)
+            if i + 1 >= len(argv):
+                print(f"{flag} needs an argument", file=sys.stderr)
+                return 2
+            val = argv[i + 1]
+            del argv[i:i + 2]
+            if flag == "--out":
+                out_path = val
+            elif flag == "--note":
+                note = val
+            else:
+                equ_bit = int(val)
+    if len(argv) < 2:
+        print("usage: compare_equ.py REF_RESULTS NATIVE_SIDE "
+              "[--out FILE] [--note TEXT] [--equ-bit N]",
+              file=sys.stderr)
+        return 2
+    ref_path, tpu_path = argv[0], argv[1]
     ref = {}
     ref_last = {}
     for line in open(ref_path):
@@ -94,17 +203,36 @@ def main():
             # censored EARLY and set the common comparison budget
             ref_last[int(parts[0])] = (int(parts[2]) if len(parts) >= 3
                                        else 20000)
-    tpu_runs = json.load(open(tpu_path))
-    if isinstance(tpu_runs, dict):
-        tpu_runs = tpu_runs.get("runs", tpu_runs.get("results", []))
+    if os.path.isdir(tpu_path) or tpu_path.endswith(".jsonl"):
+        tpu_runs = native_from_analytics(tpu_path, equ_bit=equ_bit)
+        native_semantics = ("sandbox census capability "
+                            "(analytics tasks_held_mask; NOT the same "
+                            "event the reference side measures)")
+    else:
+        tpu_runs = json.load(open(tpu_path))
+        if isinstance(tpu_runs, dict):
+            tpu_runs = tpu_runs.get("runs", tpu_runs.get("results", []))
+        native_semantics = "live in-world first-task update (equ_harness)"
+    if not tpu_runs:
+        print(f"[compare_equ] no native-side runs found in {tpu_path!r} "
+              f"(no analytics.jsonl with census records / empty results "
+              f"file) -- nothing to compare", file=sys.stderr)
+        return 2
+    if not ref:
+        print(f"[compare_equ] no reference results in {ref_path!r}",
+              file=sys.stderr)
+        return 2
 
     # censor BOTH sides at the smallest horizon among NON-discovering
     # runs (a run that found EQU then stopped is an observed event, not a
-    # censoring bound; equ_harness exits each seed at discovery)
-    ref_nd = [ref_last[s] for s, v in ref.items() if v < 0] or [20000]
+    # censoring bound; equ_harness exits each seed at discovery).  The
+    # [20000] fallback exists only to keep min() defined when a side has
+    # no non-discovering runs -- the report shows the REAL (possibly
+    # empty) horizon lists, never the placeholder
+    ref_nd = [ref_last[s] for s, v in ref.items() if v < 0]
     tpu_nd = [r.get("updates_run", 20000) for r in tpu_runs
-              if r["first_task_update"]["equ"] is None] or [20000]
-    budget = min(min(ref_nd), min(tpu_nd), 20000)
+              if r["first_task_update"]["equ"] is None]
+    budget = min(min(ref_nd or [20000]), min(tpu_nd or [20000]), 20000)
 
     ref_vals = [v if 0 < v <= budget else budget + 1 for v in ref.values()]
     ref_hits = sum(1 for v in ref.values() if 0 < v <= budget)
@@ -127,6 +255,15 @@ def main():
 
     out = {
         "censor_budget_updates": budget,
+        "horizon": {
+            "target_updates": 20000,
+            "reference_nondiscovering_horizons": sorted(ref_nd),
+            "tpu_nondiscovering_horizons": sorted(tpu_nd),
+            "at_full_horizon": budget >= 20000,
+        },
+        "reference_source": ref_path,
+        "native_source": tpu_path,
+        "native_semantics": native_semantics,
         "reference": {"n": len(ref_vals), "equ_discovered": ref_hits,
                       "median_censored": med(ref_vals)},
         "tpu": {"n": len(tpu_vals), "equ_discovered": tpu_hits,
@@ -138,8 +275,14 @@ def main():
                        "alpha=0.05" if p_u > 0.05 and p_f > 0.05 else
                        "distributions differ at alpha=0.05"),
     }
-    print(json.dumps(out, indent=2))
+    if note:
+        out["note"] = note
+    text = json.dumps(out, indent=2)
+    print(text)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(text + "\n")
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

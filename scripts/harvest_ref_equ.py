@@ -7,7 +7,21 @@ Censoring note for scripts/compare_equ.py: a seed whose last_update is
 below the comparison budget and first_equ is -1 is censored EARLY -- the
 comparison should either wait or censor BOTH sides at min(last_update).
 
-Usage: python scripts/harvest_ref_equ.py [ref_equ_dir] > results.txt
+RESUMABLE over partial seed sweeps: `--merge PREV.txt` folds a previous
+harvest into this one, so a sweep can be extended seed-batch by
+seed-batch (or its run dirs archived away) without losing earlier
+results.  Per seed, the side whose run reached the LATER update wins --
+re-harvesting an extended run supersedes the old line (its tasks.dat
+still contains the discovery, so first_equ survives a re-scan), and a
+seed whose run dir is gone keeps its previous line.  A seed that flips
+from discovered back to -1 can only mean its run dir was REPLACED by a
+different run; the merge takes the longer-horizon side but warns on
+stderr so the operator notices the substitution.
+
+Usage:
+    python scripts/harvest_ref_equ.py [ref_equ_dir] > results.txt
+    python scripts/harvest_ref_equ.py [ref_equ_dir] --merge results.txt \
+        > results_new.txt
 """
 
 from __future__ import annotations
@@ -16,8 +30,11 @@ import os
 import sys
 
 
-def main():
-    base = sys.argv[1] if len(sys.argv) > 1 else "refbuild/ref_equ"
+def harvest_dir(base: str) -> dict:
+    """{seed: (first_equ_update, last_update)} from a sweep directory."""
+    out = {}
+    if not os.path.isdir(base):
+        return out
     for name in sorted(os.listdir(base)):
         if not name.startswith("seed"):
             continue
@@ -36,8 +53,62 @@ def main():
             last = int(parts[0])
             if first < 0 and int(parts[9]) > 0:
                 first = last
+        out[seed] = (first, last)
+    return out
+
+
+def read_results(path: str) -> dict:
+    """Parse a previous harvest (2- or 3-column lines).  A legacy
+    2-column file carries no horizon; default it to the 20000-update
+    budget those sweeps ran at -- the same default compare_equ.py
+    applies -- so merging one can never collapse the downstream censor
+    budget to 0."""
+    out = {}
+    for line in open(path):
+        parts = line.split()
+        if len(parts) >= 2:
+            out[parts[0]] = (int(parts[1]),
+                             int(parts[2]) if len(parts) >= 3 else 20000)
+    return out
+
+
+def merge(cur: dict, prev: dict) -> dict:
+    """Per seed, the longer-horizon side wins; a discovered first_equ is
+    never replaced by -1 at the same horizon (partial re-harvest of a
+    truncated tasks.dat).  A longer-horizon re-harvest that LOSES a
+    previous discovery means the seed dir now holds a different run --
+    taken, but loudly."""
+    out = dict(prev)
+    for seed, (first, last) in cur.items():
+        pf, pl = out.get(seed, (-1, -1))
+        if last > pl or (last == pl and first >= 0):
+            if first < 0 <= pf:
+                print(f"[harvest_ref_equ] warning: seed {seed} was "
+                      f"discovered at {pf} (horizon {pl}) but the "
+                      f"current dir reaches {last} with no discovery -- "
+                      f"run dir replaced? taking the current side",
+                      file=sys.stderr)
+            out[seed] = (first, last)
+    return out
+
+
+def main():
+    argv = list(sys.argv[1:])
+    prev = {}
+    if "--merge" in argv:
+        i = argv.index("--merge")
+        if i + 1 >= len(argv):
+            print("--merge needs a previous results file", file=sys.stderr)
+            return 2
+        prev = read_results(argv[i + 1])
+        del argv[i:i + 2]
+    base = argv[0] if argv else "refbuild/ref_equ"
+    results = merge(harvest_dir(base), prev)
+    for seed in sorted(results, key=lambda s: (len(s), s)):
+        first, last = results[seed]
         print(f"{seed} {first} {last}")
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -34,7 +34,11 @@ Phase rows do not round-trip (the runlog's per-update phase dict is the
 source of truth for those).
 
 `summary` prints per-code event totals and the per-update event rate,
-the quick "what happened in this run" view.
+the quick "what happened in this run" view.  It also understands the
+analytics pipeline's `{"record": "analytics"}` lines
+(analyze/pipeline.py -- point it at DATA_DIR/analysis/analytics.jsonl):
+census cadence, genotypes evaluated, knockout sweeps and the last
+census digest ride the same summary.
 """
 
 from __future__ import annotations
@@ -68,10 +72,12 @@ _PHASE_ROW_BASE = 100
 _NOMINAL_MS = 1.0
 
 
-def read_runlog(path: str):
+def read_runlog(path: str, analytics: list | None = None):
     """(updates, traces, meta, drops): per-update phase records,
     per-update flight-recorder event lists, the meta record (or {}),
-    and per-update ring-overflow drop counts."""
+    and per-update ring-overflow drop counts.  When `analytics` is a
+    list, {"record": "analytics"} census records (analyze/pipeline.py)
+    are appended to it in file order."""
     updates, traces, meta = {}, {}, {}
     drops = {}
     with open(path) as f:
@@ -90,6 +96,8 @@ def read_runlog(path: str):
                     drops[u] = drops.get(u, 0) + int(rec["dropped"])
             elif kind == "meta":
                 meta = rec
+            elif kind == "analytics" and analytics is not None:
+                analytics.append(rec)
     return updates, traces, meta, drops
 
 
@@ -189,7 +197,8 @@ def from_chrome(path: str):
 
 
 def summary(path: str) -> str:
-    updates, traces, _, drops = read_runlog(path)
+    analytics = []
+    updates, traces, _, drops = read_runlog(path, analytics=analytics)
     totals = {}
     for evs in traces.values():
         for _, code, _ in evs:
@@ -204,6 +213,23 @@ def summary(path: str) -> str:
         lines.append(f"events dropped (overflow):  {sum(drops.values())}")
     for name in sorted(totals, key=totals.get, reverse=True):
         lines.append(f"  {name:<12} {totals[name]}")
+    if analytics:
+        last = analytics[-1]
+        dom = last.get("dominant") or {}
+        held = int(last.get("tasks_held_mask", 0))
+        lines += [
+            f"analytics records:          {len(analytics)} "
+            f"(censuses @ updates "
+            f"{analytics[0].get('update')}..{last.get('update')})",
+            f"  genotypes evaluated       "
+            f"{int(last.get('evaluated_total', 0))} total, "
+            f"{int(last.get('knockout_sweeps_total', 0))} knockout "
+            f"sweep(s)",
+            f"  last census               "
+            f"{int(last.get('genotypes', 0))} genotypes, dominant gid "
+            f"{dom.get('gid', -1)} depth {dom.get('depth', 0)}, tasks "
+            f"{held:#x} ({bin(held).count('1')} held)",
+        ]
     return "\n".join(lines)
 
 

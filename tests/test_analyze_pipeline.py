@@ -1,0 +1,307 @@
+"""Checkpoint-native analytics pipeline tests (analyze/pipeline.py).
+
+Covers: census/knockout/lineage over a real archived checkpoint,
+corrupt-generation fallback matching resume behavior, live-mode census
+freshness (within one checkpoint interval) with bit-identical
+trajectories analytics-on vs -off, the jaxpr-digest gate proving
+`--analyze` never perturbs update_step, the Test-CPU bucket-padding
+compile-count probe, and the ckpt_tool --detail triage column.
+
+The packed-chunk-era equivalence drill (TPU_PACKED_CHUNK=1 checkpoints
+analyze identically to per-update-era ones) runs chunked worlds on the
+interpret-mode Pallas path and is slow-marked.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "scripts"))
+
+from avida_tpu.analyze import pipeline as pl  # noqa: E402
+from avida_tpu.config import AvidaConfig  # noqa: E402
+from avida_tpu.world import World  # noqa: E402
+
+
+def _mk_world(tmp, seeds=(10, 11, 20, 21, 27), overrides=(), world=6,
+              max_memory=200, seed=3):
+    cfg = AvidaConfig()
+    cfg.WORLD_X = world
+    cfg.WORLD_Y = world
+    cfg.TPU_MAX_MEMORY = max_memory
+    cfg.RANDOM_SEED = seed
+    cfg.AVE_TIME_SLICE = 120
+    for k, v in overrides:
+        cfg.set(k, v)
+    w = World(cfg=cfg, data_dir=os.path.join(tmp, "data"))
+    for c in seeds:
+        w.inject(cell=c)
+    return w
+
+
+@pytest.fixture(scope="module")
+def archived_run(tmp_path_factory):
+    """A real archived run: 6x6 world, systematics on, two checkpoint
+    generations (updates 10 and 20) under <tmp>/ck."""
+    tmp = str(tmp_path_factory.mktemp("pipeline-run"))
+    ck = os.path.join(tmp, "ck")
+    # TPU_CKPT_AUDIT=0: skip the save-time invariant sweep's one-off
+    # compile (tier-1 budget; the PR-6 chaos-test precedent)
+    w = _mk_world(tmp, overrides=(("TPU_CKPT_DIR", ck),
+                                  ("TPU_CKPT_KEEP", 4),
+                                  ("TPU_CKPT_AUDIT", 0)))
+    for _ in range(10):
+        w.run_update()
+        w.update += 1
+    w.save_checkpoint(ck)
+    for _ in range(10):
+        w.run_update()
+        w.update += 1
+    w.save_checkpoint(ck)
+    return {"world": w, "ck": ck, "tmp": tmp, "update": w.update}
+
+
+def test_census_knockout_lineage_offline(archived_run, tmp_path):
+    w = archived_run["world"]
+    tables = pl.load_run_tables(archived_run["ck"])
+    assert tables.update == archived_run["update"]
+    assert not tables.rebuilt                      # sidecar present
+    assert tables.arbiter.num_genotypes == w.systematics.num_genotypes
+
+    pipe = pl.AnalyticsPipeline(w.params, w.environment.task_names(),
+                                str(tmp_path), knockout_top=1)
+    summary = pipe.run(tables)
+
+    # census: one row per live genotype, dominant first
+    census = pipe.census(tables)
+    assert len(census) == tables.arbiter.num_genotypes
+    dom = tables.arbiter.dominant()
+    assert census[0]["gid"] == dom.gid
+    assert summary["dominant"]["gid"] == dom.gid
+    assert summary["genotypes"] == len(census)
+    # the seed ancestor genotype (depth 0) must be viable at the known
+    # reference life history
+    root_rows = [r for r in census if r["depth"] == 0]
+    assert root_rows and any(
+        r["viable"] and r["gestation"] == 389 for r in root_rows)
+
+    # knockout: counts partition the genome
+    ko = pipe.knockouts(tables)
+    assert len(ko) == 1 and ko[0]["gid"] == dom.gid
+    assert (ko[0]["lethal"] + ko[0]["detrimental"] + ko[0]["neutral"]
+            + ko[0]["beneficial"]) == ko[0]["length"]
+    assert ko[0]["lethal"] > 0                     # copy loop / divide
+
+    # lineage: root-first walk ending at the dominant genotype
+    lin = pipe.lineage(tables)
+    assert lin[0]["parent_gid"] == -1 or lin[0]["depth"] == 0
+    assert lin[-1]["gid"] == dom.gid
+    assert [r["depth"] for r in lin] == list(range(len(lin)))
+
+    # the observability spine: tables + runlog + prom
+    for name in ("census.dat", "knockout.dat", "lineage.dat"):
+        assert os.path.exists(os.path.join(str(tmp_path), "analysis",
+                                           name))
+    recs = [json.loads(line) for line in
+            open(os.path.join(str(tmp_path), "analysis",
+                              "analytics.jsonl"))]
+    assert recs and recs[0]["record"] == "analytics"
+    assert recs[0]["update"] == tables.update
+    prom = open(os.path.join(str(tmp_path), "analytics.prom")).read()
+    assert f"avida_analytics_census_update {tables.update}" in prom
+    assert "avida_analytics_dominant_genotype_id" in prom
+
+    # repeat genotypes are content-keyed: a second census evaluates none
+    before = pipe.metrics.evaluations
+    pipe.census(tables)
+    assert pipe.metrics.evaluations == before
+
+    # trace_tool's summary understands the analytics records
+    import trace_tool
+    text = trace_tool.summary(os.path.join(str(tmp_path), "analysis",
+                                           "analytics.jsonl"))
+    assert "analytics records" in text and "dominant gid" in text
+
+
+def test_corrupt_generation_falls_back_like_resume(archived_run,
+                                                   tmp_path):
+    from avida_tpu.utils import checkpoint as ckpt_mod
+    ck = os.path.join(str(tmp_path), "ck")
+    shutil.copytree(archived_run["ck"], ck)
+    gens = ckpt_mod.list_generations(ck)
+    newest = gens[-1]
+    gpath = os.path.join(newest, "state.genome.npy")
+    blob = bytearray(open(gpath, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(gpath, "wb").write(bytes(blob))
+
+    skipped = []
+    tables = pl.load_run_tables(
+        ck, on_skip=lambda path, err: skipped.append(path))
+    # the pipeline lands on exactly the generation a resume would
+    resume_path, manifest = ckpt_mod.latest_valid(ck, on_skip=lambda *a: None)
+    assert tables.path == resume_path
+    assert tables.update == int(manifest["update"]) < archived_run["update"]
+    assert skipped == [newest]
+
+
+def test_analyze_cli_and_jaxpr_gate(archived_run, tmp_path, capsys):
+    """`--analyze CKPT_DIR` runs offline (no World.run) and the
+    update_step digest recorded AFTER the pipeline ran in this process
+    still matches the snapshot -- analytics never perturbs the
+    production update program."""
+    from avida_tpu.__main__ import main
+    # config matches the archived run's so the Test-CPU programs
+    # compiled by the earlier tests are reused (tier-1 budget)
+    rc = main(["--analyze", archived_run["ck"], "-d", str(tmp_path),
+               "-set", "WORLD_X", "6", "-set", "WORLD_Y", "6",
+               "-set", "AVE_TIME_SLICE", "120"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "census" in out and "dominant" in out
+    assert os.path.exists(os.path.join(str(tmp_path), "analytics.prom"))
+
+    import check_jaxpr
+    ok, msg = check_jaxpr.check()
+    assert ok, f"--analyze perturbed update_step: {msg}"
+
+
+def test_ckpt_tool_detail_column(archived_run, capsys):
+    import ckpt_tool
+    rc = ckpt_tool.main([archived_run["ck"], "--detail"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "dominant gid" in out and "live" in out and "tasks" in out
+
+
+def test_bucket_padding_compile_count():
+    """Distinct batch sizes inside one power-of-two bucket share a
+    single compiled gestation program (the trace-count probe)."""
+    from avida_tpu.analyze.testcpu import (evaluate_genomes,
+                                           gestation_trace_count)
+    from avida_tpu.config import default_instset
+    from avida_tpu.config.environment import default_logic9_environment
+    from avida_tpu.core.state import make_world_params
+
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 1
+    cfg.WORLD_Y = 1
+    cfg.TPU_MAX_MEMORY = 64
+    params = make_world_params(cfg, default_instset(),
+                               default_logic9_environment())
+
+    def batch(g):
+        genomes = np.zeros((g, 64), np.int8)
+        genomes[:, :4] = 2              # inert nop ball: cheap gestation
+        return genomes, np.full(g, 4, np.int32)
+
+    evaluate_genomes(params, *batch(8))            # warm bucket 8
+    c0 = gestation_trace_count()
+    for g in (5, 6, 7, 8):
+        r = evaluate_genomes(params, *batch(g))
+        assert r.viable.shape == (g,)              # sliced back to G
+        assert not r.viable.any()
+    assert gestation_trace_count() == c0           # no new compiles
+    evaluate_genomes(params, *batch(3))            # bucket 4: one more
+    assert gestation_trace_count() == c0 + 1
+
+
+def test_live_census_freshness_and_bit_identical(tmp_path):
+    """TPU_ANALYTICS=1: `--status` census is no staler than one
+    checkpoint interval on a finished run, and the evolved trajectory is
+    bit-identical with analytics on or off."""
+    def run(tag, analytics):
+        tmp = os.path.join(str(tmp_path), tag)
+        ck = os.path.join(tmp, "ck")
+        # TPU_MAX_STRETCH=1 keeps the run on the chunk-of-1 program the
+        # module fixture already compiled (host-side knob: same params,
+        # same jit cache entry) -- checkpoint boundaries land every
+        # update, the auto-save cadence stays TPU_CKPT_EVERY
+        ov = [("TPU_CKPT_DIR", ck), ("TPU_CKPT_EVERY", 8),
+              ("TPU_METRICS", 1), ("TPU_MAX_STRETCH", 1),
+              ("TPU_CKPT_AUDIT", 0)]
+        if analytics:
+            ov.append(("TPU_ANALYTICS", 1))
+        w = _mk_world(tmp, overrides=tuple(ov))
+        w.run(max_updates=20)
+        return w
+
+    wa = run("on", True)
+    wb = run("off", False)
+
+    # freshness: the census update is within one TPU_CKPT_EVERY of the
+    # run's final update (the exit refresh actually makes it equal)
+    from avida_tpu.observability.exporter import read_metrics
+    ana = read_metrics(os.path.join(wa.data_dir, "analytics.prom"))
+    assert ana["avida_analytics_census_update"] >= wa.update - 8
+    assert not os.path.exists(os.path.join(wb.data_dir, "analytics.prom"))
+
+    # --status shows the analytics line
+    from avida_tpu.observability.exporter import status_main
+    assert status_main(wa.data_dir) == 0
+
+    # bit-identical trajectories (nb_* rows past nb_count are drain
+    # scratch; compare the canonical fields)
+    import jax
+    for name in ("alive", "genome", "genome_len", "tape", "merit",
+                 "fitness", "gestation_time", "birth_update"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(wa.state, name)),
+            np.asarray(getattr(wb.state, name)), err_msg=name)
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(wa._run_key)),
+        np.asarray(jax.random.key_data(wb._run_key)))
+
+
+@pytest.mark.slow
+def test_packed_chunk_era_checkpoints_analyze_identically(tmp_path):
+    """A TPU_PACKED_CHUNK=1 run's checkpoints (packed-resident engine,
+    systematics off) analyze identically to the per-update engine's:
+    same census, same dominant, same tasks -- the pipeline is
+    engine-agnostic because the chunk-boundary unpack restores canonical
+    state before every save."""
+    def run(tag, packed):
+        tmp = os.path.join(str(tmp_path), tag)
+        ck = os.path.join(tmp, "ck")
+        w = _mk_world(tmp, overrides=(
+            ("TPU_USE_PALLAS", 1),          # interpret mode on CPU
+            ("TPU_SYSTEMATICS", 0),         # packed eligibility
+            ("TPU_LANE_PERM", 0),           # identity lanes on BOTH
+            # engines (packed residency forces identity; the per-update
+            # comparator must share the per-lane PRNG streams)
+            ("TPU_PACKED_CHUNK", packed),
+            ("TPU_CKPT_DIR", ck), ("TPU_CKPT_EVERY", 8),
+            ("TPU_CKPT_FINAL", 1), ("TPU_CKPT_AUDIT", 0)))
+        w.run(max_updates=16)
+        return w, ck
+
+    wp, ck_packed = run("packed", 1)
+    wu, ck_plain = run("plain", 0)
+
+    tp = pl.load_run_tables(ck_packed)
+    tu = pl.load_run_tables(ck_plain)
+    assert tp.update == tu.update
+    assert tp.rebuilt and tu.rebuilt       # no sidecar: rebuilt tables
+    np.testing.assert_array_equal(tp.alive, tu.alive)
+    np.testing.assert_array_equal(tp.genome, tu.genome)
+
+    pa = pl.AnalyticsPipeline(wp.params, wp.environment.task_names(),
+                              os.path.join(str(tmp_path), "a"),
+                              knockout_top=0)
+    pb = pl.AnalyticsPipeline(wu.params, wu.environment.task_names(),
+                              os.path.join(str(tmp_path), "b"),
+                              knockout_top=0)
+    ca = pa.run(tp, knockouts=False)
+    cb = pb.run(tu, knockouts=False)
+    for key in ("genotypes", "organisms", "tasks_held_mask",
+                "lineage_depth"):
+        assert ca[key] == cb[key], key
+    assert (ca["dominant"] or {}).get("fitness") == \
+        (cb["dominant"] or {}).get("fitness")
