@@ -67,6 +67,20 @@ TPU-build extras (no reference equivalent):
                      exiting.  `--status SPOOL` prints the aggregate
                      fleet summary; scripts/fleet_tool.py
                      submits/lists/cancels/requeues jobs.
+  --worlds SEEDS|MANIFEST
+                     multi-world device batching (parallel/multiworld.py):
+                     advance W static-equal worlds in ONE compiled
+                     update_scan.  SEEDS is a comma list ("7,8,9"; world
+                     k writes to DATA_DIR/w00k, checkpoints to
+                     TPU_CKPT_DIR/w00k); MANIFEST is a worlds.json path
+                     ([{"name","seed","data_dir","ckpt_dir"}] -- the
+                     fleet's device-lane packing writes one per
+                     coalesced batch).  Every world is bit-exact vs its
+                     solo run and writes solo-compatible per-world
+                     checkpoints; --resume restores all members aligned
+                     on one common update.  The root DATA_DIR gets the
+                     aggregate metrics.prom heartbeat plus per-world
+                     rows in multiworld.prom.
   --supervise        run under the self-healing supervisor
                      (service/supervisor.py): the remaining arguments
                      become the child run's command line (needs -d DIR
@@ -89,6 +103,109 @@ import argparse
 import os
 import sys
 import time
+
+
+def _worlds_main(args, overrides) -> int:
+    """--worlds: the multi-world batched run (parallel/multiworld.py)."""
+    from avida_tpu.parallel.multiworld import MultiWorld
+    from avida_tpu.service import EXIT_AUDIT, EXIT_CKPT
+    from avida_tpu.utils.audit import StateInvariantError
+    from avida_tpu.utils.checkpoint import (CheckpointError,
+                                            CheckpointMismatchError,
+                                            restore_candidates)
+
+    spec = args.worlds
+    try:
+        seeds = [int(s) for s in spec.split(",") if s.strip()]
+    except ValueError:
+        seeds = None
+    try:
+        if seeds:
+            mw = MultiWorld.from_seeds(seeds, config_dir=args.config_dir,
+                                       overrides=overrides,
+                                       data_dir=args.data_dir or "data")
+        elif os.path.exists(spec):
+            mw = MultiWorld.from_manifest(spec,
+                                          config_dir=args.config_dir,
+                                          overrides=overrides,
+                                          data_dir=args.data_dir)
+        else:
+            print(f"--worlds: {spec!r} is neither a comma seed list nor "
+                  f"a worlds.json manifest path", file=sys.stderr)
+            return 2
+    except ValueError as e:
+        # batch-ineligible config (telemetry/tracing/reversion/
+        # generation triggers, shared dirs, ...): a deterministic
+        # usage error, not a crash -- exit 2 with the reason on one
+        # line so a supervisor's log shows WHY instead of a traceback
+        print(f"[avida-tpu] --worlds refused: {e}", file=sys.stderr)
+        return 2
+
+    if args.resume is not None:
+        if args.resume:
+            # the solo path honors `--resume DIR`; a batch has one
+            # checkpoint dir PER WORLD (TPU_CKPT_DIR subdirs or the
+            # manifest's ckpt_dir entries), so a single override
+            # directory is ambiguous -- refuse loudly rather than
+            # silently resuming from somewhere else
+            print("[avida-tpu] --worlds resumes from each world's own "
+                  "checkpoint dir; --resume takes no directory here "
+                  "(set TPU_CKPT_DIR / the manifest ckpt_dir instead)",
+                  file=sys.stderr)
+            return 2
+        # restart-loop friendly like solo --resume: no member has any
+        # checkpoint -> start fresh; all members have one -> resume
+        # aligned; a PARTIAL set is unresumable (the batch cannot
+        # straddle updates) -> classified exit 66
+        have = [bool(w._ckpt_base() and restore_candidates(w._ckpt_base()))
+                for w in mw.worlds]
+        if all(have):
+            try:
+                at = mw.resume()
+            except CheckpointMismatchError:
+                raise
+            except CheckpointError as e:
+                print(f"[avida-tpu] resume failed: {e}", file=sys.stderr)
+                return EXIT_CKPT
+            except StateInvariantError as e:
+                print(f"[avida-tpu] {e}", file=sys.stderr)
+                return EXIT_AUDIT
+            if args.verbose:
+                print(f"resumed {mw.num_worlds} worlds at update {at}",
+                      file=sys.stderr)
+        elif any(have):
+            # a PARTIAL set means no update is common to every member
+            # (e.g. a crash landed between the batch's very first
+            # per-world saves).  Starting everyone fresh is bit-exact
+            # -- trajectories are pure functions of the seeds -- and
+            # self-heals the wedge a hard refusal would loop on; the
+            # loud warning covers the rarer lost-a-member's-dir case,
+            # where peers deliberately roll back with the batch
+            print("[avida-tpu] WARNING: only some worlds have "
+                  "checkpoints (torn first save, or a member's dir was "
+                  "lost); no common update exists, so the whole batch "
+                  "restarts FRESH -- deterministic replay makes this "
+                  "bit-exact for the torn-save case", file=sys.stderr)
+        else:
+            print("[avida-tpu] no checkpoints under any world; starting "
+                  "fresh", file=sys.stderr)
+
+    t0 = time.time()
+    try:
+        mw.run(max_updates=args.updates)
+    except StateInvariantError as e:
+        print(f"[avida-tpu] {e}", file=sys.stderr)
+        return EXIT_AUDIT
+    if mw.preempted:
+        print(f"[avida-tpu] preempted at update {mw.update}; "
+              f"{mw.num_worlds} world checkpoints saved", file=sys.stderr)
+        return 0
+    if args.verbose:
+        orgs = sum(w.num_organisms for w in mw.worlds)
+        print(f"{mw.update} updates x {mw.num_worlds} worlds, "
+              f"{orgs} organisms, {time.time() - t0:.1f}s",
+              file=sys.stderr)
+    return 0
 
 
 def main(argv=None):
@@ -119,6 +236,7 @@ def main(argv=None):
     p.add_argument("--resume", nargs="?", const="", default=None,
                    metavar="DIR")
     p.add_argument("--trace", action="store_true")
+    p.add_argument("--worlds", default=None, metavar="SEEDS|MANIFEST")
     p.add_argument("--status", default=None, metavar="DIR")
     p.add_argument("--max-age", type=float, default=None, metavar="SEC")
     args = p.parse_args(argv)
@@ -165,6 +283,9 @@ def main(argv=None):
         return analyze_ckpt(args.analyze, config_dir=args.config_dir,
                             overrides=overrides, data_dir=args.data_dir,
                             verbose=args.verbose)
+
+    if args.worlds is not None:
+        return _worlds_main(args, overrides)
 
     from avida_tpu.world import World
     world = World(config_dir=args.config_dir, overrides=overrides,

@@ -29,6 +29,7 @@ import time
 import numpy as np
 
 METRICS_FILE = "metrics.prom"
+MULTIWORLD_METRICS_FILE = "multiworld.prom"
 
 _HELP = {
     "avida_update": ("counter", "updates completed by the run"),
@@ -304,3 +305,112 @@ class MetricsExporter:
             "avida_heartbeat_timestamp_seconds": round(time.time(), 3),
         }
         return _render(values, snap["trace"])
+
+
+class MultiWorldExporter:
+    """Heartbeat for one MultiWorld batch (parallel/multiworld.py).
+
+    Publishes TWO files into the batch's root data dir:
+
+      metrics.prom      the standard single-run families carrying batch
+                        AGGREGATES (update = the shared grid counter;
+                        organisms / births / insts summed over worlds),
+                        so the supervisor watchdog, `--status DIR` and
+                        every other metrics.prom consumer read a
+                        batched child exactly like a solo run;
+      multiworld.prom   the per-world rows: the same families labeled
+                        {world="<name>"} -- one sample per batch member
+                        -- plus avida_multiworld_size.
+
+    Live publishes are deferred one chunk (capture [W]-vector refs at
+    boundary N, read them back at boundary N+1 when that chunk has
+    finished) exactly like MetricsExporter; export_final is the
+    synchronous exit/preempt flavor."""
+
+    _PER_WORLD = ("avida_update", "avida_organisms", "avida_births_total",
+                  "avida_deaths_last_update", "avida_generation_avg",
+                  "avida_time", "avida_insts_total", "avida_preempted")
+
+    def __init__(self, mw, path: str | None = None):
+        self.mw = mw
+        base = path or mw.data_dir
+        self.path = os.path.join(base, METRICS_FILE)
+        self.worlds_path = os.path.join(base, MULTIWORLD_METRICS_FILE)
+        self._pending = None
+
+    def export_deferred(self, mw=None):
+        m = mw or self.mw
+        prev, self._pending = self._pending, self._snapshot(m)
+        if prev is not None:
+            self._publish(prev, durable=False)
+
+    def export_final(self, mw=None):
+        m = mw or self.mw
+        for w in m.worlds:
+            # the exit heartbeat must carry exact totals (solo
+            # render_metrics flushes too); a fleet leader world shares
+            # the root data dir, so no per-world export flushed for it
+            w._flush_exec()
+        self._pending = None
+        self._publish(self._snapshot(m), durable=True)
+
+    @staticmethod
+    def _snapshot(mw) -> dict:
+        return {
+            "update": int(mw.update),
+            "names": list(mw.names),
+            "organisms": mw._prev_alive,       # [W] device refs; the
+            "births": mw._total_births,        # batch loop reassigns
+            "deaths": mw._deaths_this,         # (never mutates) them
+            "gen": mw._last_ave_gen,
+            "time": mw._avida_time,
+            "insts": [int(w._cum_insts) for w in mw.worlds],
+            "preempted": int(bool(mw.preempted or mw._preempt)),
+        }
+
+    def _publish(self, snap: dict, durable: bool):
+        def vec(x, default=0):
+            if x is None:
+                return [default] * len(snap["names"])
+            return np.asarray(x).tolist()
+
+        per = {
+            "avida_update": [snap["update"]] * len(snap["names"]),
+            "avida_organisms": vec(snap["organisms"]),
+            "avida_births_total": vec(snap["births"]),
+            "avida_deaths_last_update": vec(snap["deaths"]),
+            "avida_generation_avg": [round(float(v), 4)
+                                     for v in vec(snap["gen"], 0.0)],
+            "avida_time": [round(float(v), 6)
+                           for v in vec(snap["time"], 0.0)],
+            "avida_insts_total": snap["insts"],
+            "avida_preempted": [snap["preempted"]] * len(snap["names"]),
+        }
+        agg = {
+            "avida_update": snap["update"],
+            "avida_organisms": int(sum(per["avida_organisms"])),
+            "avida_births_total": int(sum(per["avida_births_total"])),
+            "avida_deaths_last_update": int(
+                sum(per["avida_deaths_last_update"])),
+            "avida_generation_avg": round(
+                float(np.mean(per["avida_generation_avg"])), 4),
+            "avida_time": round(max(per["avida_time"]), 6),
+            "avida_insts_total": int(sum(snap["insts"])),
+            "avida_preempted": snap["preempted"],
+            "avida_heartbeat_timestamp_seconds": round(time.time(), 3),
+        }
+        try:
+            write_metrics(self.path, _render(agg, None), durable=durable)
+            fams = [("avida_multiworld_size", "gauge",
+                     "worlds batched into this run", len(snap["names"]))]
+            fams += [(name, *_HELP[name],
+                      {f'world="{n}"': v
+                       for n, v in zip(snap["names"], per[name])})
+                     for name in self._PER_WORLD]
+            fams.append(("avida_heartbeat_timestamp_seconds",
+                         *_HELP["avida_heartbeat_timestamp_seconds"],
+                         round(time.time(), 3)))
+            write_metrics(self.worlds_path, render_families(fams),
+                          durable=durable)
+        except OSError:
+            pass                    # metrics must never kill the batch

@@ -98,6 +98,37 @@ def measure_packed_chunk(params, st, neighbors, key, updates=8, reps=3):
     return ms, st
 
 
+def measure_multiworld(params, sts, neighbors, keys, updates=8, reps=3):
+    """End-to-end ms/update-per-world of the batched multi-world scan
+    (parallel/multiworld.multiworld_scan): W stacked worlds advance
+    `updates` updates in one device program per rep.  Returns
+    (ms_per_update_per_world, final_batched_state).
+
+    Caching-immune by construction (the module-docstring caveat):
+    every rep scans onward from the previous rep's evolved batched
+    state with a fresh update-number base, so no dispatch ever repeats
+    an input."""
+    import time
+
+    from avida_tpu.parallel.multiworld import multiworld_scan
+
+    W = len(keys)
+    bstate = jax.tree.map(lambda *xs: jnp.stack(xs), *sts)
+    bkeys = jnp.stack(list(keys))
+    u0 = 1 << 20              # clear of any real update numbers
+    bstate, _ = multiworld_scan(params, bstate, updates, bkeys,
+                                neighbors, jnp.int32(u0))   # compile+warm
+    jax.block_until_ready(bstate)
+    t0 = time.perf_counter()
+    for r in range(reps):
+        bstate, _ = multiworld_scan(params, bstate, updates, bkeys,
+                                    neighbors,
+                                    jnp.int32(u0 + (r + 1) * updates))
+        bstate = jax.block_until_ready(bstate)
+    ms = (time.perf_counter() - t0) * 1e3 / (reps * updates * W)
+    return ms, bstate
+
+
 def measure_trace_drain(cap=4096, n_updates=16, reps=5):
     """Host cost (ms) of one flight-recorder chunk-boundary drain at its
     worst case: a FULL ring of `cap` events spread over `n_updates`

@@ -438,33 +438,15 @@ def _point_mutation_sweep(params, st, key):
     return st.replace(tape=jnp.where(hit, mutated, st.tape))
 
 
-@partial(jax.jit, static_argnums=(0, 2), donate_argnums=(1,))
-def update_scan(params, st, chunk, run_key, neighbors, u0):
-    """Run `chunk` consecutive updates in ONE device program (lax.scan).
-
-    Per-update host dispatch costs dominate small worlds (and any remote
-    device path); the World driver batches event-free stretches through
-    this.  The per-update PRNG key is fold_in(run_key, update_no), making
-    the random stream a pure function of the seed and the update number --
-    trajectories are bit-identical however the driver chunks the run
-    (chunked vs single-step, any event schedule).  Returns the final state
-    plus per-update int32[chunk] vectors of executed instructions, births
-    and deaths, and f32[chunk] avida-time deltas and average generations
-    (all the host bookkeeping World needs, at update granularity).
-
-    The input state is DONATED: XLA updates the ~100k-organism buffers in
-    place instead of double-buffering them, so the caller's reference to
-    the pre-call state is invalid afterwards (World reassigns self.state
-    from the return value; any device-array the caller still needs from
-    the old state must be copied out before the call).
-
-    Packed-resident chunk (ops/packed_chunk.py, round 6): when the
-    configuration qualifies, the scan keeps the population in the
-    kernel's [LP, N] plane layout for the WHOLE chunk -- pack once, run
-    `chunk` updates with the packed-native birth flush, unpack once here
-    at the boundary (where checkpoints, trace drains and .dat readbacks
-    already synchronize).  Same per-update PRNG stream, bit-exact vs the
-    per-update path (tests/test_packed_chunk.py)."""
+def update_scan_impl(params, st, chunk, run_key, neighbors, u0):
+    """Unjitted body of `update_scan` below -- the single spelling of the
+    chunked update loop.  Exists so the multi-world batcher
+    (avida_tpu/parallel/multiworld.py) can `jax.vmap` the identical
+    program over a leading world axis inside its own jit: per-world
+    PRNG streams stay fold_in(run_key_w, update_no), so every world in
+    a batch replays the exact per-update key sequence of a solo run.
+    See `update_scan` for the full contract (donation, packed residency,
+    returned per-update vectors)."""
     from avida_tpu.ops import packed_chunk
 
     if packed_chunk.active(params, st):
@@ -495,6 +477,36 @@ def update_scan(params, st, chunk, run_key, neighbors, u0):
         return st, (executed, births, deaths, dt, ave_gen, n_alive)
     st, outs = jax.lax.scan(body, st, jnp.arange(chunk))
     return st, outs
+
+
+@partial(jax.jit, static_argnums=(0, 2), donate_argnums=(1,))
+def update_scan(params, st, chunk, run_key, neighbors, u0):
+    """Run `chunk` consecutive updates in ONE device program (lax.scan).
+
+    Per-update host dispatch costs dominate small worlds (and any remote
+    device path); the World driver batches event-free stretches through
+    this.  The per-update PRNG key is fold_in(run_key, update_no), making
+    the random stream a pure function of the seed and the update number --
+    trajectories are bit-identical however the driver chunks the run
+    (chunked vs single-step, any event schedule).  Returns the final state
+    plus per-update int32[chunk] vectors of executed instructions, births
+    and deaths, and f32[chunk] avida-time deltas and average generations
+    (all the host bookkeeping World needs, at update granularity).
+
+    The input state is DONATED: XLA updates the ~100k-organism buffers in
+    place instead of double-buffering them, so the caller's reference to
+    the pre-call state is invalid afterwards (World reassigns self.state
+    from the return value; any device-array the caller still needs from
+    the old state must be copied out before the call).
+
+    Packed-resident chunk (ops/packed_chunk.py, round 6): when the
+    configuration qualifies, the scan keeps the population in the
+    kernel's [LP, N] plane layout for the WHOLE chunk -- pack once, run
+    `chunk` updates with the packed-native birth flush, unpack once here
+    at the boundary (where checkpoints, trace drains and .dat readbacks
+    already synchronize).  Same per-update PRNG stream, bit-exact vs the
+    per-update path (tests/test_packed_chunk.py)."""
+    return update_scan_impl(params, st, chunk, run_key, neighbors, u0)
 
 
 @partial(jax.jit, static_argnums=0)

@@ -2,3 +2,12 @@ from avida_tpu.parallel.mesh import (  # noqa: F401
     CELL_AXIS, make_mesh, population_sharding, replicate,
     shard_neighbors, shard_population,
 )
+
+
+def __getattr__(name):
+    # lazy (PEP 562): multiworld pulls in the full World driver; mesh
+    # consumers (bench sharded mode, tests) should not pay that import
+    if name in ("MultiWorld", "multiworld_scan"):
+        from avida_tpu.parallel import multiworld as _mw
+        return getattr(_mw, name)
+    raise AttributeError(name)

@@ -1,0 +1,539 @@
+"""Multi-world device batching: one compiled update_scan serving a fleet.
+
+The fleet orchestrator (service/fleet.py) reaches "many tenants" by
+spawning one process per world, so a small world -- far too small to
+saturate a device, and dominated by per-update host dispatch on any
+backend -- pays full launch + compile + dispatch overhead per tenant.
+This module is the missing half (ROADMAP item 2): a batched **world
+axis**.  W independent worlds with the SAME static configuration
+(identical WorldParams -- one compiled program) but distinct seeds are
+stacked on a leading axis of every PopulationState leaf and advanced by
+`jax.vmap(update_scan_impl)` chunks, so W worlds progress in one device
+program and aggregate throughput scales with W while compile cost stays
+O(1) -- the direct analogue of batch-serving in an inference stack.
+
+Bit-exactness contract: world w in a batch IS the solo run with seed w.
+
+  * per-world PRNG streams stay `fold_in(run_key_w, update_no)` -- the
+    batched scan vmaps the identical per-update program over per-world
+    run keys, so every world replays its solo key sequence;
+  * the batched run loop calls the SAME chunk planner as World.run
+    (World._plan_stretch), so the batch's chunk grid -- and with it
+    every event, drain, audit and checkpoint boundary -- is identical
+    to each member's solo grid;
+  * host accumulators (_avida_time, _total_births, ...) are lifted from
+    per-world device scalars into [W] device vectors updated with the
+    same per-chunk reductions, so float accumulation order per world is
+    unchanged.
+
+Checkpoints are saved PER WORLD by slicing the batched leaves back into
+each member World and running the ordinary World.save_checkpoint into
+that world's own TPU_CKPT_DIR -- each generation is byte-identical to
+the one a solo run would have written at the same boundary, so
+`--resume`, ckpt_tool, and the analytics pipeline all work unchanged on
+a batch member, and a member can even continue SOLO from a batch
+checkpoint (or vice versa) bit-exactly.
+
+Eligibility: everything the chunked solo path requires
+(World._chunkable -- no telemetry, no reversion tests, no
+generation/births event triggers) plus no flight recorder, no live
+analytics and no fault injection (their per-world host pipelines are
+not sliced; run those workloads solo).  Systematics IS supported: the
+per-world newborn rings are sliced and drained into each member's own
+GenotypeArbiter at every chunk boundary.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from avida_tpu.ops.update import update_scan_impl
+from avida_tpu.world import World
+
+
+@partial(jax.jit, static_argnums=(0, 2), donate_argnums=(1,))
+def multiworld_scan(params, bstate, chunk, run_keys, neighbors, u0):
+    """Advance W worlds by `chunk` updates in ONE device program.
+
+    bstate: a PopulationState pytree whose every leaf carries a leading
+    world axis; run_keys: the stacked per-world run keys.  u0 and the
+    neighbor table are shared (the batch advances on one update grid
+    and static-equal configs have one world geometry).  Returns the
+    batched final state plus the per-update bookkeeping vectors of
+    update_scan with a leading world axis ([W, chunk]).
+
+    The batched state is DONATED, exactly like update_scan's."""
+    return jax.vmap(
+        lambda st, rk: update_scan_impl(params, st, chunk, rk,
+                                        neighbors, u0)
+    )(bstate, run_keys)
+
+
+def _event_key(ev):
+    return (ev.trigger, ev.start, ev.interval, ev.stop, ev.action,
+            tuple(ev.args))
+
+
+class MultiWorld:
+    """Driver for one batch of static-equal worlds (see module header).
+
+    Build with a list of fully-constructed Worlds (distinct seeds /
+    data dirs / checkpoint dirs; identical everything-static), or via
+    `from_seeds` / `from_manifest`.
+    """
+
+    def __init__(self, worlds, data_dir: str | None = None):
+        if not worlds:
+            raise ValueError("MultiWorld needs at least one world")
+        self.worlds = list(worlds)
+        w0 = self.worlds[0]
+        self.params = w0.params
+        self.neighbors = w0.neighbors
+        self.cfg = w0.cfg
+        self.data_dir = data_dir or w0.data_dir
+        n0 = np.asarray(w0.neighbors)
+        ev0 = [_event_key(e) for e in w0.events]
+        for w in self.worlds[1:]:
+            if w.params != w0.params:
+                raise ValueError(
+                    "multi-world batch needs identical static configs "
+                    "(WorldParams differ; only seeds and output dirs may "
+                    "vary across a batch)")
+            if not np.array_equal(np.asarray(w.neighbors), n0):
+                raise ValueError(
+                    "multi-world batch needs one shared world topology "
+                    "(neighbor tables differ -- scale-free geometries "
+                    "draw per-seed graphs and cannot batch)")
+            if [_event_key(e) for e in w.events] != ev0:
+                raise ValueError("multi-world batch needs one shared "
+                                 "event schedule")
+        for w in self.worlds:
+            if not w._chunkable():
+                raise ValueError(
+                    "multi-world batching requires chunkable runs: no "
+                    "telemetry, no offspring reversion tests, no "
+                    "generation/births event triggers")
+            if w.tracer is not None or w.analytics is not None \
+                    or w.faults is not None:
+                raise ValueError(
+                    "multi-world batching does not slice the flight "
+                    "recorder, live analytics or fault-injection host "
+                    "pipelines; run those workloads solo")
+        if len({id(w.cfg) for w in self.worlds}) != len(self.worlds) \
+                and len(self.worlds) > 1:
+            raise ValueError("each batch member needs its own config "
+                             "object (distinct seeds / dirs)")
+        self.update = w0.update
+        if any(w.update != self.update for w in self.worlds):
+            raise ValueError("batch members disagree on the current "
+                             "update; resume() aligns them first")
+        dirs = [os.path.abspath(w.data_dir) for w in self.worlds]
+        if len(set(dirs)) != len(dirs):
+            raise ValueError("batch members share a data_dir; each "
+                             "world needs its own .dat output dir")
+        self._ckpt_on = all(w._ckpt_base() for w in self.worlds)
+        if self._ckpt_on:
+            cks = [os.path.abspath(w._ckpt_base()) for w in self.worlds]
+            if len(set(cks)) != len(cks):
+                # a config-FILE TPU_CKPT_DIR reaches every member
+                # verbatim (from_seeds only suffixes override-supplied
+                # dirs): same-update generations would silently clobber
+                # each other and a resume would restore ONE world's
+                # bytes into all members
+                raise ValueError(
+                    "batch members share a checkpoint dir; give each "
+                    "world its own TPU_CKPT_DIR (the --worlds CLI and "
+                    "the fleet manifest do this per world)")
+        self._exit = False
+        self._preempt = False
+        self.preempted = False
+        self.bstate = None
+        self._run_keys = None
+        self._avida_time = None
+        self._last_ave_gen = None
+        self._deaths_this = None
+        self._prev_alive = None
+        self._total_births = None
+        self._boundary_hook = None     # test seam (chaos drills): called
+        #                                after every chunk boundary
+        self.names = [f"w{k:03d}" for k in range(len(self.worlds))]
+        self.exporter = None
+        if int(self.cfg.get("TPU_METRICS", 0)):
+            from avida_tpu.observability.exporter import MultiWorldExporter
+            self.exporter = MultiWorldExporter(self)
+
+    # ---- construction helpers ----
+
+    @classmethod
+    def from_seeds(cls, seeds, config_dir: str | None = None,
+                   overrides=None, data_dir: str = "data",
+                   ckpt_dir: str | None = None, names=None) -> "MultiWorld":
+        """One world per seed, static config shared.  World k writes its
+        .dat output to `<data_dir>/<name_k>` (names default w000, w001,
+        ...) and, when `ckpt_dir` (or a TPU_CKPT_DIR override) is given,
+        checkpoints to `<ckpt_dir>/<name_k>`."""
+        overrides = list(overrides or [])
+        if ckpt_dir is None:
+            for n, v in overrides:
+                if n == "TPU_CKPT_DIR" and str(v) not in ("-", ""):
+                    ckpt_dir = str(v)
+        base = [(n, v) for n, v in overrides
+                if n not in ("RANDOM_SEED", "TPU_CKPT_DIR")]
+        names = list(names or [f"w{k:03d}" for k in range(len(seeds))])
+        entries = []
+        for name, seed in zip(names, seeds):
+            entries.append({
+                "name": name, "seed": int(seed),
+                "data_dir": os.path.join(data_dir, name),
+                "ckpt_dir": (os.path.join(ckpt_dir, name)
+                             if ckpt_dir else None)})
+        return cls._from_entries(entries, config_dir, base, data_dir)
+
+    @classmethod
+    def from_manifest(cls, path: str, config_dir: str | None = None,
+                      overrides=None,
+                      data_dir: str | None = None) -> "MultiWorld":
+        """Batch from a worlds.json manifest -- a list of
+        {"name", "seed", "data_dir", "ckpt_dir"} entries (the fleet
+        orchestrator's device-lane packing writes one per coalesced
+        batch; service/fleet.py)."""
+        try:
+            with open(path) as f:
+                entries = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise ValueError(f"{path}: unreadable worlds manifest ({e})")
+        if not isinstance(entries, list) or not entries:
+            raise ValueError(f"{path}: worlds manifest must be a "
+                             f"non-empty JSON list")
+        for k, e in enumerate(entries):
+            # operator-facing input: refuse with a one-line reason (the
+            # --worlds CLI maps ValueError to exit 2), never a KeyError
+            # traceback a supervisor would crash-loop on
+            if not isinstance(e, dict) or not str(e.get("data_dir", "")):
+                raise ValueError(f"{path}: entry {k} must be an object "
+                                 f"with at least 'seed' and 'data_dir'")
+            try:
+                int(e["seed"])
+            except (KeyError, TypeError, ValueError):
+                raise ValueError(f"{path}: entry {k} needs an integer "
+                                 f"'seed'")
+        base = [(n, v) for n, v in (overrides or [])
+                if n not in ("RANDOM_SEED", "TPU_CKPT_DIR")]
+        return cls._from_entries(entries, config_dir, base,
+                                 data_dir or os.path.dirname(path))
+
+    @classmethod
+    def _from_entries(cls, entries, config_dir, base_overrides, data_dir):
+        worlds = []
+        for e in entries:
+            ov = list(base_overrides) + [("RANDOM_SEED", int(e["seed"]))]
+            if e.get("ckpt_dir"):
+                ov.append(("TPU_CKPT_DIR", e["ckpt_dir"]))
+            worlds.append(World(config_dir=config_dir, overrides=ov,
+                                data_dir=e["data_dir"]))
+        mw = cls(worlds, data_dir=data_dir)
+        mw.names = [str(e.get("name", f"w{k:03d}"))
+                    for k, e in enumerate(entries)]
+        return mw
+
+    # ---- batched <-> per-world state movement ----
+
+    def _stack(self):
+        """Stack the member states (and the per-world host accumulator
+        scalars) onto the leading world axis.  Member .state references
+        are dropped: the batched buffers are donated every chunk and the
+        members get fresh slices back at the next host boundary."""
+        if self.bstate is not None:
+            return
+        self.bstate = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                   *[w.state for w in self.worlds])
+        self._run_keys = jnp.stack([w._run_key for w in self.worlds])
+        self._avida_time = jnp.stack(
+            [jnp.asarray(w._avida_time, jnp.float32) for w in self.worlds])
+        self._last_ave_gen = jnp.stack(
+            [jnp.asarray(w._last_ave_gen, jnp.float32)
+             for w in self.worlds])
+        self._deaths_this = jnp.stack(
+            [jnp.asarray(w._deaths_this, jnp.int32) for w in self.worlds])
+        self._prev_alive = (
+            None if any(w._prev_alive is None for w in self.worlds)
+            else jnp.stack([jnp.asarray(w._prev_alive, jnp.int32)
+                            for w in self.worlds]))
+        self._total_births = jnp.stack(
+            [jnp.asarray(w._total_births, jnp.int32) for w in self.worlds])
+        for w in self.worlds:
+            w.state = None
+
+    def _sync_worlds(self):
+        """Slice the batched state + accumulators back into each member
+        World (a host boundary: events, checkpoints, audits, run exit).
+        Slices are materialized copies, so they survive the next chunk's
+        donation of the batched buffers."""
+        if self.bstate is None:
+            return
+        for i, w in enumerate(self.worlds):
+            w.state = jax.tree.map(lambda x, i=i: x[i], self.bstate)
+            w.update = self.update
+            w._avida_time = self._avida_time[i]
+            w._last_ave_gen = self._last_ave_gen[i]
+            w._deaths_this = self._deaths_this[i]
+            w._prev_alive = (None if self._prev_alive is None
+                             else self._prev_alive[i])
+            w._total_births = self._total_births[i]
+            w._summary_cache_update = None
+        self.bstate = None
+
+    # ---- the batched run loop (mirrors World.run's chunk grid) ----
+
+    def _scan(self, k: int):
+        """One batched chunk: W worlds x k updates, one device program.
+        The same per-chunk accumulator updates as World._scan_updates,
+        vectorized over the world axis (same per-world float order)."""
+        self.bstate, (executed, births, deaths, dts, ave_gens, n_alive) = \
+            multiworld_scan(self.params, self.bstate, k, self._run_keys,
+                            self.neighbors, jnp.int32(self.update))
+        self._avida_time = self._avida_time + dts.sum(axis=1)
+        self._last_ave_gen = ave_gens[:, -1]
+        self._deaths_this = deaths[:, -1]
+        self._prev_alive = n_alive[:, -1]
+        self._total_births = self._total_births + births.sum(axis=1)
+        for i, w in enumerate(self.worlds):
+            w._pending_exec.append(executed[i])
+        self.update += k
+        for w in self.worlds:
+            w.update = self.update
+
+    def _events_due(self) -> bool:
+        for ev in self.worlds[0].events:
+            if ev.trigger == "update" and ev.fires_at(self.update):
+                return True
+            if ev.trigger == "immediate" and self.update == 0:
+                return True
+        return False
+
+    def _drain_newborns(self, at: int):
+        """Slice the batched newborn rings and feed each member's own
+        GenotypeArbiter, synchronously at every chunk boundary -- the
+        same window boundaries (and therefore the same record grouping
+        and death resolution) as the member's solo run.
+
+        `at` is the update number stamped on the drain window.  Solo
+        runs stamp a >1-update chunk with the post-chunk update
+        (World._snapshot_newborns) but a single-stepped update with the
+        update just run (run_update drains BEFORE World.run advances
+        the counter) -- the caller passes the matching value so the
+        serialized last_drain_update, and every systematics.process
+        call, stays identical to the solo run's.
+
+        Per-world snap entries stay DEVICE slices: _feed_systematics
+        reads the nb_* rings only up to nb_count and touches the wide
+        arrays (genome/birth_update/parent_id) solely in the overflow
+        fallback, so eagerly np.asarray-ing the [W, N, L] genome plane
+        here would fence the device for tens of MB per boundary that
+        are almost never read."""
+        if self.worlds[0].systematics is None:
+            return
+        for i, w in enumerate(self.worlds):
+            snap = {name: getattr(self.bstate, name)[i]
+                    for name in World._NB_SNAP_FIELDS}
+            snap["update_at"] = at
+            snap["win_start"] = w._last_drain_update
+            w._last_drain_update = at
+            w._feed_systematics(snap)
+        self.bstate = self.bstate.replace(
+            nb_count=jnp.zeros((len(self.worlds),), jnp.int32))
+
+    # the solo handler verbatim (same `_preempt` attribute contract,
+    # including the second-Ctrl-C escalation and the off-main-thread
+    # guard) -- one spelling, so a future fix applies to both drivers
+    _install_preempt_handlers = World._install_preempt_handlers
+
+    def save_checkpoints(self):
+        """One ordinary per-world checkpoint generation each, into each
+        member's own TPU_CKPT_DIR -- byte-identical to the generation a
+        solo run would publish at this boundary."""
+        self._sync_worlds()
+        for w in self.worlds:
+            w.save_checkpoint()
+            if self._world_exports(w):
+                # per-world heartbeat refresh: the boundary already
+                # synced, so the readback is free -- fleet --status
+                # member sub-rows stay no staler than one save interval
+                w.exporter.export(w)
+
+    def _world_exports(self, w) -> bool:
+        """A member writes its own metrics.prom unless that path IS the
+        batch aggregate's (the fleet's leader world shares the root
+        data dir; its rows live in multiworld.prom instead)."""
+        if w.exporter is None:
+            return False
+        return (self.exporter is None
+                or os.path.abspath(w.exporter.path)
+                != os.path.abspath(self.exporter.path))
+
+    def resume(self, at_update: int | None = None) -> int:
+        """Restore every member from its own checkpoint dir, aligned on
+        one common update: the newest update for which EVERY member
+        retains a generation (intersection, not min-of-newest: with a
+        short retention an ahead member may have pruned the update a
+        behind member fell back to -- skipping to the next common
+        update recovers instead of wedging).  A generation that fails
+        CRC drops the whole candidate update and the next-newest
+        common one is tried.  Returns the aligned update."""
+        from avida_tpu.utils import checkpoint as ckpt_mod
+        if at_update is None:
+            sets = []
+            for w in self.worlds:
+                ups = {ckpt_mod.generation_update(p)
+                       for p in ckpt_mod.restore_candidates(
+                           w._ckpt_base())}
+                sets.append({u for u in ups if u >= 0})
+            common = set.intersection(*sets) if sets else set()
+            if not common:
+                raise ckpt_mod.CheckpointError(
+                    "no checkpoint update common to every batch member "
+                    "(mixed progress resumes aligned or not at all)")
+            candidates = sorted(common, reverse=True)
+        else:
+            candidates = [int(at_update)]
+        last_err = None
+        for u in candidates:
+            try:
+                for w in self.worlds:
+                    restored = w.resume(at_update=u)
+                    assert restored == u
+            except ckpt_mod.CheckpointMismatchError:
+                raise
+            except ckpt_mod.CheckpointError as e:
+                last_err = e
+                continue
+            self.update = u
+            return u
+        raise last_err or ckpt_mod.CheckpointError("batch resume failed")
+
+    def run(self, max_updates: int | None = None):
+        """The batched master loop.  Structurally World.run with the
+        device work vectorized over the world axis: one shared chunk
+        grid (World._plan_stretch on the common update counter), host
+        boundaries -- events, newborn drains, audits, auto-saves,
+        preemption -- at exactly the updates each member's solo run
+        would have them.  Returns total instructions executed across
+        the batch this call."""
+        for w in self.worlds:
+            if w.state is None:
+                w.process_events()
+                if w.state is None:
+                    w.inject()
+        start_insts = sum(w._cum_insts for w in self.worlds)
+        ckpt_every = int(self.cfg.get("TPU_CKPT_EVERY", 0))
+        audit_every = int(self.cfg.get("TPU_AUDIT_EVERY", 0))
+        max_stretch = int(self.cfg.get("TPU_MAX_STRETCH", 0))
+        self.preempted = False
+        self._preempt = False
+        for w in self.worlds:
+            w.preempted = False
+            w._preempt = False
+        handlers = self._install_preempt_handlers() if self._ckpt_on else {}
+        last_ckpt = self.update
+        last_audit = self.update
+        sysm_on = self.worlds[0].systematics is not None
+        try:
+            self._stack()
+            while not self._exit and not self._preempt:
+                if max_updates is not None and self.update >= max_updates:
+                    break
+                if self._events_due():
+                    self._sync_worlds()
+                    for w in self.worlds:
+                        w.process_events()
+                    if any(w._exit for w in self.worlds):
+                        self._exit = True
+                        break
+                    self._stack()
+                else:
+                    # solo runs call the (idempotent) process_events at
+                    # the top of EVERY iteration; with nothing due its
+                    # only effect is this cursor -- mirror it so
+                    # checkpoints stay byte-identical to solo ones
+                    for w in self.worlds:
+                        w._events_done_for = self.update
+                stretch = self.worlds[0]._plan_stretch(max_updates,
+                                                       max_stretch)
+                self._scan(stretch)
+                if sysm_on:
+                    # single-stepped updates drain with the pre-advance
+                    # update number, exactly like solo run_update (see
+                    # _drain_newborns)
+                    self._drain_newborns(self.update if stretch > 1
+                                         else self.update - 1)
+                for w in self.worlds:
+                    if len(w._pending_exec) >= 256:
+                        w._flush_exec()
+                if sysm_on and self.update % 100 == 0:
+                    for w in self.worlds:
+                        w.systematics.prune_extinct(keep_ancestry=True)
+                if self.exporter is not None:
+                    self.exporter.export_deferred(self)
+                audit_due = (audit_every
+                             and self.update - last_audit >= audit_every)
+                ckpt_due = (self._ckpt_on and ckpt_every
+                            and self.update - last_ckpt >= ckpt_every)
+                if audit_due or ckpt_due:
+                    # one sync + one restack even when both cadences
+                    # land on the same boundary
+                    self._sync_worlds()
+                    if audit_due:
+                        from avida_tpu.utils.audit import check_invariants
+                        for w in self.worlds:
+                            check_invariants(self.params, w.state,
+                                             where=f"update {self.update}")
+                        last_audit = self.update
+                    if ckpt_due:
+                        self.save_checkpoints()
+                        last_ckpt = self.update
+                    self._stack()
+                if self._boundary_hook is not None:
+                    self._boundary_hook(self)
+            self._sync_worlds()
+            self.preempted = self._preempt
+            for w in self.worlds:
+                w._preempt = self._preempt
+            if self._preempt and self._ckpt_on:
+                for w in self.worlds:
+                    w.save_checkpoint()
+            elif self._ckpt_on and int(self.cfg.get("TPU_CKPT_FINAL", 0)) \
+                    and self.update != last_ckpt:
+                for w in self.worlds:
+                    w.save_checkpoint()
+            for w in self.worlds:
+                w.preempted = self._preempt
+                if self._world_exports(w) and w.state is not None:
+                    w.exporter.export(w)
+            if self.exporter is not None:
+                self.exporter.export_final(self)
+        finally:
+            import signal as _signal
+            for s, h in handlers.items():
+                try:
+                    _signal.signal(s, h)
+                except (ValueError, OSError):
+                    pass
+            for w in self.worlds:
+                for f in w._files.values():
+                    try:
+                        f.close()
+                    except Exception:
+                        pass
+                w._files = {}
+                w._dat_append = True
+        return sum(w._flush_exec() for w in self.worlds) - start_insts
+
+    @property
+    def num_worlds(self) -> int:
+        return len(self.worlds)

@@ -88,8 +88,8 @@ FLEET_METRICS_FILE = "fleet.prom"
 LOCK_FILE = "fleet.lock"
 JOB_SPEC_FILE = "job.json"
 
-JOB_STATES = ("queued", "running", "done", "failed", "quarantined",
-              "cancelled")
+JOB_STATES = ("queued", "running", "batched", "done", "failed",
+              "quarantined", "cancelled")
 
 # job names become directory names and metric labels; the whole
 # "fleet"/"fleet.*" namespace is the orchestrator's own (fleet.jsonl,
@@ -132,6 +132,49 @@ def validate_spec(spec) -> None:
             or not all(isinstance(k, str) and isinstance(v, str)
                        for k, v in env.items())):
         raise ValueError("'env' must be a string-to-string object")
+    if not isinstance(spec.get("batch", False), bool):
+        raise ValueError("'batch' must be a boolean (device-lane "
+                         "packing opt-in)")
+
+
+def spec_seed_and_batch_key(spec) -> tuple:
+    """(seed, static-key) for device-lane packing: the seed is lifted
+    out of the spec argv (`-s`/`--seed`/`-set RANDOM_SEED`), and the
+    key -- the seed-stripped argv plus the env -- is the host-only
+    proxy for "identical static config": two specs with equal keys
+    trace the identical update program and may share one compiled
+    batch.  seed is None when the spec never names one explicitly
+    (unbatchable: the worlds manifest needs a concrete per-world
+    seed)."""
+    argv = list(spec.get("argv") or ())
+    # precedence mirrors the solo CLI: __main__ appends the -s seed
+    # AFTER every -set override (last override wins in the config), so
+    # -s beats -set RANDOM_SEED regardless of argv position
+    s_seed = None
+    set_seed = None
+    stripped = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in ("-s", "--seed") and i + 1 < len(argv):
+            s_seed = argv[i + 1]
+            i += 2
+            continue
+        if a == "-set" and i + 2 < len(argv) \
+                and argv[i + 1] == "RANDOM_SEED":
+            set_seed = argv[i + 2]
+            i += 3
+            continue
+        stripped.append(a)
+        i += 1
+    seed = s_seed if s_seed is not None else set_seed
+    try:
+        seed = int(seed) if seed is not None else None
+    except ValueError:
+        seed = None
+    key = (tuple(stripped),
+           tuple(sorted((spec.get("env") or {}).items())))
+    return seed, key
 
 
 class FleetConfig:
@@ -141,7 +184,8 @@ class FleetConfig:
     def __init__(self, max_jobs: int = 2, poll_sec: float = 0.5,
                  breaker_k: int = 3, breaker_sec: float = 300.0,
                  drain_sec: float = 600.0, serve: bool = False,
-                 journal_max_bytes: int = 64 << 20):
+                 journal_max_bytes: int = 64 << 20,
+                 max_batch: int = 16):
         self.max_jobs = max(int(max_jobs), 1)
         self.poll_sec = float(poll_sec)
         self.breaker_k = int(breaker_k)
@@ -149,6 +193,12 @@ class FleetConfig:
         self.drain_sec = float(drain_sec)
         self.serve = bool(serve)
         self.journal_max_bytes = int(journal_max_bytes)
+        # device-lane packing width cap (TPU_FLEET_MAX_BATCH): one
+        # batched child stacks W full PopulationStates on the device,
+        # so an unbounded W would let a 100-spec sweep bypass the
+        # resource bounding max_jobs exists for -- wider groups split
+        # into multiple batches
+        self.max_batch = max(int(max_batch), 2)
 
     @classmethod
     def from_env(cls, env) -> "FleetConfig":
@@ -161,6 +211,7 @@ class FleetConfig:
             breaker_sec=f("TPU_FLEET_BREAKER_SEC", 300.0),
             drain_sec=f("TPU_FLEET_DRAIN_SEC", 600.0),
             journal_max_bytes=int(f("TPU_RUNLOG_MAX_BYTES", 64 << 20)),
+            max_batch=int(f("TPU_FLEET_MAX_BATCH", 16)),
         )
 
 
@@ -226,6 +277,15 @@ class Job:
         self.pid = None                 # newest child pid (journaled)
         self.cancel_requested = False
         self._fail_snapshot: dict = {}
+        # device-lane packing (spec "batch": true): a LEADER job runs
+        # one MultiWorld child serving every member; members park in
+        # state "batched" with no supervisor of their own
+        self.batch_members: list = []   # member names (leader only)
+        self.batch_leader = None        # leader name (members only)
+        self._batch_fallback_logged = False
+        self._batch_progress = None     # cached resume-progress key
+        #                                 (None = rescan; reset whenever
+        #                                 the job re-enters the queue)
 
     @property
     def data_dir(self):
@@ -268,6 +328,11 @@ def journal_states(journal_path: str) -> tuple:
             state[name] = "running"
         elif ev == "spawn":
             pids[name] = rec.get("pid")
+        elif ev == "coalesced":
+            # device-lane packing: the member rides a leader's
+            # MultiWorld child; its own checkpoints stay solo-format,
+            # so replay can requeue it standalone
+            state[name] = "batched"
         elif ev == "cancel_requested":
             # a cancel whose graceful stop was still in flight: must not
             # be resurrected as "running" if the orchestrator dies here
@@ -278,6 +343,28 @@ def journal_states(journal_path: str) -> tuple:
         elif ev == "xla_fallback":
             xla = True
     return state, pids, xla
+
+
+def journal_batch_leaders(journal_path: str) -> dict:
+    """{member: leader} for every LIVE coalescing in the journal --
+    terminal member events (done/failed/cancelled/requeued) dissolve
+    the pairing.  Status/list views group member sub-rows under their
+    leader with this."""
+    leaders: dict = {}
+    for rec in read_records(journal_path):
+        if rec.get("record") != "fleet":
+            continue
+        ev = rec.get("event")
+        name = rec.get("job")
+        if ev == "snapshot":
+            leaders = {n: v["leader"] for n, v in rec["jobs"].items()
+                       if v.get("leader")}
+        elif ev == "coalesced" and rec.get("leader"):
+            leaders[name] = rec["leader"]
+        elif ev in ("done", "failed", "cancelled", "quarantined",
+                    "requeued"):
+            leaders.pop(name, None)
+    return leaders
 
 
 def spool_job_states(spool: str) -> dict:
@@ -352,7 +439,8 @@ class FleetOrchestrator:
                     "record": "fleet", "event": "snapshot",
                     "time": self._clock(),
                     "xla_fallback": self.xla_fallback,
-                    "jobs": {n: {"state": j.state, "pid": j.pid}
+                    "jobs": {n: {"state": j.state, "pid": j.pid,
+                                 "leader": j.batch_leader}
                              for n, j in self.jobs.items()}})
             append_record(self.journal_path, rec)
         except OSError:
@@ -481,13 +569,19 @@ class FleetOrchestrator:
                      moved_to=os.path.basename(dst))
 
     def _admit(self, now: float):
-        """Admission control: fill free slots from the queue unless the
-        circuit breaker holds admissions."""
+        """Admission control: device-lane packing first (a batch serves
+        W tenants on one slot), then fill the remaining slots from the
+        queue, unless the circuit breaker holds admissions."""
         self.admissions_paused = self.breaker.is_open(now)
         if self.admissions_paused:
             return
         running = sum(1 for j in self.jobs.values()
                       if j.state == "running")
+        for members in self._form_batches():
+            if running >= self.cfg.max_jobs:
+                break
+            if self._start_batch(members):
+                running += 1
         for name in sorted(self.jobs):
             if running >= self.cfg.max_jobs:
                 break
@@ -497,23 +591,205 @@ class FleetOrchestrator:
             if self._start(job):
                 running += 1
 
+    # ---- device-lane packing (spec "batch": true) ----
+
+    def _load_spec(self, job: Job):
+        """Best-effort spec read for a queued job (spool root or its
+        already-moved job.json); None when unreadable -- the normal
+        admission path surfaces the error."""
+        if job.spec is not None:
+            return job.spec
+        for path in (job.spec_path, job.spool_spec_path):
+            try:
+                with open(path) as f:
+                    spec = json.load(f)
+                validate_spec(spec)
+                job.spec = spec
+                return spec
+            except (OSError, ValueError):
+                continue
+        return None
+
+    def _batch_fallback(self, job: Job, reason: str):
+        """Journal (once) why a '"batch": true' spec runs as an
+        ordinary process-per-job instead -- the documented clean
+        fallback.  The job stays queued and batchable: a static-equal
+        peer arriving before a slot frees can still pick it up."""
+        if job._batch_fallback_logged:
+            return
+        job._batch_fallback_logged = True
+        self.journal("batch_fallback", job=job.name, reason=reason)
+
+    def _form_batches(self) -> list:
+        """Group queued '"batch": true' specs by their static key
+        (seed-stripped argv + env -- identical keys trace one compiled
+        update program).  Returns a list of batches, each a [(job,
+        seed)] list sorted by name (the first member leads).  Specs
+        that cannot batch -- a fault plan (per-process chaos), no
+        explicit seed, no static-equal peer -- fall back to
+        process-per-job with the reason journaled."""
+        groups: dict = {}
+        for name in sorted(self.jobs):
+            job = self.jobs[name]
+            if job.state != "queued":
+                continue
+            spec = self._load_spec(job)
+            if spec is None or not spec.get("batch"):
+                continue
+            if spec.get("fault_plan"):
+                self._batch_fallback(job, "fault_plan is per-process")
+                continue
+            seed, key = spec_seed_and_batch_key(spec)
+            if seed is None:
+                self._batch_fallback(job, "no explicit seed in argv")
+                continue
+            # resume-progress compatibility: the child resumes a batch
+            # aligned on ONE update, so a requeued member with
+            # checkpoints must not coalesce with a fresh spec (the
+            # mixed set would refuse to resume on every boot).  Key on
+            # the newest published generation's update (-1 = fresh),
+            # cached per job -- it cannot change while the job sits
+            # queued, and rescanning 100 parked specs' dirs every
+            # poll tick would hammer the disk for nothing
+            if job._batch_progress is None:
+                from avida_tpu.utils.checkpoint import (
+                    generation_update, list_generations)
+                gens = list_generations(job.ckpt_dir)
+                job._batch_progress = (generation_update(gens[-1])
+                                       if gens else -1)
+            groups.setdefault((key, job._batch_progress),
+                              []).append((job, seed))
+        batches = []
+        for key in sorted(groups, key=str):
+            members = groups[key]
+            if len(members) < 2:
+                self._batch_fallback(members[0][0],
+                                     "no static-equal peer queued")
+                continue
+            # width cap: split wide groups so one batched child never
+            # stacks more than max_batch worlds (TPU_FLEET_MAX_BATCH)
+            for i in range(0, len(members), self.cfg.max_batch):
+                chunk = members[i:i + self.cfg.max_batch]
+                if len(chunk) >= 2:
+                    batches.append(chunk)
+                else:
+                    self._batch_fallback(chunk[0][0],
+                                         "width-cap remainder")
+        return batches
+
+    def _start_batch(self, members: list) -> bool:
+        """Admit one coalesced batch: every member's spec moves into
+        its own fault domain (per-world data + checkpoints survive in
+        solo-compatible form), a worlds.json manifest lands in the
+        leader's domain, and ONE supervised `--worlds` child serves
+        them all.  Occupies one admission slot."""
+        admitted = [(job, seed) for job, seed in members
+                    if self._admit_spec_move(job)]
+        if not admitted:
+            return False
+        if len(admitted) == 1:
+            return self._start(admitted[0][0])
+        leader, _ = admitted[0]
+        _, key = spec_seed_and_batch_key(leader.spec)
+        manifest = [{"name": j.name, "seed": s,
+                     "data_dir": j.data_dir, "ckpt_dir": j.ckpt_dir}
+                    for j, s in admitted]
+        mpath = os.path.join(leader.dir, "worlds.json")
+        tmp = f"{mpath}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(manifest, f, indent=1)
+                f.write("\n")
+            os.replace(tmp, mpath)
+        except OSError as e:
+            self.journal("batch_fallback", job=leader.name,
+                         reason=f"manifest write failed: {e}")
+            return self._start(leader)
+        argv = list(key[0]) + [
+            "--worlds", mpath,
+            "-d", leader.data_dir, "-set", "TPU_CKPT_DIR",
+            leader.ckpt_dir]
+        env = dict(self._base_env)
+        env.update(leader.spec.get("env") or {})
+        try:
+            sup = Supervisor(argv, cfg=SupervisorConfig.from_env(env),
+                             env=env, spawn=self._spawn_factory(leader),
+                             clock=self._clock, sleep=self._sleep)
+        except ValueError as e:
+            self.journal("batch_fallback", job=leader.name,
+                         reason=f"supervisor refused batch argv: {e}")
+            return self._start(leader)
+        if self.xla_fallback:
+            sup._xla_fallback = True
+        leader.sup = sup
+        leader._fail_snapshot = dict(sup.failures)
+        leader.state = "running"
+        leader.batch_members = [j.name for j, _ in admitted[1:]]
+        self.journal("coalesce", job=leader.name,
+                     members=leader.batch_members,
+                     worlds=len(admitted))
+        for j, _ in admitted[1:]:
+            j.state = "batched"
+            j.batch_leader = leader.name
+            self.journal("coalesced", job=j.name, leader=leader.name)
+        sup.publish_metrics()
+        return True
+
+    def _finish_batch(self, leader: Job):
+        """Propagate the leader's terminal state to its riders: done and
+        failed verbatim; a drained/preempted batch requeues every member
+        (their solo-format checkpoints make each independently
+        resumable -- re-coalescing or running solo both continue
+        bit-exactly); a member that asked for cancellation lands
+        `cancelled` while its peers requeue."""
+        members, leader.batch_members = leader.batch_members, []
+        for mname in members:
+            m = self.jobs.get(mname)
+            if m is None or m.batch_leader != leader.name:
+                continue
+            m.batch_leader = None
+            if leader.state in ("done", "failed"):
+                m.state = leader.state
+                self.journal(leader.state, job=m.name,
+                             batch_leader=leader.name)
+            elif m.cancel_requested:
+                m.state = "cancelled"
+                self.journal("cancelled", job=m.name)
+            else:
+                m.state = "queued"
+                m.sup = None
+                m._batch_progress = None   # checkpoints advanced
+                self.journal("requeued", job=m.name,
+                             reason="batch_"
+                                    + ("cancelled"
+                                       if leader.state == "cancelled"
+                                       else "drain"))
+
+    def _admit_spec_move(self, job: Job) -> bool:
+        """The transactional half of admission, shared by solo and
+        batched starts: journal-first ("admit"), THEN atomically move
+        the spec into the job's fault domain -- if we die between the
+        two steps, replay finds the admit record and completes the
+        move before respawning.  False = quarantined (path blocked)."""
+        if os.path.exists(job.spec_path):
+            return True
+        self.journal("admit", job=job.name)
+        try:
+            os.makedirs(job.dir, exist_ok=True)
+            os.replace(job.spool_spec_path, job.spec_path)
+        except OSError as e:
+            # e.g. the job-dir path is blocked by a file: quarantine
+            # rather than crash-loop the whole orchestrator
+            self._quarantine_spec(job, job.spool_spec_path,
+                                  f"spec move failed: {e}")
+            return False
+        return True
+
     def _start(self, job: Job) -> bool:
         """Admit one queued job: transactional spec move + Supervisor
         construction + first child launch."""
-        if not os.path.exists(job.spec_path):
-            # journal-first admission: if we die between these two
-            # steps, replay finds the admit record and completes the
-            # move before respawning
-            self.journal("admit", job=job.name)
-            try:
-                os.makedirs(job.dir, exist_ok=True)
-                os.replace(job.spool_spec_path, job.spec_path)
-            except OSError as e:
-                # e.g. the job-dir path is blocked by a file: quarantine
-                # rather than crash-loop the whole orchestrator
-                self._quarantine_spec(job, job.spool_spec_path,
-                                      f"spec move failed: {e}")
-                return False
+        if not self._admit_spec_move(job):
+            return False
         if job.spec is None:
             try:
                 with open(job.spec_path) as f:
@@ -587,6 +863,17 @@ class FleetOrchestrator:
             job.state = "cancelled"
             self.journal("cancelled", job=name)
             return
+        if job.state == "batched":
+            # a rider has no child of its own: preempt the whole batch
+            # gracefully -- this member lands `cancelled`, its peers
+            # requeue from their per-world checkpoints (_finish_batch)
+            job.cancel_requested = True
+            leader = self.jobs.get(job.batch_leader or "")
+            if leader is not None and leader.sup is not None:
+                leader.sup.request_stop()
+            self.journal("cancel_requested", job=name,
+                         batch_leader=job.batch_leader)
+            return
         # running: graceful stop; _poll_job records the terminal state
         # once the child has written its preemption checkpoint
         job.cancel_requested = True
@@ -604,6 +891,7 @@ class FleetOrchestrator:
         job.spec = None
         job.cancel_requested = False
         job.state = "queued"
+        job._batch_progress = None
         self.journal("requeued", job=name, reason=reason)
 
     # ---- the poll loop ----
@@ -617,6 +905,8 @@ class FleetOrchestrator:
             # the job tables agree it is terminal
             job.state = "failed"
             self.journal("failed", job=job.name, error=str(e))
+            if job.batch_members:
+                self._finish_batch(job)
             return
         self._note_failures(job, now)
         if state not in ("done", "failed"):
@@ -635,7 +925,10 @@ class FleetOrchestrator:
             # supervisor preempted (drain): incomplete but resumable
             job.state = "queued"
             job.sup = None
+            job._batch_progress = None   # checkpoints advanced
             self.journal("requeued", job=job.name, reason="drain")
+        if job.batch_members:
+            self._finish_batch(job)
 
     def _note_failures(self, job: Job, now: float):
         """Diff the job supervisor's per-class failure counters into the
@@ -681,10 +974,10 @@ class FleetOrchestrator:
             self.journal("breaker_close", failure_class=closed)
         self._admit(now)
         for job in [j for j in self.jobs.values()
-                    if j.state == "running"]:
+                    if j.state == "running" and j.sup is not None]:
             self._poll_job(job, now)
         self.publish_metrics()
-        return any(j.state in ("queued", "running")
+        return any(j.state in ("queued", "running", "batched")
                    for j in self.jobs.values())
 
     # ---- metrics / status ----
@@ -902,8 +1195,34 @@ def format_fleet_status(spool: str, now: float | None = None) -> str:
             lines.append("degraded    fleet-wide XLA fallback active")
         lines.append(f"heartbeat   {age}")
     state = spool_job_states(spool)
+    leaders = journal_batch_leaders(os.path.join(spool, JOURNAL_FILE))
+    riders: dict = {}
+    for member, leader in leaders.items():
+        if state.get(member) == "batched":
+            riders.setdefault(leader, []).append(member)
+
+    def world_rows(leader: str) -> dict:
+        """{world_name: (update, organisms)} from the leader batch's
+        per-world metric rows (multiworld.prom)."""
+        path = os.path.join(spool, leader, "data", "multiworld.prom")
+        if not os.path.exists(path):
+            return {}
+        m = read_metrics(path)
+        rows: dict = {}
+        for k, v in m.items():
+            if "{world=\"" not in k:
+                continue
+            fam, label = k.split("{world=\"", 1)
+            wname = label.rstrip("\"}")
+            rows.setdefault(wname, {})[fam] = v
+        return {n: (int(d.get("avida_update", 0)),
+                    int(d.get("avida_organisms", 0)))
+                for n, d in rows.items()}
+
     for name in sorted(state):
         st = state[name]
+        if st == "batched" and leaders.get(name) in riders:
+            continue                  # rendered under its leader below
         extra = ""
         sup_prom = os.path.join(spool, name, "data", "supervisor.prom")
         if os.path.exists(sup_prom):
@@ -926,7 +1245,20 @@ def format_fleet_status(spool: str, now: float | None = None) -> str:
             age = "?" if d["age"] is None else str(d["age"])
             extra += (f"  census u{d['update']} age {age}u "
                       f"depth {d['depth']} tasks {d['tasks_held']}")
+        members = riders.get(name, ())
+        if members:
+            extra = f"  (batch x{1 + len(members)}){extra}"
         lines.append(f"  {name:<24} {st}{extra}")
+        if members:
+            # one batched job = one row, its worlds as sub-rows (the
+            # leader's own world first, then each rider's)
+            per = world_rows(name)
+            for wname in [name] + sorted(members):
+                u, orgs = per.get(wname, (None, None))
+                detail = ("(no per-world metrics yet)" if u is None
+                          else f"u{u} organisms {orgs}")
+                role = "lead" if wname == name else "batched"
+                lines.append(f"    - {wname:<20} {role}  {detail}")
     return "\n".join(lines) if lines else f"empty spool {spool!r}"
 
 

@@ -71,6 +71,21 @@ def generation_name(update: int) -> str:
     return f"{PREFIX}{int(update):012d}"
 
 
+def generation_update(path: str) -> int:
+    """Update number encoded in a generation directory name (-1 when the
+    name does not carry one).  Works for published `ckpt-*` dirs and the
+    crash-window `.old-ckpt-*` asides restore_candidates also scans."""
+    name = os.path.basename(path)
+    i = name.find(PREFIX)
+    if i < 0:
+        return -1
+    digits = name[i + len(PREFIX):].split(".", 1)[0]
+    try:
+        return int(digits)
+    except ValueError:
+        return -1
+
+
 def _fsync_dir(path: str):
     fd = os.open(path, os.O_RDONLY)
     try:
@@ -477,21 +492,35 @@ def _apply(world, manifest: dict, arrays: dict, files: dict):
             world.systematics = arb
 
 
-def restore_checkpoint(base_dir: str, world) -> int:
+def restore_checkpoint(base_dir: str, world, at_update: int | None = None
+                       ) -> int:
     """Restore `world` from the newest VALID generation under base_dir.
 
     Corrupt or truncated generations (manifest/CRC failures) are skipped
     with a runlog warning, falling back to the previous retained one;
     config-incompatible checkpoints raise immediately.  Returns the
-    restored update number."""
+    restored update number.
+
+    at_update pins the restore to the generation saved at that SPECIFIC
+    update (still CRC-verified; asides included).  The multi-world
+    batched driver uses this to re-align a fleet of per-world checkpoint
+    dirs on one common update when a member's newest generation fell
+    back further than its peers' (parallel/multiworld.py)."""
     from avida_tpu.observability.runlog import emit_event
 
     def on_skip(path, err):
         emit_event(world, "checkpoint_corrupt", path=path, error=str(err),
                    detail="falling back to previous retained generation")
 
+    candidates = restore_candidates(base_dir)
+    if at_update is not None:
+        candidates = [p for p in candidates
+                      if generation_update(p) == int(at_update)]
+        if not candidates:
+            raise CheckpointError(
+                f"no generation at update {at_update} under {base_dir!r}")
     last_err = None
-    for path in restore_candidates(base_dir):
+    for path in candidates:
         try:
             manifest, arrays, files = read_generation(path)
         except CheckpointMismatchError:

@@ -973,6 +973,35 @@ class World:
         self._total_births = self._total_births + births.sum()
         return executed
 
+    def _chunkable(self) -> bool:
+        """May event-free stretches run as one scanned device program?
+        Anything needing per-update host work (reversion tests, telemetry
+        phase fencing, generation/births event triggers) forces single
+        stepping.  Shared with the multi-world batched driver
+        (avida_tpu/parallel/multiworld.py), which refuses un-chunkable
+        configs outright."""
+        return (not self._revert_on and self.telemetry is None and
+                not any(ev.trigger in ("generation", "births")
+                        for ev in self.events))
+
+    def _plan_stretch(self, max_updates, max_stretch: int) -> int:
+        """Length of the next event-free stretch starting at self.update,
+        under the event schedule, the systematics drain cap and
+        TPU_MAX_STRETCH.  Power-of-two buckets keep the number of
+        compiled scan variants at <= 8 instead of one per distinct gap.
+        The multi-world batched driver calls this SAME planner, so a
+        batched run's chunk grid is identical to each member's solo
+        grid -- the alignment byte-identical per-world checkpoints rest
+        on."""
+        due = self._next_event_due()
+        if max_updates is not None:
+            due = min(due, max_updates)
+        cap_stretch = 128.0 if self.systematics is None else 8.0
+        if max_stretch > 0:
+            cap_stretch = min(cap_stretch, float(max_stretch))
+        gap = int(max(1.0, min(due - self.update, cap_stretch)))
+        return 1 << (gap.bit_length() - 1)
+
     def _next_event_due(self) -> float:
         """Earliest update > self.update at which any update-trigger event
         fires (inf if none).  Generation/immediate triggers are handled by
@@ -1203,18 +1232,23 @@ class World:
         return path
 
     def resume(self, ckpt_dir: str | None = None,
-               audit: bool | None = None) -> int:
+               audit: bool | None = None,
+               at_update: int | None = None) -> int:
         """Restore this world from the newest VALID checkpoint generation
         and position the run loop to continue bit-exactly (the run PRNG
         stream is a pure function of the restored key and update number).
         Corrupt generations fall back to the previous retained one with a
-        runlog warning.  Returns the restored update number."""
+        runlog warning.  Returns the restored update number.
+
+        at_update pins the restore to one specific generation (the
+        multi-world driver re-aligns its members on a common update;
+        parallel/multiworld.py)."""
         from avida_tpu.utils import checkpoint as ckpt_mod
         base = ckpt_dir or self._ckpt_base()
         if base is None:
             raise ValueError(
                 "no checkpoint directory (set TPU_CKPT_DIR or pass one)")
-        update = ckpt_mod.restore_checkpoint(base, self)
+        update = ckpt_mod.restore_checkpoint(base, self, at_update=at_update)
         # output continuity: files the resumed run opens extend the
         # preempted run's rows instead of truncating them -- after
         # trimming any rows PAST the restored update (a crash that
@@ -1266,9 +1300,7 @@ class World:
         # event-free stretches run as one device program; anything needing
         # per-update host work (systematics, generation triggers,
         # telemetry phase fencing) forces single stepping
-        can_chunk = (not self._revert_on and self.telemetry is None and
-                     not any(ev.trigger in ("generation", "births")
-                             for ev in self.events))
+        can_chunk = self._chunkable()
         # TPU_MAX_STRETCH bounds the event-free stretch (0 = engine
         # default).  Supervised runs set it to trade a little dispatch
         # overhead for operational granularity: chunk boundaries gate
@@ -1293,19 +1325,8 @@ class World:
                     self.process_events()
                 if self._exit:
                     break
-                stretch = 1
-                if can_chunk:
-                    due = self._next_event_due()
-                    if max_updates is not None:
-                        due = min(due, max_updates)
-                    cap_stretch = 128.0 if self.systematics is None else 8.0
-                    if max_stretch > 0:
-                        cap_stretch = min(cap_stretch, float(max_stretch))
-                    gap = int(max(1.0, min(due - self.update, cap_stretch)))
-                    # power-of-two stretch buckets: at most 8 compiled
-                    # variants of the scanned update program instead of one
-                    # per distinct gap length
-                    stretch = 1 << (gap.bit_length() - 1)
+                stretch = (self._plan_stretch(max_updates, max_stretch)
+                           if can_chunk else 1)
                 if stretch > 1:
                     self._pending_exec.append(self.run_updates(stretch))
                     if self.systematics is not None:

@@ -232,6 +232,11 @@ def main():
         line.update(supervisor_restart_fields())
     if os.environ.get("BENCH_ANALYZE", "0") == "1":
         line.update(analytics_fields())
+    if os.environ.get("BENCH_WORLDS", "0") not in ("", "0"):
+        side = int(os.environ.get("BENCH_WORLDS_SIDE",
+                                  "120" if on_tpu else "20"))
+        line.update(multiworld_fields(int(os.environ["BENCH_WORLDS"]),
+                                      side, timed=4 if on_tpu else 3))
     if os.environ.get("BENCH_PHASES", "1") != "0":
         phases = phase_breakdown(world)
         line["phases"] = phases
@@ -243,6 +248,170 @@ def main():
                                 + phases.get("unpack", 0.0), 3)
         line["flush_ms"] = round(phases.get("birth_flush", 0.0), 3)
     print(json.dumps(line))
+
+
+def multiworld_fields(W, side, timed=3, chunk=25):
+    """BENCH_WORLDS=W: fleet-scale batching throughput -- W worlds of
+    side x side organisms advanced by ONE compiled multiworld_scan
+    (parallel/multiworld.py) vs the SAME W worlds run as sequential
+    solo scans (the process-per-tenant model's best case: zero launch
+    or compile overhead, only the smaller per-program device work).
+    Small worlds by default (BENCH_WORLDS_SIDE): that is the regime the
+    fleet serves, where per-update dispatch dominates and batching
+    pays most.  Emits:
+
+      world_count               W
+      sequential_inst_per_sec   aggregate org-inst/s of the W back-to-
+                                back solo runs
+      multiworld_inst_per_sec   aggregate org-inst/s of the batched run
+      per_world_inst_per_sec    the batched run's per-world split
+      batch_efficiency          batched / (W x solo) -- 1.0 = perfect
+                                linear scaling
+      multiworld_ms_per_update_world
+                                observability/harness.measure_multiworld
+                                (caching-immune: every rep advances the
+                                evolved batched state)
+
+    Seeds differ per world (the batch serves distinct tenants); timing
+    fences only at segment ends, identically for both protocols."""
+    from avida_tpu.observability.harness import measure_multiworld
+    from avida_tpu.ops.update import update_scan
+    from avida_tpu.parallel.multiworld import multiworld_scan
+
+    u0 = 1 << 20
+    seeds = [200 + 7 * k for k in range(W)]
+
+    def fresh(seed):
+        params, st, neighbors, _ = build(side, side, 256, seed=seed)
+        return params, st, neighbors, jax.random.key(seed ^ 0xBEEF)
+
+    # sequential baseline: W solo runs back to back, one warm chunk
+    # each (the shared jit cache means only the first pays compile --
+    # generous to the sequential side)
+    seq_exec = 0
+    seq_dt = 0.0
+    for seed in seeds:
+        params, st, neighbors, key = fresh(seed)
+        st, _ = update_scan(params, st, chunk, key, neighbors,
+                            jnp.int32(u0))
+        jax.block_until_ready(st)
+        outs = []
+        t0 = time.perf_counter()
+        for c in range(timed):
+            st, (ex, *_rest) = update_scan(
+                params, st, chunk, key, neighbors,
+                jnp.int32(u0 + (c + 1) * chunk))
+            outs.append(ex)
+        jax.block_until_ready(st)
+        seq_dt += time.perf_counter() - t0
+        seq_exec += int(sum(np.asarray(x, np.int64).sum() for x in outs))
+    seq_ips = seq_exec / seq_dt
+
+    # batched: the same W worlds in one device program
+    built = [fresh(seed) for seed in seeds]
+    params, _, neighbors, _ = built[0]
+    bstate = jax.tree.map(lambda *xs: jnp.stack(xs),
+                          *[b[1] for b in built])
+    bkeys = jnp.stack([b[3] for b in built])
+    bstate, _ = multiworld_scan(params, bstate, chunk, bkeys, neighbors,
+                                jnp.int32(u0))
+    jax.block_until_ready(bstate)
+    outs = []
+    t0 = time.perf_counter()
+    for c in range(timed):
+        bstate, (ex, *_rest) = multiworld_scan(
+            params, bstate, chunk, bkeys, neighbors,
+            jnp.int32(u0 + (c + 1) * chunk))
+        outs.append(ex)
+    jax.block_until_ready(bstate)
+    bat_dt = time.perf_counter() - t0
+    per_world = np.zeros(W, np.int64)
+    for ex in outs:
+        per_world += np.asarray(ex, np.int64).sum(axis=1)
+    bat_ips = float(per_world.sum()) / bat_dt
+
+    mw_ms, _ = measure_multiworld(
+        params, [fresh(seed)[1] for seed in seeds], neighbors,
+        [jax.random.key(s ^ 0xBEEF) for s in seeds])
+    out = {
+        "world_count": W,
+        "world_side": side,
+        "sequential_inst_per_sec": round(seq_ips, 1),
+        "multiworld_inst_per_sec": round(bat_ips, 1),
+        "per_world_inst_per_sec": [round(float(x) / bat_dt, 1)
+                                   for x in per_world],
+        "batch_efficiency": round(bat_ips / (W * seq_ips), 4),
+        "multiworld_ms_per_update_world": round(mw_ms, 3),
+    }
+    if os.environ.get("BENCH_WORLDS_SERVE", "1") != "0":
+        out.update(multiworld_serve_fields(W, side))
+    return out
+
+
+def multiworld_serve_fields(W, side, updates=40):
+    """The fleet-scale half of BENCH_WORLDS: serve W tenants END TO END
+    the two ways the fleet can -- W sequential solo CHILD PROCESSES
+    (the process-per-job model: every tenant pays python + jax launch
+    AND its own ~20-40s compile) versus ONE `--worlds` child batching
+    all W (one launch, one compile, one device program).  This is the
+    cost the orchestrator's device-lane packing actually removes; the
+    steady-state in-program split is the *_inst_per_sec fields above.
+
+    Aggregate serve throughput = total organism-instructions executed /
+    wall seconds, read from each run's final metrics.prom -- the
+    batched and solo runs execute bit-identical trajectories, so the
+    instruction totals agree by construction and the speedup is pure
+    wall time."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    from avida_tpu.observability.exporter import read_metrics
+
+    seeds = [200 + 7 * k for k in range(W)]
+    repo = os.path.dirname(os.path.abspath(__file__))
+    base = ["-set", "WORLD_X", str(side), "-set", "WORLD_Y", str(side),
+            "-set", "TPU_MAX_MEMORY", "256",
+            "-set", "TPU_MAX_STEPS_PER_UPDATE",
+            os.environ.get("BENCH_CAP", "0"),
+            "-set", "TPU_METRICS", "1", "-u", str(updates)]
+    env = dict(os.environ)
+    env.pop("BENCH_WORLDS", None)
+
+    def child(argv):
+        t0 = time.perf_counter()
+        subprocess.run([sys.executable, "-m", "avida_tpu"] + argv,
+                       cwd=repo, env=env, check=True,
+                       stdout=subprocess.DEVNULL,
+                       stderr=subprocess.DEVNULL)
+        return time.perf_counter() - t0
+
+    td = tempfile.mkdtemp(prefix="bench-mw-serve-")
+    try:
+        seq_sec = 0.0
+        seq_insts = 0
+        for s in seeds:
+            d = os.path.join(td, f"solo{s}")
+            seq_sec += child(base + ["-s", str(s), "-d", d])
+            seq_insts += int(read_metrics(
+                os.path.join(d, "metrics.prom"))["avida_insts_total"])
+        droot = os.path.join(td, "batch")
+        mw_sec = child(base + ["--worlds",
+                               ",".join(str(s) for s in seeds),
+                               "-d", droot])
+        mw_insts = int(read_metrics(
+            os.path.join(droot, "metrics.prom"))["avida_insts_total"])
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+    return {
+        "serve_updates": updates,
+        "sequential_serve_sec": round(seq_sec, 2),
+        "multiworld_serve_sec": round(mw_sec, 2),
+        "sequential_serve_inst_per_sec": round(seq_insts / seq_sec, 1),
+        "multiworld_serve_inst_per_sec": round(mw_insts / mw_sec, 1),
+        "serve_speedup_x": round((mw_insts / mw_sec)
+                                 / max(seq_insts / seq_sec, 1e-9), 2),
+    }
 
 
 def supervisor_restart_fields():

@@ -1,8 +1,8 @@
 """Fleet spool CLI: submit / list / cancel / requeue jobs (service/fleet.py).
 
 Usage:
-    python scripts/fleet_tool.py submit SPOOL NAME [--fault-plan S/S...]
-            [--env K=V]... -- CHILD_ARGV...
+    python scripts/fleet_tool.py submit SPOOL NAME [--batch]
+            [--fault-plan S/S...] [--env K=V]... -- CHILD_ARGV...
     python scripts/fleet_tool.py list SPOOL
     python scripts/fleet_tool.py cancel SPOOL NAME
     python scripts/fleet_tool.py requeue SPOOL NAME
@@ -19,6 +19,16 @@ one's startup scan.
 `list` needs no orchestrator at all: it reconstructs job states from
 the fleet journal plus the spool contents, so it answers "what happened
 to my sweep?" after everything has exited.
+
+`--batch` marks the spec for device-lane packing: the orchestrator
+coalesces queued --batch specs whose argv (minus the seed) and env are
+identical into ONE supervised MultiWorld child (`--worlds`,
+avida_tpu/parallel/multiworld.py), so a W-seed sweep costs one process,
+one compile and one device program instead of W.  Each world keeps its
+own job dir, .dat output and solo-compatible checkpoints; on a static
+mismatch (or no peer, or a fault plan) the spec falls back to
+process-per-job with the reason journaled.  The argv must name its seed
+explicitly (`-s N`).
 """
 
 from __future__ import annotations
@@ -35,12 +45,14 @@ def _repo_path():
 
 
 def submit(spool: str, name: str, argv: list, fault_plan=(),
-           env=None) -> str:
+           env=None, batch: bool = False) -> str:
     """Write one job spec atomically; returns its path.  Validates with
     the orchestrator's own schema check so a typo is caught here, not
     quarantined later."""
     _repo_path()
-    from avida_tpu.service.fleet import legal_name, validate_spec
+    from avida_tpu.service.fleet import (legal_name,
+                                         spec_seed_and_batch_key,
+                                         validate_spec)
     if not legal_name(name):
         raise ValueError(f"illegal job name {name!r}")
     spec = {"argv": list(argv)}
@@ -48,6 +60,14 @@ def submit(spool: str, name: str, argv: list, fault_plan=(),
         spec["fault_plan"] = list(fault_plan)
     if env:
         spec["env"] = dict(env)
+    if batch:
+        spec["batch"] = True
+        if fault_plan:
+            raise ValueError("--batch and --fault-plan are exclusive "
+                             "(fault injection is per-process)")
+        if spec_seed_and_batch_key(spec)[0] is None:
+            raise ValueError("--batch needs an explicit seed in the "
+                             "child argv (-s N) to key the world")
     validate_spec(spec)
     os.makedirs(spool, exist_ok=True)
     path = os.path.join(spool, name + ".json")
@@ -92,7 +112,7 @@ def main(argv=None) -> int:
         name = rest[0]
         sep = rest.index("--")
         flags, child = rest[1:sep], rest[sep + 1:]
-        fault_plan, env = (), {}
+        fault_plan, env, batch = (), {}, False
         i = 0
         while i < len(flags):
             if flags[i] == "--fault-plan" and i + 1 < len(flags):
@@ -103,12 +123,15 @@ def main(argv=None) -> int:
                 k, _, v = flags[i + 1].partition("=")
                 env[k] = v
                 i += 2
+            elif flags[i] == "--batch":
+                batch = True
+                i += 1
             else:
                 print(f"unknown submit flag {flags[i]!r}")
                 return 2
         try:
             path = submit(spool, name, child, fault_plan=fault_plan,
-                          env=env)
+                          env=env, batch=batch)
         except ValueError as e:
             print(f"submit rejected: {e}")
             return 2

@@ -582,6 +582,210 @@ def test_fleet_main_cli_parse(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# device-lane packing: '"batch": true' spec coalescing (host-only, stub
+# children through the Supervisor._spawn seam -- no jax compile)
+# ---------------------------------------------------------------------------
+
+def test_spec_seed_and_batch_key():
+    from avida_tpu.service.fleet import spec_seed_and_batch_key
+    s, k = spec_seed_and_batch_key({"argv": ["-u", "10", "-s", "7"]})
+    assert s == 7 and k[0] == ("-u", "10")
+    s2, k2 = spec_seed_and_batch_key(
+        {"argv": ["-u", "10", "-set", "RANDOM_SEED", "9"]})
+    assert s2 == 9 and k2[0] == ("-u", "10")
+    assert k == k2                       # seed spelling doesn't split keys
+    s3, k3 = spec_seed_and_batch_key({"argv": ["-u", "10"]})
+    assert s3 is None                    # no explicit seed: unbatchable
+    # precedence mirrors the solo CLI: -s is appended AFTER -set
+    # overrides by __main__, so it wins regardless of argv position
+    s5, _ = spec_seed_and_batch_key(
+        {"argv": ["-s", "7", "-set", "RANDOM_SEED", "9"]})
+    assert s5 == 7
+    _, k4 = spec_seed_and_batch_key(
+        {"argv": ["-u", "10", "-s", "7"], "env": {"A": "1"}})
+    assert k4 != k                       # env differences split batches
+    validate_spec({"argv": ["-u", "1"], "batch": True})
+    with pytest.raises(ValueError):
+        validate_spec({"argv": ["-u", "1"], "batch": "yes"})
+
+
+def test_fleet_batch_coalesces_static_equal_specs(tmp_path):
+    """Three --batch specs differing only in seed coalesce into ONE
+    supervised --worlds child on one admission slot; a static-mismatched
+    --batch spec falls back to process-per-job with the reason
+    journaled; terminal state propagates to every rider."""
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    for n, s in (("b1", 7), ("b2", 8), ("b3", 9)):
+        fleet_tool.submit(spool, n, ["-u", "10", "-s", str(s)],
+                          batch=True)
+    fleet_tool.submit(spool, "solo1",
+                      ["-u", "10", "-s", "4", "-set", "WORLD_X", "20"],
+                      batch=True)
+    fleet, spool, stubs = _mk_fleet(
+        tmp_path, clk,
+        {"b1": [lambda: ts.FakeProc(clk, code=0, runtime=3.0)],
+         "solo1": [lambda: ts.FakeProc(clk, code=0, runtime=3.0)]},
+        max_jobs=2)
+    assert fleet.run() == 0
+    # ONE child served b1+b2+b3; one more for the fallback
+    assert sorted(n for n, _, _ in stubs.spawned) == ["b1", "solo1"]
+    assert stubs.max_concurrent <= 2
+    argv = next(a for n, _, a in stubs.spawned if n == "b1")
+    i = argv.index("--worlds")
+    with open(argv[i + 1]) as f:
+        manifest = json.load(f)
+    assert [e["name"] for e in manifest] == ["b1", "b2", "b3"]
+    assert [e["seed"] for e in manifest] == [7, 8, 9]
+    for e in manifest:
+        # every rider keeps its OWN fault domain: per-world data and
+        # solo-compatible checkpoints under its own job dir
+        assert e["data_dir"] == os.path.join(spool, e["name"], "data")
+        assert e["ckpt_dir"] == os.path.join(spool, e["name"], "ck")
+    assert "-s" not in argv              # seed lives in the manifest
+    assert argv[argv.index("-d") + 1] == os.path.join(spool, "b1",
+                                                      "data")
+    assert "--resume" in argv            # supervisor restart contract
+    assert all(fleet.jobs[n].state == "done"
+               for n in ("b1", "b2", "b3", "solo1"))
+    events, recs = _events(spool)
+    assert ("coalesce", "b1") in events
+    assert ("coalesced", "b2") in events and ("coalesced", "b3") in events
+    fallback = [r for r in recs if r["event"] == "batch_fallback"]
+    assert [r["job"] for r in fallback] == ["solo1"]
+    state, _, _ = journal_states(os.path.join(spool, JOURNAL_FILE))
+    assert state == {n: "done" for n in ("b1", "b2", "b3", "solo1")}
+
+
+def test_fleet_batch_member_cancel_preempts_and_requeues(tmp_path):
+    """Cancelling a rider preempts the whole batch: the rider lands
+    cancelled, the leader requeues (its per-world checkpoint resumes
+    it), and -- its peer gone -- the requeued spec falls back to a solo
+    process and completes.  Also covers the status view's one-row-plus-
+    sub-rows rendering and journal replay of a live batch."""
+    from avida_tpu.service.fleet import (format_fleet_status,
+                                         journal_batch_leaders)
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    for n, s in (("p1", 3), ("p2", 5)):
+        fleet_tool.submit(spool, n, ["-u", "1000", "-s", str(s)],
+                          batch=True)
+    fleet, spool, stubs = _mk_fleet(
+        tmp_path, clk,
+        {"p1": [lambda: PreemptibleProc(clk, runtime=None),
+                # the fallback boot must republish preempted=0 (real
+                # children do on exit); the drained boot's stale marker
+                # would otherwise classify its clean exit as a preempt
+                lambda: ts.FakeProc(
+                    clk, code=0, runtime=1.0,
+                    on_spawn=lambda p, a, e, lf: ts._write_metrics(
+                        os.path.dirname(lf.name), hb=clk(),
+                        preempted=0))]},
+        max_jobs=2)
+    fleet.poll_once()
+    assert fleet.jobs["p1"].state == "running"
+    assert fleet.jobs["p2"].state == "batched"
+    assert fleet.jobs["p1"].batch_members == ["p2"]
+
+    # status view: one batched row with per-world sub-rows
+    os.makedirs(os.path.join(spool, "p1", "data"), exist_ok=True)
+    with open(os.path.join(spool, "p1", "data",
+                           "multiworld.prom"), "w") as f:
+        f.write('avida_update{world="p1"} 12\n'
+                'avida_update{world="p2"} 12\n'
+                'avida_organisms{world="p1"} 3\n'
+                'avida_organisms{world="p2"} 4\n')
+    view = format_fleet_status(spool, now=clk())
+    assert "(batch x2)" in view
+    assert "- p2" in view and "u12 organisms 4" in view
+    assert "\n  p2 " not in view         # rider has no top-level row
+
+    # a replay over the journal resumes BOTH as queued (the rider's
+    # solo-format checkpoints make it independently resumable --
+    # re-coalescing or running solo both continue bit-exactly)
+    state, _, _ = journal_states(os.path.join(spool, JOURNAL_FILE))
+    assert state == {"p1": "running", "p2": "batched"}
+    assert journal_batch_leaders(
+        os.path.join(spool, JOURNAL_FILE)) == {"p2": "p1"}
+    replay = FleetOrchestrator(spool, cfg=_cfg(), env=dict(SUP_ENV),
+                               clock=clk, sleep=clk.sleep,
+                               spawn_factory=StubChildren(clk, {}).factory)
+    assert replay.jobs["p1"].state == "queued"
+    assert replay.jobs["p2"].state == "queued"
+
+    assert fleet_tool.main(["cancel", spool, "p2"]) == 0
+    for _ in range(4):
+        fleet.poll_once()
+    assert fleet.jobs["p2"].state == "cancelled"
+    proc = stubs.spawned[0][1]
+    assert proc.returncode == 0          # graceful SIGTERM, not kill
+    events, recs = _events(spool)
+    assert ("cancel_requested", "p2") in events
+    # the leader requeued, then -- no peer left -- fell back solo
+    assert fleet.run() == 0
+    assert fleet.jobs["p1"].state == "done"
+    assert fleet.jobs["p2"].state == "cancelled"
+    fallback = [r for r in _events(spool)[1]
+                if r["event"] == "batch_fallback"]
+    assert any(r["job"] == "p1" for r in fallback)
+
+
+def test_fleet_batch_groups_by_resume_progress(tmp_path):
+    """A requeued member with checkpoints must not coalesce with a
+    fresh static-equal spec: the child resumes a batch aligned on one
+    update, so mixed progress would refuse on every boot.  Grouping
+    keys on the newest published generation's update."""
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    for n, s in (("r1", 3), ("r2", 5)):
+        fleet_tool.submit(spool, n, ["-u", "1000", "-s", str(s)],
+                          batch=True)
+    # r1 already has checkpoint progress (a requeued member); r2 is
+    # fresh -- a bare generation dir is all the host-side key reads
+    os.makedirs(os.path.join(spool, "r1", "ck", "ckpt-000000000008"))
+    fleet, spool, stubs = _mk_fleet(
+        tmp_path, clk,
+        {n: [lambda: ts.FakeProc(clk, code=0, runtime=2.0)]
+         for n in ("r1", "r2")},
+        max_jobs=2)
+    assert fleet.run() == 0
+    # no coalesce: two solo children, each journaled as a fallback
+    assert sorted(n for n, _, _ in stubs.spawned) == ["r1", "r2"]
+    assert all("--worlds" not in a for _, _, a in stubs.spawned)
+    reasons = [r.get("reason") for r in _events(spool)[1]
+               if r["event"] == "batch_fallback"]
+    assert reasons and all("peer" in r for r in reasons)
+
+
+def test_fleet_batch_width_cap_splits_groups(tmp_path):
+    """TPU_FLEET_MAX_BATCH bounds how many worlds one batched child
+    stacks: a 5-spec static-equal group at max_batch=2 becomes two
+    2-world batches plus a solo fallback -- the admission throttle's
+    resource bounding survives device-lane packing."""
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    names = [f"c{i}" for i in range(1, 6)]
+    for i, n in enumerate(names):
+        fleet_tool.submit(spool, n, ["-u", "10", "-s", str(i + 1)],
+                          batch=True)
+    fleet, spool, stubs = _mk_fleet(
+        tmp_path, clk,
+        {n: [lambda: ts.FakeProc(clk, code=0, runtime=2.0)]
+         for n in ("c1", "c3", "c5")},
+        max_jobs=3, max_batch=2)
+    assert fleet.run() == 0
+    assert sorted(n for n, _, _ in stubs.spawned) == ["c1", "c3", "c5"]
+    for leader, width in (("c1", 2), ("c3", 2)):
+        argv = next(a for n, _, a in stubs.spawned if n == leader)
+        with open(argv[argv.index("--worlds") + 1]) as f:
+            assert len(json.load(f)) == width
+    assert all(fleet.jobs[n].state == "done" for n in names)
+    reasons = [r.get("reason") for r in _events(spool)[1]
+               if r["event"] == "batch_fallback"]
+    assert "width-cap remainder" in reasons
+
+
+# ---------------------------------------------------------------------------
 # slow: the end-to-end chaos proof with real children
 # ---------------------------------------------------------------------------
 

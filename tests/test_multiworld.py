@@ -1,0 +1,268 @@
+"""Multi-world device batching (parallel/multiworld.py + --worlds CLI).
+
+Tier-1 proves the batching contract on the XLA path: every world in a
+W=4 batch -- mutations on, births on, systematics on -- is bit-exact
+vs its solo run, per-world checkpoints are byte-identical to solo ones,
+and a mixed-seed batch survives SIGTERM preemption + aligned resume.
+The Pallas-kernel / packed-resident-chunk interaction is slow-marked
+(interpret mode).  Single-world behavior is guarded by the jaxpr digest
+gate: batching adds NO state and NO trace change to update_step.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+
+import numpy as np
+import pytest
+
+from avida_tpu.config import AvidaConfig
+from avida_tpu.parallel.multiworld import MultiWorld, multiworld_scan
+from avida_tpu.utils import checkpoint as ckpt_mod
+from avida_tpu.world import World
+
+SEEDS = (3, 11, 29, 41)
+# 17 updates = mutations + births + multiple genotypes at this world
+# config, on a chunk grid of 8+8+1: the trailing SINGLE-update chunk
+# pins the solo run_update drain convention (systematics window
+# stamped with the pre-advance update) under the checkpoint
+# byte-compare.  Only chunk sizes 8 and 1 ever compile; 20 would add
+# a chunk-4 program for both the solo and batched sides -- pure
+# tier-1 budget, no extra coverage
+U = 17
+
+
+def _cfg(seed, ck=None, **extra):
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 8
+    cfg.WORLD_Y = 8
+    cfg.TPU_MAX_MEMORY = 256
+    cfg.RANDOM_SEED = seed
+    cfg.AVE_TIME_SLICE = 100
+    cfg.TPU_MAX_STEPS_PER_UPDATE = 100
+    cfg.set("TPU_CKPT_AUDIT", 0)
+    if ck:
+        cfg.set("TPU_CKPT_DIR", str(ck))
+        cfg.set("TPU_CKPT_EVERY", 8)
+        cfg.set("TPU_CKPT_FINAL", 1)
+    for k, v in extra.items():
+        cfg.set(k, v)
+    return cfg
+
+
+def _world(seed, data, ck=None, **extra):
+    w = World(cfg=_cfg(seed, ck, **extra), data_dir=str(data))
+    w.events = []
+    return w
+
+
+@pytest.fixture(scope="module")
+def solo_refs(tmp_path_factory):
+    """The four uninterrupted solo reference runs (with per-world
+    checkpoint generations) every batch leg compares against."""
+    td = tmp_path_factory.mktemp("solo")
+    refs = []
+    for s in SEEDS:
+        w = _world(s, td / f"d{s}", td / f"ck{s}")
+        w.run(max_updates=U)
+        refs.append((w, str(td / f"ck{s}")))
+    return refs
+
+
+def _assert_world_equal(a, b, nb_scratch_exact=True):
+    """Solo world `a` == batch member `b`: full state, host
+    accumulators, executed totals and the phylogeny."""
+    scratch = ("nb_genome", "nb_len", "nb_cell", "nb_parent", "nb_update")
+    for name in a.state.__dataclass_fields__:
+        va = getattr(a.state, name)
+        if va is None:
+            continue
+        va = np.asarray(va)
+        vb = np.asarray(getattr(b.state, name))
+        if name in scratch and not nb_scratch_exact:
+            cnt = int(np.asarray(a.state.nb_count))
+            va, vb = va[:cnt], vb[:cnt]
+        np.testing.assert_array_equal(va, vb, err_msg=f"field {name}")
+    for attr in ("_avida_time", "_last_ave_gen", "_deaths_this",
+                 "_total_births"):
+        assert np.asarray(getattr(a, attr)) == np.asarray(
+            getattr(b, attr)), attr
+    assert a._flush_exec() == b._flush_exec()
+    assert a.systematics.num_genotypes == b.systematics.num_genotypes
+    assert sorted(g.sequence.tobytes()
+                  for g in a.systematics.live_genotypes()) \
+        == sorted(g.sequence.tobytes()
+                  for g in b.systematics.live_genotypes())
+
+
+def test_w4_batch_bit_exact_and_checkpoints_byte_identical(
+        solo_refs, tmp_path):
+    """The acceptance core: a W=4 batch (distinct seeds, one compiled
+    program) reproduces each member's solo trajectory exactly AND
+    publishes per-world checkpoint generations byte-identical to the
+    solo runs' -- so --resume, ckpt_tool and the analytics pipeline
+    work unchanged on batch output."""
+    worlds = [_world(s, tmp_path / f"d{s}", tmp_path / f"ck{s}",
+                     TPU_METRICS=1) for s in SEEDS]
+    mw = MultiWorld(worlds, data_dir=str(tmp_path / "root"))
+    mw.run(max_updates=U)
+    for i, (solo, solo_ck) in enumerate(solo_refs):
+        _assert_world_equal(solo, mw.worlds[i])
+        ga = ckpt_mod.list_generations(solo_ck)
+        gb = ckpt_mod.list_generations(str(tmp_path / f"ck{SEEDS[i]}"))
+        assert [os.path.basename(p) for p in ga] \
+            == [os.path.basename(p) for p in gb] and ga
+        for pa, pb in zip(ga, gb):
+            for fn in sorted(os.listdir(pa)):
+                with open(os.path.join(pa, fn), "rb") as f:
+                    ba = f.read()
+                with open(os.path.join(pb, fn), "rb") as f:
+                    bb = f.read()
+                if fn == ckpt_mod.MANIFEST:
+                    ja, jb = json.loads(ba), json.loads(bb)
+                    ja.pop("saved_at"), jb.pop("saved_at")
+                    assert ja == jb, f"{os.path.basename(pa)}/{fn}"
+                else:
+                    assert ba == bb, f"{os.path.basename(pa)}/{fn}"
+    # the exporter satellite: aggregate heartbeat at the root plus
+    # per-world labeled rows in multiworld.prom
+    from avida_tpu.observability.exporter import read_metrics
+    agg = read_metrics(str(tmp_path / "root" / "metrics.prom"))
+    per = read_metrics(str(tmp_path / "root" / "multiworld.prom"))
+    assert agg["avida_update"] == U
+    assert per["avida_multiworld_size"] == len(SEEDS)
+    orgs = [per[f'avida_organisms{{world="w{k:03d}"}}']
+            for k in range(len(SEEDS))]
+    assert agg["avida_organisms"] == sum(orgs)
+    assert orgs[0] == mw.worlds[0].num_organisms
+
+
+def test_mixed_seed_batch_sigterm_resume_bit_exact(solo_refs, tmp_path):
+    """SIGTERM lands mid-batch: the preemption flag trips at the next
+    chunk boundary, every world saves a checkpoint at the SAME update,
+    and a fresh batch resumes aligned and finishes bit-exact vs the
+    uninterrupted solo runs."""
+    worlds = [_world(s, tmp_path / f"d{s}", tmp_path / f"ck{s}")
+              for s in SEEDS]
+    mw = MultiWorld(worlds, data_dir=str(tmp_path / "root"))
+
+    def hook(m):
+        if m.update >= 8:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    mw._boundary_hook = hook
+    mw.run(max_updates=U)
+    assert mw.preempted and mw.update < U
+    saved = [ckpt_mod.latest_valid(str(tmp_path / f"ck{s}"))[1]["update"]
+             for s in SEEDS]
+    assert len(set(saved)) == 1          # one aligned preempt boundary
+
+    worlds2 = [_world(s, tmp_path / f"d{s}", tmp_path / f"ck{s}")
+               for s in SEEDS]
+    mw2 = MultiWorld(worlds2, data_dir=str(tmp_path / "root2"))
+    assert mw2.resume() == saved[0]
+    mw2.run(max_updates=U)
+    assert not mw2.preempted
+    for i, (solo, _) in enumerate(solo_refs):
+        # rows past the newborn-ring cursor are drain scratch whose
+        # stale contents legitimately differ across a resume re-chunk
+        _assert_world_equal(solo, mw2.worlds[i], nb_scratch_exact=False)
+
+
+def test_batch_eligibility_validation(tmp_path):
+    from avida_tpu.config.events import parse_event_line
+
+    a = _world(1, tmp_path / "a")
+    with pytest.raises(ValueError, match="identical static"):
+        MultiWorld([a, _world(2, tmp_path / "b", WORLD_X=10)])
+    with pytest.raises(ValueError, match="shared event schedule"):
+        b = _world(2, tmp_path / "c")
+        b.events = [parse_event_line("u 5 Exit")]
+        MultiWorld([a, b])
+    with pytest.raises(ValueError, match="chunkable"):
+        c = _world(1, tmp_path / "e")
+        d = _world(2, tmp_path / "f")
+        c.events = [parse_event_line("g 0:10 PrintAverageData")]
+        d.events = [parse_event_line("g 0:10 PrintAverageData")]
+        MultiWorld([c, d])
+    with pytest.raises(ValueError, match="at least one"):
+        MultiWorld([])
+    # distinct cfg objects are required (seeds/dirs must be per-world)
+    with pytest.raises(ValueError, match="own config"):
+        MultiWorld([a, a])
+
+
+def test_worlds_cli_rejects_bad_spec(tmp_path):
+    from avida_tpu.__main__ import main
+    assert main(["--worlds", str(tmp_path / "nope.json"),
+                 "-u", "1"]) == 2
+
+
+def test_multiworld_off_zero_state_and_jaxpr_digest():
+    """The trace_cap/lane_perm pattern: with no batch in play the
+    engine is untouched -- importing the batcher adds no
+    PopulationState field, and the single-world update_step still
+    traces to the recorded jaxpr digest."""
+    import avida_tpu.parallel.multiworld  # noqa: F401  (the import IS the test)
+    from avida_tpu.core.state import PopulationState
+    assert not any("world" in f or "batch" in f
+                   for f in PopulationState.__dataclass_fields__)
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    import check_jaxpr
+    ok, msg = check_jaxpr.check(check_jaxpr.compute())
+    assert ok, msg
+
+
+@pytest.mark.slow
+def test_batch_matches_solo_on_pallas_and_packed_paths():
+    """The kernel interaction: the batched scan composes with the
+    interpret-mode Pallas cycle kernel AND the packed-resident chunk,
+    bit-exact per world vs solo scans with the same knobs."""
+    import jax
+    import jax.numpy as jnp
+
+    from avida_tpu.ops.update import update_scan
+
+    def mk(seed, packed):
+        cfg = AvidaConfig()
+        cfg.WORLD_X = 8
+        cfg.WORLD_Y = 8
+        cfg.TPU_MAX_MEMORY = 256
+        cfg.RANDOM_SEED = seed
+        cfg.TPU_USE_PALLAS = 1
+        cfg.set("TPU_KERNEL_SHARDS", 1)
+        cfg.set("TPU_LANE_PERM", 0)
+        cfg.set("TPU_PACKED_CHUNK", 1 if packed else 0)
+        cfg.set("TPU_SYSTEMATICS", 0)
+        w = World(cfg=cfg)
+        w.events = []
+        w.inject()
+        return w
+
+    for packed in (False, True):
+        seeds = [5, 9]
+        solo = []
+        for s in seeds:
+            w = mk(s, packed)
+            st, _ = update_scan(w.params, w.state, 4, w._run_key,
+                                w.neighbors, jnp.int32(0))
+            solo.append(st)
+        worlds = [mk(s, packed) for s in seeds]
+        bstate = jax.tree.map(lambda *xs: jnp.stack(xs),
+                              *[w.state for w in worlds])
+        rkeys = jnp.stack([w._run_key for w in worlds])
+        bst, _ = multiworld_scan(worlds[0].params, bstate, 4, rkeys,
+                                 worlds[0].neighbors, jnp.int32(0))
+        for i in range(len(seeds)):
+            for name in bst.__dataclass_fields__:
+                v = getattr(bst, name)
+                if v is None:
+                    continue
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(solo[i], name)),
+                    np.asarray(v)[i],
+                    err_msg=f"packed={packed} world={i} field {name}")
