@@ -208,6 +208,44 @@ def format_analytics_status(metrics: dict, analytics: dict) -> str:
             f"tasks {d['tasks_mask']:#x} ({d['tasks_held']} held)")
 
 
+def multiworld_rows(mw: dict) -> dict:
+    """{world_name: {family: value}} from a multiworld.prom dict --
+    the per-world {world="..."} labeled samples regrouped by world.
+    Shared by the `--status` view below and the fleet per-tenant
+    sub-rows (service/fleet.py) so the parse lives once."""
+    rows: dict = {}
+    for k, v in mw.items():
+        if '{world="' not in k:
+            continue
+        fam, label = k.split('{world="', 1)
+        rows.setdefault(label.rstrip('"}'), {})[fam] = v
+    return rows
+
+
+def format_multiworld_status(mw: dict) -> str:
+    """The batch block of `--status` for a --worlds run: one batch
+    summary line (size, in-program batch efficiency, worst straggler)
+    plus one sub-row per world with its straggler lag."""
+    size = int(mw.get("avida_multiworld_size", 0))
+    eff = mw.get("avida_multiworld_batch_efficiency")
+    rows = multiworld_rows(mw)
+    lags = {n: float(d.get("avida_multiworld_straggler_lag_updates", 0.0))
+            for n, d in rows.items()}
+    head = f"batch       {size} worlds"
+    if eff is not None:
+        head += f", efficiency {float(eff):.2f}"
+    if lags:
+        head += f", worst straggler lag {max(lags.values()):.1f}u"
+    lines = [head]
+    for n in sorted(rows):
+        d = rows[n]
+        lines.append(
+            f"  {n:<18} u{int(d.get('avida_update', 0))} "
+            f"organisms {int(d.get('avida_organisms', 0))} "
+            f"lag {lags.get(n, 0.0):.1f}u")
+    return "\n".join(lines)
+
+
 def status_main(data_dir: str, max_age: float | None = None) -> int:
     """`python -m avida_tpu --status DIR [--max-age SEC]`: print the
     last heartbeat.  Exit status is machine-consumable so external
@@ -221,6 +259,9 @@ def status_main(data_dir: str, max_age: float | None = None) -> int:
         return 1
     metrics = read_metrics(path)
     print(format_status(metrics))
+    mw_path = os.path.join(data_dir, MULTIWORLD_METRICS_FILE)
+    if os.path.exists(mw_path):
+        print(format_multiworld_status(read_metrics(mw_path)))
     sup_path = os.path.join(data_dir, "supervisor.prom")
     if os.path.exists(sup_path):
         sup = read_metrics(sup_path)
@@ -366,6 +407,13 @@ class MultiWorldExporter:
             "time": mw._avida_time,
             "insts": [int(w._cum_insts) for w in mw.worlds],
             "preempted": int(bool(mw.preempted or mw._preempt)),
+            # occupancy accumulators (parallel/multiworld._scan): [W]
+            # per-world trip totals, the per-update batch-max total, and
+            # the update count they cover -> batch_efficiency gauge +
+            # per-world straggler-lag rows
+            "trips": getattr(mw, "_trips", None),
+            "leader_trips": getattr(mw, "_leader_trips", None),
+            "trips_updates": int(getattr(mw, "_trips_updates", 0)),
         }
 
     def _publish(self, snap: dict, durable: bool):
@@ -407,6 +455,7 @@ class MultiWorldExporter:
                       {f'world="{n}"': v
                        for n, v in zip(snap["names"], per[name])})
                      for name in self._PER_WORLD]
+            fams += self._occupancy_families(snap)
             fams.append(("avida_heartbeat_timestamp_seconds",
                          *_HELP["avida_heartbeat_timestamp_seconds"],
                          round(time.time(), 3)))
@@ -414,3 +463,46 @@ class MultiWorldExporter:
                           durable=durable)
         except OSError:
             pass                    # metrics must never kill the batch
+
+    @staticmethod
+    def _occupancy_families(snap: dict) -> list:
+        """The world-axis occupancy gauges (PR-11 satellite).
+
+        batch_efficiency = sum_w(trips_w) / (W * leader_trips): the
+        fraction of the batch's lockstep trip-count budget doing
+        per-world useful work (1.0 = every world wanted exactly the
+        batch-max trips every update; the structural ceiling of
+        in-program batching -- what the world-folded cycle loop /
+        stacked kernel can actually deliver of it is bench.py's
+        batch_efficiency throughput ratio).
+
+        straggler_lag_updates{world=w} = (leader_trips - trips_w) /
+        (leader_trips / updates): how many batch-leader updates' worth
+        of cycles world w sat masked while faster tenants ran -- 0 for
+        the leader, growing for a tenant whose budgets trail the
+        batch."""
+        trips = snap.get("trips")
+        if trips is None or not snap["names"]:
+            return []
+        tl = [float(v) for v in np.asarray(trips).tolist()]
+        leader = float(np.asarray(snap.get("leader_trips") or 0.0))
+        if leader <= 0:
+            # no cycle work yet (or an extinct batch): absent gauges,
+            # never a falsely-perfect 1.0
+            return []
+        upd_n = int(snap.get("trips_updates") or 0)
+        W = len(snap["names"])
+        eff = sum(tl) / (W * leader)
+        per_upd = (leader / upd_n) if upd_n else 0.0
+        lag = [round((leader - t) / per_upd, 2) if per_upd > 0 else 0.0
+               for t in tl]
+        return [
+            ("avida_multiworld_batch_efficiency", "gauge",
+             "sum of per-world trip counts / (W x batch-max trips): "
+             "in-program batching occupancy, 1.0 = no straggler waste",
+             round(eff, 4)),
+            ("avida_multiworld_straggler_lag_updates", "gauge",
+             "batch-leader updates' worth of cycles this world spent "
+             "masked behind faster tenants",
+             {f'world="{n}"': v for n, v in zip(snap["names"], lag)}),
+        ]

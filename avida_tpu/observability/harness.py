@@ -129,6 +129,78 @@ def measure_multiworld(params, sts, neighbors, keys, updates=8, reps=3):
     return ms, bstate
 
 
+def measure_multiworld_phases(params, sts, neighbors, keys, reps=3):
+    """Fenced per-phase attribution of the BATCHED update on the XLA
+    world-folded path (ops/update.update_scan_batched's per-update
+    engine): `pre` = the vmapped resources+schedule prologue, `cycles` =
+    the ONE world-folded while_loop (the tentpole's hot loop), `post` =
+    the vmapped bank+birth epilogue.  Each stage is jitted separately
+    and fenced, exactly like profile_phases does for the solo update, so
+    bench.py can report the cycle loop's share of the batched update.
+
+    Caching-immune: every rep advances the evolved batched state through
+    the full pre->cycles->post chain with a fresh update number.
+    Returns {"pre_ms", "cycles_ms", "post_ms", "cycle_loop_share"}
+    (ms per update for the whole batch; share in [0, 1])."""
+    import time
+    from functools import partial
+
+    from avida_tpu.ops import update as upd
+
+    bst = jax.tree.map(lambda *xs: jnp.stack(xs), *sts)
+    bkeys = jnp.stack(list(keys))
+    u0 = 1 << 21
+
+    @partial(jax.jit, static_argnums=0)
+    def pre(params, bst, keys, u):
+        return jax.vmap(
+            lambda st, k: upd._mw_pre_phase(params, st, k, u))(bst, keys)
+
+    @partial(jax.jit, static_argnums=0)
+    def cycles(params, bst, k_steps, granted, max_k):
+        return upd._mw_fold_cycles_xla(params, bst, k_steps, granted,
+                                       max_k)
+
+    @partial(jax.jit, static_argnums=0)
+    def post(params, bst, budgets, e0, kb, ks, neighbors, u):
+        def one(st, b, e, kb1, ks1):
+            st, executed = upd.bank_phase(params, st, b, e)
+            return upd.birth_phase(params, st, kb1, ks1, neighbors, u)
+
+        return jax.vmap(one)(bst, budgets, e0, kb, ks)
+
+    t = {"pre": 0.0, "cycles": 0.0, "post": 0.0}
+    for r in range(reps + 1):                 # rep 0 warms the compiles
+        u = jnp.int32(u0 + r)
+        keys_r = jax.vmap(
+            lambda rk: jax.random.fold_in(rk, u0 + r))(bkeys)
+        jax.block_until_ready(bst)
+        t0 = time.perf_counter()
+        bst, (budgets, granted, max_k, k_steps, k_birth) = pre(
+            params, bst, keys_r, u)
+        jax.block_until_ready(bst)
+        t1 = time.perf_counter()
+        e0 = bst.insts_executed
+        bst = cycles(params, bst, k_steps, granted, max_k)
+        jax.block_until_ready(bst)
+        t2 = time.perf_counter()
+        bst = post(params, bst, budgets, e0, k_birth, k_steps,
+                   neighbors, u)
+        jax.block_until_ready(bst)
+        t3 = time.perf_counter()
+        if r > 0:
+            t["pre"] += t1 - t0
+            t["cycles"] += t2 - t1
+            t["post"] += t3 - t2
+    total = sum(t.values()) or 1e-9
+    return {
+        "pre_ms": round(t["pre"] * 1e3 / reps, 3),
+        "cycles_ms": round(t["cycles"] * 1e3 / reps, 3),
+        "post_ms": round(t["post"] * 1e3 / reps, 3),
+        "cycle_loop_share": round(t["cycles"] / total, 4),
+    }
+
+
 def measure_trace_drain(cap=4096, n_updates=16, reps=5):
     """Host cost (ms) of one flight-recorder chunk-boundary drain at its
     worst case: a FULL ring of `cap` events spread over `n_updates`

@@ -1395,6 +1395,26 @@ def flush_births_packed(params, st, key, planes, update_no):
     return (tape_t, off_t, gen_t, ivec, fvec), st
 
 
+def flush_births_packed_worlds(params, bst, keys, planes, update_no):
+    """World-blocked packed birth flush for a stacked multi-world chunk
+    (ops/packed_chunk.update_step_packed_worlds).
+
+    `planes` carry lanes split per world ([LP, W, N] / [NI, W, N] /
+    [NF, W, N]); `bst`/`keys` carry the leading world axis.  The flush
+    is the per-world flush vmapped over that axis, which makes the
+    world-boundary guarantee STRUCTURAL: every lane-axis roll
+    (_pk_roll2d), byte-funnel shift and newborn scatter runs inside one
+    world's own [LP, N] block, so a birth landing on the last lane of a
+    world can never read or write the next world's first lane
+    (tests/test_multiworld.py's boundary cross-talk guard), and each
+    world consumes its own flush key exactly as its solo run does."""
+    return jax.vmap(
+        lambda st, key, pl5: flush_births_packed(params, st, key, pl5,
+                                                 update_no),
+        in_axes=(0, 0, 1), out_axes=(1, 0),
+    )(bst, keys, planes)
+
+
 def flush_injections(params, st, key, neighbors):
     """Parasite transmission: each organism with a staged injection
     (inject_pending from Inst_Inject) targets a random neighbor; infection

@@ -63,40 +63,65 @@ class PackedChunk(struct.PyTreeNode):
     fvec: jax.Array          # f32[NF, N]  float phenotype scalars
 
 
-def active(params, st=None) -> bool:
-    """Static routing predicate: may this configuration keep state
-    packed across a chunk?  Everything here is trace-time (params +
-    state SHAPES), so update_scan / update_step / bench all agree.
+def ineligible_reason(params, nb_ring: bool = False) -> str | None:
+    """Why this configuration cannot keep state packed across a chunk
+    (None = eligible).  The single spelling of the routing predicate:
+    `active` below delegates here, and the multi-world driver
+    (parallel/multiworld.py) reports this string in the runlog when a
+    batch falls back to the per-update engine, so a fleet operator can
+    see WHY a batch is not on the pack-once/unpack-once path.
+
+    `nb_ring`: whether the state carries a non-empty newborn ring
+    (systematics records gather newborn genomes row-wise -- a lane-axis
+    gather in packed space; run with TPU_SYSTEMATICS=0 for the packed
+    path).
 
     Requirements beyond the kernel's own `eligible`: the torus birth
     fast path (the packed flush is roll-based), asexual, no demes /
     energy / population caps, no point or slip mutations (per-site
     [N, L] sweeps / variable-size region moves stay canonical), no
-    resource pools (resource_phase must not read stale planes), no
-    device-side fault injection, and an EMPTY newborn ring (systematics
-    records gather newborn genomes row-wise -- a lane-axis gather in
-    packed space; run with TPU_SYSTEMATICS=0 for the packed path)."""
+    resource pools (resource_phase must not read stale planes), and no
+    device-side fault injection."""
     from avida_tpu.ops.update import use_pallas_path
     if int(getattr(params, "packed_chunk", 1)) == 0:
-        return False
+        return "TPU_PACKED_CHUNK=0"
     if params.hw_type != 0 or params.max_cpu_threads > 1:
-        return False
+        return "non-heads hardware or multi-threaded CPUs (XLA path)"
     if not use_pallas_path(params):
-        return False
+        return ("Pallas cycle kernel off for this run "
+                "(TPU_USE_PALLAS / eligibility / backend)")
     if birth_ops.has_divide_sex(params):
-        return False
+        return "divide-sex instruction set (recombination is canonical)"
     if not birth_ops.local_torus_fast_path(params, sexual=False):
-        return False
+        return ("birth placement off the torus fast path (geometry / "
+                "birth method / demes / population caps)")
     if params.point_mut_prob > 0 or params.divide_slip_prob > 0:
-        return False
+        return "point or slip mutations (per-site sweeps stay canonical)"
     if params.num_global_res or params.num_spatial_res \
             or params.num_deme_res:
-        return False
+        return "resource pools (resource_phase reads canonical planes)"
     if getattr(params, "fault_nan", ()):
-        return False
-    if st is not None and st.nb_genome.shape[0] > 0:
-        return False
-    return True
+        return "device-side fault injection armed (TPU_FAULT nan:)"
+    if nb_ring:
+        return ("systematics newborn ring in use (TPU_SYSTEMATICS=1; "
+                "newborn-record gathers stay canonical)")
+    return None
+
+
+def active(params, st=None) -> bool:
+    """Static routing predicate: may this configuration keep state
+    packed across a chunk?  Everything here is trace-time (params +
+    state SHAPES), so update_scan / update_step / bench all agree.
+    See ineligible_reason for the individual gates."""
+    return ineligible_reason(
+        params, st is not None and st.nb_genome.shape[0] > 0) is None
+
+
+def batch_active(params, bst) -> bool:
+    """`active` for a W-stacked batch state (leading world axis on
+    every leaf): the static gates are per-config, so world 0 answers
+    for the whole (static-equal) batch."""
+    return active(params, jax.tree.map(lambda x: x[0], bst))
 
 
 def pack_chunk(params, st) -> PackedChunk:
@@ -148,6 +173,22 @@ def _launch(params, planes, key, cap):
     return out
 
 
+def _bank_rows(params, st, ivec, budgets, executed0):
+    """bank_phase in row space -- same values as ops/update.bank_phase
+    on the unpacked state (insts_executed and alive are ivec-backed).
+    Elementwise, so it serves both the solo [N] and the stacked
+    multi-world [W, N] steps from ONE spelling: a change to the carry
+    clamp or alive gating cannot break the solo-vs-stacked bit-exactness
+    contract.  Returns (st, executed_this); callers reduce
+    executed_this over their own lane axes."""
+    executed_this = ivec[pallas_cycles.IV_INSTS_EXEC] - executed0
+    alive_k = (ivec[pallas_cycles.IV_FLAGS] & pallas_cycles.FLAG_ALIVE) != 0
+    carry = jnp.clip(budgets - executed_this, 0,
+                     100 * params.ave_time_slice)
+    st = st.replace(budget_carry=jnp.where(alive_k, carry, 0))
+    return st, executed_this
+
+
 def update_step_packed(params, pc: PackedChunk, key, neighbors, update_no):
     """One update on resident planes -- the packed mirror of
     ops/update.update_step's phase order (resources -> schedule ->
@@ -174,13 +215,7 @@ def update_step_packed(params, pc: PackedChunk, key, neighbors, update_no):
         params, (pc.tape_t, pc.off_t, ivec, pc.fvec), k_steps,
         upd.static_cap(params))
 
-    # bank_phase on rows (same values as ops/update.bank_phase on the
-    # unpacked state: insts_executed and alive are ivec-backed)
-    executed_this = ivec[IV_INSTS] - executed0
-    alive_k = (ivec[pallas_cycles.IV_FLAGS] & pallas_cycles.FLAG_ALIVE) != 0
-    carry = jnp.clip(budgets - executed_this, 0,
-                     100 * params.ave_time_slice)
-    st = st.replace(budget_carry=jnp.where(alive_k, carry, 0))
+    st, executed_this = _bank_rows(params, st, ivec, budgets, executed0)
     executed = executed_this.sum()
 
     planes, st = birth_ops.flush_births_packed(
@@ -193,3 +228,127 @@ def update_step_packed(params, pc: PackedChunk, key, neighbors, update_no):
     tape_t, off_t, gen_t, ivec, fvec = planes
     return pc.replace(st=st, tape_t=tape_t, off_t=off_t, gen_t=gen_t,
                       ivec=ivec, fvec=fvec), executed
+
+
+# ---- stacked multi-world residency (PR 11 Stage 2) ----
+#
+# A fleet batch of W static-equal worlds keeps ALL of them resident in
+# packed layout for a whole chunk: each plane grows a world axis in the
+# middle ([rows, W, N], world-major lanes), the per-update kernel
+# launch flattens it onto the lane axis ([rows, W*N] -- one grid, one
+# launch, per-world PRNG seed bases; pallas_cycles.run_packed_stacked)
+# and the birth flush runs world-blocked (birth_ops.
+# flush_births_packed_worlds: every roll stays inside one world's
+# plane).  Pack once, scan the chunk, unpack once -- the multi-world
+# mirror of PackedChunk, bit-exact per world vs the solo packed scan.
+
+
+class PackedWorlds(struct.PyTreeNode):
+    """Resident multi-world chunk state: batched canonical carrier
+    (leading world axis, like MultiWorld's bstate) + the five planes
+    with lanes split [rows, W, N]."""
+    bst: object              # PopulationState, every leaf [W, ...]
+    tape_t: jax.Array        # int32[LP, W, N]
+    off_t: jax.Array         # int32[LP, W, N]
+    gen_t: jax.Array         # int32[LP, W, N]
+    ivec: jax.Array          # int32[NI, W, N]
+    fvec: jax.Array          # f32[NF, W, N]
+
+
+def pack_worlds(params, bst) -> PackedWorlds:
+    """Batched canonical state -> stacked resident planes (traced; once
+    per chunk).  vmap of pack_chunk with the world axis moved behind
+    the row axis, so every plane keeps rows leading (the kernel's
+    sublane dimension) and worlds contiguous on lanes."""
+    pc = jax.vmap(lambda st: pack_chunk(params, st))(bst)
+
+    def mv(x):
+        return jnp.moveaxis(x, 0, 1)
+
+    return PackedWorlds(bst=pc.st, tape_t=mv(pc.tape_t),
+                        off_t=mv(pc.off_t), gen_t=mv(pc.gen_t),
+                        ivec=mv(pc.ivec), fvec=mv(pc.fvec))
+
+
+def unpack_worlds(params, pw: PackedWorlds):
+    """Stacked resident planes -> batched canonical state (traced; once
+    per chunk) -- the inverse of pack_worlds."""
+    def mv(x):
+        return jnp.moveaxis(x, 1, 0)
+
+    pc = PackedChunk(st=pw.bst, tape_t=mv(pw.tape_t), off_t=mv(pw.off_t),
+                     gen_t=mv(pw.gen_t), ivec=mv(pw.ivec),
+                     fvec=mv(pw.fvec))
+    return jax.vmap(lambda p: unpack_chunk(params, p))(pc)
+
+
+def _launch_worlds(params, planes, seeds, cap):
+    """One stacked kernel launch over W worlds' resident planes: pad
+    each world's lanes to the block quantum, flatten the world axis
+    onto lanes (world-major -- blocks never straddle worlds), launch,
+    slice back."""
+    from avida_tpu.ops import pallas_cycles as pc
+    n = planes[0].shape[2]
+    W = planes[0].shape[1]
+    B, n_pad, _ = pc._dims(params, n, params.max_memory, 1)
+    pad = n_pad - n
+
+    def flat(x):
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, pad)))
+        return x.reshape(x.shape[0], W * n_pad)
+
+    out = pc.run_packed_stacked(params, tuple(flat(x) for x in planes),
+                                seeds, cap, B)
+    return tuple(o.reshape(o.shape[0], W, n_pad)[:, :, :n] for o in out)
+
+
+def update_step_packed_worlds(params, pw: PackedWorlds, keys, neighbors,
+                              update_no):
+    """One update for W worlds on stacked resident planes -- the
+    multi-world mirror of update_step_packed, phase for phase, with the
+    cheap phases vmapped over the world axis and the kernel cycle loop
+    run as ONE stacked launch.  Consumes each world's solo PRNG splits
+    exactly (split per world, randint seed per world, flush key per
+    world), so each world is bit-exact vs its solo packed scan.
+    Returns (pw', executed[W], trips[W])."""
+    from avida_tpu.ops import update as upd
+    IV_GRANTED = pallas_cycles.IV_GRANTED
+    IV_INSTS = pallas_cycles.IV_INSTS_EXEC
+
+    ks = jax.vmap(lambda k: jax.random.split(k, 3))(keys)
+    k_budget, k_steps, k_birth = ks[:, 0], ks[:, 1], ks[:, 2]
+
+    st = jax.vmap(
+        lambda s, k: upd.resource_phase(params, s, k, update_no)
+    )(pw.bst, keys)
+    budgets, granted, max_k = jax.vmap(
+        lambda s, k: upd.schedule_phase(params, s, k))(st, k_budget)
+    ivec = pw.ivec.at[IV_GRANTED].set(granted)
+
+    if params.trace_cap:
+        st, tsnap = jax.vmap(
+            lambda s, g: upd.trace_pre_phase(params, s, g, update_no)
+        )(st, granted)
+
+    executed0 = ivec[IV_INSTS]
+    seeds = pallas_cycles.world_seed_bases(k_steps)
+    tape_t, off_t, ivec, fvec = _launch_worlds(
+        params, (pw.tape_t, pw.off_t, ivec, pw.fvec), seeds,
+        upd.static_cap(params))
+
+    st, executed_this = _bank_rows(params, st, ivec, budgets, executed0)
+    executed = executed_this.sum(axis=1)
+
+    planes, st = birth_ops.flush_births_packed_worlds(
+        params, st, k_birth, (tape_t, off_t, pw.gen_t, ivec, fvec),
+        update_no)
+
+    if params.trace_cap:
+        st = jax.vmap(
+            lambda s, sn: upd.trace_post_phase(params, s, sn, update_no)
+        )(st, tsnap)
+
+    tape_t, off_t, gen_t, ivec, fvec = planes
+    return pw.replace(bst=st, tape_t=tape_t, off_t=off_t, gen_t=gen_t,
+                      ivec=ivec, fvec=fvec), executed, max_k

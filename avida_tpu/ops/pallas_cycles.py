@@ -422,7 +422,12 @@ def _make_kernel(params, L, B, num_steps, interpret=False):
         fvec_ref[...] = fvec_in[...]
         if (params.copy_mut_prob > 0 or params.inst_prob_fail) \
                 and not interpret:
-            pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
+            # seed_ref is block-mapped (BlockSpec (1,) over the per-block
+            # seed vector): the host bakes the block's global offset --
+            # and, for a stacked multi-world launch (run_packed_stacked),
+            # the block's WORLD seed base -- into seed_ref[0], so the
+            # kernel body needs no program_id arithmetic
+            pltpu.prng_seed(seed_ref[0])
 
         granted = ivec_ref[IV_GRANTED, :][None, :]
         # index planes (built in-kernel: closure constants are not allowed)
@@ -457,7 +462,12 @@ def _make_kernel(params, L, B, num_steps, interpret=False):
                 unsupported in Mosaic; the top 24 bits fit an int32
                 exactly).  In interpret mode (CPU tests): a counter-based
                 splitmix-style hash of (seed, block, cycle, lane, tag) --
-                pltpu.prng_* has no CPU lowering."""
+                pltpu.prng_* has no CPU lowering.  seed_ref[0] is the
+                block-mapped per-block seed; for SOLO launches the host
+                passes the same seed to every interpret-mode block (the
+                historical stream, kept so recorded trajectories stay
+                valid), while a stacked multi-world launch passes each
+                world its own seed base."""
                 if not interpret:
                     b = pltpu.bitcast(pltpu.prng_random_bits((1, B)),
                                       jnp.uint32)
@@ -1563,40 +1573,31 @@ def pack_state(params, st, granted, perm=None, shards=1):
     return opc_t, off_t, ivec, fvec
 
 
-def run_packed(params, packed, key, num_steps):
-    """Kernel launch(es) over the packed state quad (traced).
-
-    Single device: one pallas_call over all blocks.  Multiple shards
-    (kernel_shards): the SAME launch is shard_map'd over the `cells` mesh
-    axis -- pallas_call registers no GSPMD partitioning rule, so the
-    manual shard_map is what keeps a sharded multi-chip update on the
-    kernel instead of silently falling back to the HBM-round-tripping XLA
-    while_loop.  Blocks are independent (fast-path precondition), so
-    shards need no communication; each shard's per-block PRNG seed is
-    offset by its global block base so the sharded trajectory is
-    bit-identical to the unsharded one."""
+def _launch_packed(params, packed, block_seeds, num_steps, B, S):
+    """The shared launch core: one pallas_call over `grid` blocks of B
+    lanes (shard_map'd over the `cells` mesh axis when S > 1), with the
+    PRNG seed delivered PER BLOCK via `block_seeds` (int32[total_blocks],
+    block-mapped into SMEM).  The callers own the seed schedule:
+    run_packed reproduces the historical solo streams exactly;
+    run_packed_stacked gives every world its own seed base so a stacked
+    launch replays each member's solo streams."""
     tape_t, off_t, ivec, fvec = packed
     LP, n_pad = tape_t.shape
     L = LP * 4
     NI, LW, _, _ = _layout(params, L)
-    S = kernel_shards(params)
-    if S > 1 and (n_pad % S or (n_pad // S) % 128):
-        S = 1                        # caller packed without shard padding
     n_loc = n_pad // S
-    B = min(DEFAULT_BLOCK, n_loc)
-
-    seed = jax.random.randint(key, (1,), 0, 2**31 - 1, dtype=jnp.int32)
 
     interpret = jax.devices()[0].platform != "tpu"
     kernel, _ = _make_kernel(params, L, B, num_steps, interpret)
     grid = (n_loc // B,)
 
-    def launch(seed, tape_t, off_t, ivec, fvec):
+    def launch(seeds, tape_t, off_t, ivec, fvec):
         return pl.pallas_call(
             kernel,
             grid=grid,
             in_specs=[
-                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((1,), lambda i: (i,),
+                             memory_space=pltpu.SMEM),
                 pl.BlockSpec((LP, B), lambda i: (0, i)),
                 pl.BlockSpec((LP, B), lambda i: (0, i)),
                 pl.BlockSpec((NI, B), lambda i: (0, i)),
@@ -1616,32 +1617,115 @@ def run_packed(params, packed, key, num_steps):
             ],
             input_output_aliases={1: 0, 2: 1, 3: 2, 4: 3},
             interpret=interpret,
-        )(seed, tape_t, off_t, ivec, fvec)
+        )(seeds, tape_t, off_t, ivec, fvec)
 
     if S == 1:
-        return tuple(launch(seed, tape_t, off_t, ivec, fvec))
+        return tuple(launch(block_seeds, tape_t, off_t, ivec, fvec))
 
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
     from avida_tpu.parallel.mesh import CELL_AXIS, make_mesh
 
     mesh = make_mesh(jax.devices()[:S])
-
-    def launch_shard(seed, tape_t, off_t, ivec, fvec):
-        # per-shard seed base = global block index of the shard's first
-        # block, so block b of shard s seeds exactly like global block
-        # s*grid + b of an unsharded launch (bit-exactness under sharding)
-        base = seed + jax.lax.axis_index(CELL_AXIS) * grid[0]
-        return launch(base, tape_t, off_t, ivec, fvec)
-
     lane = P(None, CELL_AXIS)
     out = shard_map(
-        launch_shard, mesh=mesh,
-        in_specs=(P(), lane, lane, lane, lane),
+        launch, mesh=mesh,
+        # block_seeds carries each block's GLOBAL seed already, so the
+        # vector shards right alongside the lanes it seeds
+        in_specs=(P(CELL_AXIS), lane, lane, lane, lane),
         out_specs=(lane, lane, lane, lane),
         check_rep=False,
-    )(seed, tape_t, off_t, ivec, fvec)
+    )(block_seeds, tape_t, off_t, ivec, fvec)
     return tuple(out)
+
+
+def kernel_seed(key):
+    """The kernel PRNG seed draw -- ONE spelling (width, bound, dtype)
+    shared by every launch path.  Stacked-vs-solo bit-exactness depends
+    on world_seed_bases below reproducing this draw for each world's
+    own k_steps key, so any change to the derivation must happen here
+    and nowhere else."""
+    return jax.random.randint(key, (1,), 0, 2**31 - 1, dtype=jnp.int32)
+
+
+def world_seed_bases(k_steps):
+    """Per-world seed bases for a stacked multi-world launch
+    (int32[W]): world w's base is exactly the kernel_seed its SOLO
+    launch would draw from the same k_steps_w, which is what makes
+    run_packed_stacked bit-exact per world vs solo by construction.
+    The single spelling shared by ops/update._mw_stack_kernel_cycles
+    and ops/packed_chunk.update_step_packed_worlds."""
+    return jax.vmap(kernel_seed)(k_steps)[:, 0]
+
+
+def run_packed(params, packed, key, num_steps):
+    """Kernel launch(es) over the packed state quad (traced).
+
+    Single device: one pallas_call over all blocks.  Multiple shards
+    (kernel_shards): the SAME launch is shard_map'd over the `cells` mesh
+    axis -- pallas_call registers no GSPMD partitioning rule, so the
+    manual shard_map is what keeps a sharded multi-chip update on the
+    kernel instead of silently falling back to the HBM-round-tripping XLA
+    while_loop.  Blocks are independent (fast-path precondition), so
+    shards need no communication; each block's PRNG seed is its global
+    block base (seed + global block index on TPU) so the sharded
+    trajectory is bit-identical to the unsharded one."""
+    tape_t, off_t, ivec, fvec = packed
+    LP, n_pad = tape_t.shape
+    S = kernel_shards(params)
+    if S > 1 and (n_pad % S or (n_pad // S) % 128):
+        S = 1                        # caller packed without shard padding
+    n_loc = n_pad // S
+    B = min(DEFAULT_BLOCK, n_loc)
+
+    seed = kernel_seed(key)
+    total = n_pad // B
+    blk = jnp.arange(total, dtype=jnp.int32)
+    if jax.devices()[0].platform == "tpu":
+        block_seeds = seed + blk
+    else:
+        # interpret mode has no in-kernel block offset historically: all
+        # of a (shard's) launch's blocks share the shard base.  Preserved
+        # exactly -- every recorded interpret trajectory (tests,
+        # checkpoints) depends on these streams.
+        block_seeds = seed + (blk // (n_loc // B)) * (n_loc // B)
+    return _launch_packed(params, (tape_t, off_t, ivec, fvec),
+                          block_seeds, num_steps, B, S)
+
+
+def run_packed_stacked(params, packed, world_seeds, num_steps, B):
+    """ONE kernel launch over W worlds' planes stacked on the lane axis.
+
+    `packed` is the usual quad but with n_pad = W x n_w lanes laid out
+    world-major (world w owns lanes [w*n_w, (w+1)*n_w); n_w a multiple
+    of the per-world block width B, so no block ever straddles a world
+    boundary).  `world_seeds` (int32[W]) are the per-world seed bases --
+    block b of world w seeds exactly like block b of world w's SOLO
+    UNSHARDED launch (TPU: seed_w + b; interpret mode: seed_w), which
+    makes the stacked launch bit-exact per world vs solo by construction
+    on both backends, independent of TPU_KERNEL_SHARDS.
+
+    This is what lets the two-level scheduler (per-block while_loop early
+    exit + TPU_KERNEL_ROWSKIP) load-balance ragged budgets ACROSS
+    tenants: each world's blocks run to their own max granted budget
+    inside one launch, instead of every world idling on the batch-max
+    trip count of a vmapped loop."""
+    tape_t, off_t, ivec, fvec = packed
+    LP, lanes = tape_t.shape
+    W = world_seeds.shape[0]
+    n_w = lanes // W
+    bpw = n_w // B                   # blocks per world
+    S = kernel_shards(params)
+    if S > 1 and (lanes % S or (lanes // S) % 128 or (lanes // S) % B):
+        S = 1                        # stacking incompatible with S shards
+    blk = jnp.arange(bpw, dtype=jnp.int32)[None, :]
+    if jax.devices()[0].platform == "tpu":
+        block_seeds = (world_seeds[:, None] + blk).reshape(W * bpw)
+    else:
+        block_seeds = jnp.broadcast_to(
+            world_seeds[:, None], (W, bpw)).reshape(W * bpw)
+    return _launch_packed(params, (tape_t, off_t, ivec, fvec),
+                          block_seeds, num_steps, B, S)
 
 
 def unpack_state(params, st, packed, inv=None, restore_ro=False):

@@ -272,6 +272,40 @@ def trace_post_phase(params, st, snap, update_no):
     return st
 
 
+def _cycle_step_fn(params):
+    """The hardware-type micro-step dispatch -- ONE spelling shared by
+    the solo cycle loop (interpret_phase) and the world-folded batched
+    one (_mw_fold_cycles_xla), so a new hardware type routes both
+    engines and cannot desynchronize them."""
+    if params.hw_type in (1, 2):
+        from avida_tpu.ops.interpreter_smt import micro_step_smt
+        return micro_step_smt
+    if params.max_cpu_threads > 1:
+        from avida_tpu.ops.interpreter import micro_step_threads
+        return micro_step_threads
+    return micro_step
+
+
+def _materialize_offspring(params, st, pending_before):
+    """End-of-update offspring materialization for the heads XLA path:
+    extract each freshly divided parent's offspring into off_tape (the
+    Pallas kernel does this at the divide cycle; one masked barrel roll
+    per update keeps the two paths bit-identical).  A stalled parent's
+    tape is frozen, so end-of-update extraction sees exactly the
+    divide-time bytes.  Shared by interpret_phase and (vmapped) the
+    world-folded batched loop, so a fix here applies to both and the
+    batched-vs-solo bit-exactness contract cannot silently drift."""
+    from avida_tpu.ops.interpreter import barrel_shift_left, tape_ops
+    new_div = st.divide_pending & ~pending_before
+    L_ = st.tape.shape[1]
+    ext = barrel_shift_left(
+        tape_ops(st.tape).astype(jnp.uint8), st.off_start, L_)
+    ext = jnp.where(jnp.arange(L_)[None, :] < st.off_len[:, None],
+                    ext, jnp.uint8(0))
+    return st.replace(off_tape=jnp.where(new_div[:, None], ext,
+                                         st.off_tape))
+
+
 def interpret_phase(params, st, k_steps, granted, max_k, cap, counters=None):
     """Run the update's lockstep cycles (Pallas kernel or XLA while_loop)
     plus the end-of-update offspring materialization.
@@ -292,14 +326,7 @@ def interpret_phase(params, st, k_steps, granted, max_k, cap, counters=None):
         st = pallas_cycles.run_cycles(params, st, k_steps, granted, int(cap))
         return st, counters
 
-    if params.hw_type in (1, 2):
-        from avida_tpu.ops.interpreter_smt import micro_step_smt
-        step_fn = micro_step_smt
-    elif params.max_cpu_threads > 1:
-        from avida_tpu.ops.interpreter import micro_step_threads
-        step_fn = micro_step_threads
-    else:
-        step_fn = micro_step
+    step_fn = _cycle_step_fn(params)
 
     if counters is None:
         def cond(carry):
@@ -338,20 +365,7 @@ def interpret_phase(params, st, k_steps, granted, max_k, cap, counters=None):
         _, st, counters = jax.lax.while_loop(
             cond_c, body_c, (jnp.int32(0), st, counters))
     if params.hw_type == 0:
-        # materialize this update's new offspring into off_tape (the
-        # Pallas kernel does this at the divide cycle; here one masked
-        # barrel roll per update keeps the two paths bit-identical) --
-        # a stalled parent's tape is frozen, so end-of-update extraction
-        # sees exactly the divide-time bytes
-        from avida_tpu.ops.interpreter import barrel_shift_left, tape_ops
-        new_div = st.divide_pending & ~pending_before
-        n_, L_ = st.tape.shape
-        ext = barrel_shift_left(
-            tape_ops(st.tape).astype(jnp.uint8), st.off_start, L_)
-        ext = jnp.where(jnp.arange(L_)[None, :] < st.off_len[:, None],
-                        ext, jnp.uint8(0))
-        st = st.replace(off_tape=jnp.where(new_div[:, None], ext,
-                                           st.off_tape))
+        st = _materialize_offspring(params, st, pending_before)
     return st, counters
 
 
@@ -438,6 +452,18 @@ def _point_mutation_sweep(params, st, key):
     return st.replace(tape=jnp.where(hit, mutated, st.tape))
 
 
+def _update_stats(params, st, alive_before, update_no):
+    """The per-update host-bookkeeping tuple shared by every scan body
+    (solo / W-batched x per-update / packed-resident): light_stats plus
+    the deaths balance and the avida-time delta.  One spelling, so a
+    change to the deaths clamp or dt derivation applies to all four
+    engines and cannot desynchronize solo vs batched bookkeeping."""
+    ave_gest, ave_gen, n_alive, births = light_stats(params, st, update_no)
+    deaths = jnp.maximum(alive_before + births - n_alive, 0)
+    dt = jnp.where(ave_gest > 0, 1.0 / jnp.maximum(ave_gest, 1e-9), 0.0)
+    return births, deaths, dt, ave_gen, n_alive
+
+
 def update_scan_impl(params, st, chunk, run_key, neighbors, u0):
     """Unjitted body of `update_scan` below -- the single spelling of the
     chunked update loop.  Exists so the multi-world batcher
@@ -457,11 +483,8 @@ def update_scan_impl(params, st, chunk, run_key, neighbors, u0):
             alive_before = pc.st.alive.sum()
             pc, executed = packed_chunk.update_step_packed(
                 params, pc, k, neighbors, u0 + i)
-            ave_gest, ave_gen, n_alive, births = light_stats(
-                params, pc.st, u0 + i)
-            deaths = jnp.maximum(alive_before + births - n_alive, 0)
-            dt = jnp.where(ave_gest > 0,
-                           1.0 / jnp.maximum(ave_gest, 1e-9), 0.0)
+            births, deaths, dt, ave_gen, n_alive = _update_stats(
+                params, pc.st, alive_before, u0 + i)
             return pc, (executed, births, deaths, dt, ave_gen, n_alive)
 
         pc, outs = jax.lax.scan(pbody, pc, jnp.arange(chunk))
@@ -471,9 +494,8 @@ def update_scan_impl(params, st, chunk, run_key, neighbors, u0):
         k = jax.random.fold_in(run_key, u0 + i)
         alive_before = st.alive.sum()
         st, executed = update_step(params, st, k, neighbors, u0 + i)
-        ave_gest, ave_gen, n_alive, births = light_stats(params, st, u0 + i)
-        deaths = jnp.maximum(alive_before + births - n_alive, 0)
-        dt = jnp.where(ave_gest > 0, 1.0 / jnp.maximum(ave_gest, 1e-9), 0.0)
+        births, deaths, dt, ave_gen, n_alive = _update_stats(
+            params, st, alive_before, u0 + i)
         return st, (executed, births, deaths, dt, ave_gen, n_alive)
     st, outs = jax.lax.scan(body, st, jnp.arange(chunk))
     return st, outs
@@ -507,6 +529,196 @@ def update_scan(params, st, chunk, run_key, neighbors, u0):
     already synchronize).  Same per-update PRNG stream, bit-exact vs the
     per-update path (tests/test_packed_chunk.py)."""
     return update_scan_impl(params, st, chunk, run_key, neighbors, u0)
+
+
+# ---- the multi-world batched update (parallel/multiworld.py) ----
+#
+# PR 10 advanced a W-world batch by jit(vmap(update_scan_impl)), which
+# was bit-exact but paid vmap's batching tax on control flow: the
+# batching rule for lax.while_loop runs every iteration until EVERY
+# world's cond is false and freezes finished worlds with a per-cycle
+# select over every carry leaf -- measured batch_efficiency 0.07-0.12
+# on CPU (BENCH_r08_local.json).  The functions below eliminate that
+# structurally: the cheap per-update phases (resources / schedule /
+# bank / birth flush / stats) stay vmapped, but the cycle loop is
+# WORLD-FOLDED -- one lax.while_loop whose carry stacks W worlds'
+# leaves on a leading axis, running to the batch-uniform trip count
+# max_w(max_k_w), with per-world execution masks doing the gating.  A
+# world past its own trip count contributes an all-false exec_mask, and
+# a fully-masked micro_step is an exact identity (the same contract the
+# solo loop relies on for budget-exhausted lanes and stalled parents),
+# so no carry leaf pays a select and every world replays its solo
+# trajectory bit-exactly.  On the Pallas paths the world axis is folded
+# INTO the kernel launch instead (one [LP, W*N] grid; see
+# pallas_cycles.run_packed_stacked and ops/packed_chunk.py).
+
+
+def _mw_pre_phase(params, st, key, update_no):
+    """One world's cheap pre-cycle phases -- exactly update_step's
+    prologue (key split, resources, schedule, perm) -- vmapped over the
+    world axis by _batched_update_step."""
+    k_budget, k_steps, k_birth = jax.random.split(key, 3)
+    st = resource_phase(params, st, key, update_no)
+    budgets, granted, max_k = schedule_phase(params, st, k_budget)
+    st = perm_phase(params, st, granted, update_no)
+    return st, (budgets, granted, max_k, k_steps, k_birth)
+
+
+def _mw_fold_cycles_xla(params, bst, k_steps, granted, max_k):
+    """The Stage-1 tentpole: ONE while_loop advances W stacked worlds'
+    lockstep cycles.  Trip count = max over worlds of the per-world
+    max_k (batch-uniform); the body vmaps micro_step over the world
+    axis with each world's own exec mask and per-cycle key
+    fold_in(k_steps_w, s).  Worlds whose max_k is below the batch max
+    run fully-masked (identity) iterations -- the only cross-world cost
+    is the shared mask test, with NO per-leaf select."""
+    step_fn = _cycle_step_fn(params)
+    bmax = jnp.max(max_k)
+
+    def cond(carry):
+        return carry[0] < bmax
+
+    def body(carry):
+        s, bst = carry
+
+        def one(st, kw, gw):
+            exec_mask = st.alive & (s < gw) & ~st.divide_pending
+            return step_fn(params, st, jax.random.fold_in(kw, s),
+                           exec_mask)
+
+        return s + 1, jax.vmap(one)(bst, k_steps, granted)
+
+    pending_before = bst.divide_pending
+    _, bst = jax.lax.while_loop(cond, body, (jnp.int32(0), bst))
+    if params.hw_type == 0:
+        bst = jax.vmap(
+            lambda st, pb: _materialize_offspring(params, st, pb)
+        )(bst, pending_before)
+    return bst
+
+
+def _mw_stack_kernel_cycles(params, bst, k_steps, granted, cap):
+    """Stage-2's per-update flavor: the Pallas path with the world axis
+    folded into the kernel -- W per-world pack_state quads stacked on
+    the lane axis and launched as ONE [LP, W*n_pad] grid
+    (pallas_cycles.run_packed_stacked), so each world's blocks exit
+    their while_loop at their own budgets instead of idling on the
+    batch-max of a vmapped launch.  Seeds mirror run_cycles draw for
+    draw (randint on each world's k_steps)."""
+    from avida_tpu.ops import packed_chunk
+    n = bst.alive.shape[1]
+    use_perm = int(getattr(params, "lane_perm_k", 0)) > 0
+    if use_perm:
+        use_perm = not packed_chunk.active(
+            params, jax.tree.map(lambda x: x[0], bst))
+
+    def pack_w(st, g):
+        return pallas_cycles.pack_state(
+            params, st, g, st.lane_perm if use_perm else None, 1)
+
+    quads = jax.vmap(pack_w)(bst, granted)         # each [W, rows, n_pad]
+    W, n_pad = quads[0].shape[0], quads[0].shape[2]
+    B = pallas_cycles._dims(params, n, params.max_memory, 1)[0]
+    seeds = pallas_cycles.world_seed_bases(k_steps)
+    flat = tuple(jnp.moveaxis(q, 0, 1).reshape(q.shape[1], W * n_pad)
+                 for q in quads)
+    out = pallas_cycles.run_packed_stacked(params, flat, seeds, cap, B)
+    out_w = tuple(o.reshape(o.shape[0], W, n_pad) for o in out)
+
+    def unpack_w(st, quad):
+        return pallas_cycles.unpack_state(
+            params, st, quad, st.lane_inv if use_perm else None)
+
+    return jax.vmap(unpack_w, in_axes=(0, 1))(bst, out_w)
+
+
+def _batched_update_step(params, bst, keys, neighbors, update_no):
+    """One update for W stacked worlds -- update_step's phase order with
+    the cycle loop world-folded.  Returns (bst, executed[W], trips[W])
+    where trips is each world's own per-update trip count max_k (what
+    its solo while_loop would run; the batch runs max over worlds), the
+    raw material of the multiworld_batch_efficiency gauge."""
+    bst, (budgets, granted, max_k, k_steps, k_birth) = jax.vmap(
+        lambda st, k: _mw_pre_phase(params, st, k, update_no))(bst, keys)
+    cap = static_cap(params)
+
+    if params.trace_cap:
+        bst, tsnap = jax.vmap(
+            lambda st, g: trace_pre_phase(params, st, g, update_no)
+        )(bst, granted)
+
+    executed0 = bst.insts_executed
+
+    if use_pallas_path(params):
+        bst = _mw_stack_kernel_cycles(params, bst, k_steps, granted, cap)
+    else:
+        bst = _mw_fold_cycles_xla(params, bst, k_steps, granted, max_k)
+
+    def post(st, b, e0, kb, ks):
+        st, executed = bank_phase(params, st, b, e0)
+        st = birth_phase(params, st, kb, ks, neighbors, update_no)
+        return st, executed
+
+    bst, executed = jax.vmap(post)(bst, budgets, executed0, k_birth,
+                                   k_steps)
+
+    if params.fault_nan:
+        from avida_tpu.utils.faultinject import nan_phase
+        bst = jax.vmap(lambda st: nan_phase(params, st, update_no))(bst)
+
+    if params.trace_cap:
+        bst = jax.vmap(
+            lambda st, sn: trace_post_phase(params, st, sn, update_no)
+        )(bst, tsnap)
+    return bst, executed, max_k
+
+
+def update_scan_batched(params, bst, chunk, run_keys, neighbors, u0):
+    """The W-world mirror of update_scan_impl (the engine behind
+    parallel/multiworld.multiworld_scan).  bst carries a leading world
+    axis on every leaf; run_keys are the stacked per-world run keys.
+    Routing mirrors the solo scan: the packed-resident chunk engine
+    when the configuration qualifies (stacked planes, pack once /
+    unpack once -- ops/packed_chunk.py), else the per-update batched
+    step above.  Returns (bst', outs) where outs adds a 7th per-update
+    vector to update_scan's six: trips[W, chunk], each world's own trip
+    count per update (the straggler/efficiency attribution input)."""
+    from avida_tpu.ops import packed_chunk
+
+    if packed_chunk.batch_active(params, bst):
+        pw = packed_chunk.pack_worlds(params, bst)
+
+        def pbody(pw, i):
+            keys = jax.vmap(
+                lambda rk: jax.random.fold_in(rk, u0 + i))(run_keys)
+            alive_before = pw.bst.alive.sum(axis=1)
+            pw, executed, trips = packed_chunk.update_step_packed_worlds(
+                params, pw, keys, neighbors, u0 + i)
+            births, deaths, dt, ave_gen, n_alive = jax.vmap(
+                lambda st, ab: _update_stats(params, st, ab, u0 + i)
+            )(pw.bst, alive_before)
+            return pw, (executed, births, deaths, dt, ave_gen, n_alive,
+                        trips)
+
+        pw, outs = jax.lax.scan(pbody, pw, jnp.arange(chunk))
+        bst = packed_chunk.unpack_worlds(params, pw)
+    else:
+        def body(bst, i):
+            keys = jax.vmap(
+                lambda rk: jax.random.fold_in(rk, u0 + i))(run_keys)
+            alive_before = bst.alive.sum(axis=1)
+            bst, executed, trips = _batched_update_step(
+                params, bst, keys, neighbors, u0 + i)
+            births, deaths, dt, ave_gen, n_alive = jax.vmap(
+                lambda st, ab: _update_stats(params, st, ab, u0 + i)
+            )(bst, alive_before)
+            return bst, (executed, births, deaths, dt, ave_gen, n_alive,
+                         trips)
+
+        bst, outs = jax.lax.scan(body, bst, jnp.arange(chunk))
+    # scan stacks per-update outputs on axis 0: put the world axis back
+    # in front ([W, chunk], the contract PR 10's vmap established)
+    return bst, jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), outs)
 
 
 @partial(jax.jit, static_argnums=0)

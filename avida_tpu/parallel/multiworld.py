@@ -8,9 +8,17 @@ This module is the missing half (ROADMAP item 2): a batched **world
 axis**.  W independent worlds with the SAME static configuration
 (identical WorldParams -- one compiled program) but distinct seeds are
 stacked on a leading axis of every PopulationState leaf and advanced by
-`jax.vmap(update_scan_impl)` chunks, so W worlds progress in one device
-program and aggregate throughput scales with W while compile cost stays
-O(1) -- the direct analogue of batch-serving in an inference stack.
+chunks of ops/update.update_scan_batched, so W worlds progress in one
+device program and aggregate throughput scales with W while compile
+cost stays O(1) -- the direct analogue of batch-serving in an inference
+stack.  The engine world-FOLDS the hot cycle loop rather than vmapping
+it (PR 11): one while_loop at the batch-uniform trip count with
+per-world exec masks on the XLA path; one stacked [LP, W*N] kernel grid
+on the Pallas / packed-resident paths, where each world's blocks run to
+their own budgets (per-block early exit + TPU_KERNEL_ROWSKIP
+load-balance the ragged budgets across tenants).  Only the cheap
+per-update phases (resources / schedule / bank / birth flush / stats)
+are vmapped.
 
 Bit-exactness contract: world w in a batch IS the solo run with seed w.
 
@@ -53,7 +61,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from avida_tpu.ops.update import update_scan_impl
+from avida_tpu.ops.update import update_scan_batched
 from avida_tpu.world import World
 
 
@@ -66,13 +74,21 @@ def multiworld_scan(params, bstate, chunk, run_keys, neighbors, u0):
     neighbor table are shared (the batch advances on one update grid
     and static-equal configs have one world geometry).  Returns the
     batched final state plus the per-update bookkeeping vectors of
-    update_scan with a leading world axis ([W, chunk]).
+    update_scan with a leading world axis ([W, chunk]), extended with a
+    seventh vector: each world's own per-update trip count (the
+    efficiency/straggler attribution input).
+
+    The engine (ops/update.update_scan_batched) world-FOLDS the cycle
+    loop instead of vmapping it: one while_loop at the batch-uniform
+    trip count with per-world exec masks on the XLA path, one stacked
+    [LP, W*N] kernel launch on the Pallas paths -- no per-cycle select
+    over carry leaves, no vmapped control flow (the PR-10 engine's
+    batching tax; BENCH_r08_local.json).  Every world remains bit-exact
+    vs its solo run.
 
     The batched state is DONATED, exactly like update_scan's."""
-    return jax.vmap(
-        lambda st, rk: update_scan_impl(params, st, chunk, rk,
-                                        neighbors, u0)
-    )(bstate, run_keys)
+    return update_scan_batched(params, bstate, chunk, run_keys,
+                               neighbors, u0)
 
 
 def _event_key(ev):
@@ -160,6 +176,17 @@ class MultiWorld:
         self._deaths_this = None
         self._prev_alive = None
         self._total_births = None
+        # batch-lifetime occupancy accumulators (f32 device values; fed
+        # by _scan, published by MultiWorldExporter): per-world trip
+        # totals, the per-update batch-max total, and the update count
+        # they cover.  batch_efficiency = sum(trips) / (W * leader);
+        # straggler lag_w = (leader - trips_w) / (leader / updates) --
+        # how many leader-updates' worth of cycles world w spent masked
+        self._trips = None
+        self._leader_trips = None
+        self._trips_updates = 0
+        self.engine = None             # "packed-stacked" | "per-update",
+        #                                set (and runlog-reported) by run()
         self._boundary_hook = None     # test seam (chaos drills): called
         #                                after every chunk boundary
         self.names = [f"w{k:03d}" for k in range(len(self.worlds))]
@@ -294,8 +321,12 @@ class MultiWorld:
     def _scan(self, k: int):
         """One batched chunk: W worlds x k updates, one device program.
         The same per-chunk accumulator updates as World._scan_updates,
-        vectorized over the world axis (same per-world float order)."""
-        self.bstate, (executed, births, deaths, dts, ave_gens, n_alive) = \
+        vectorized over the world axis (same per-world float order).
+        The extra `trips` vector feeds the batch-efficiency /
+        straggler-lag gauges: trips[w, u] is world w's OWN trip count
+        at update u, while the batch ran max over worlds."""
+        self.bstate, (executed, births, deaths, dts, ave_gens, n_alive,
+                      trips) = \
             multiworld_scan(self.params, self.bstate, k, self._run_keys,
                             self.neighbors, jnp.int32(self.update))
         self._avida_time = self._avida_time + dts.sum(axis=1)
@@ -303,6 +334,13 @@ class MultiWorld:
         self._deaths_this = deaths[:, -1]
         self._prev_alive = n_alive[:, -1]
         self._total_births = self._total_births + births.sum(axis=1)
+        # f32 accumulators: int32 trip totals wrap on long uncapped runs
+        # (~1e5-trip updates x ~1e5 updates); the gauges they feed are
+        # ratios, where f32's 2^-24 relative error is irrelevant
+        self._trips = self._trips + trips.sum(axis=1).astype(jnp.float32)
+        self._leader_trips = (self._leader_trips
+                              + trips.max(axis=0).sum().astype(jnp.float32))
+        self._trips_updates += k
         for i, w in enumerate(self.worlds):
             w._pending_exec.append(executed[i])
         self.update += k
@@ -353,6 +391,29 @@ class MultiWorld:
     # including the second-Ctrl-C escalation and the off-main-thread
     # guard) -- one spelling, so a future fix applies to both drivers
     _install_preempt_handlers = World._install_preempt_handlers
+
+    def _report_engine(self):
+        """Make the batch's chunk engine explicit and LOUD: a batch that
+        cannot take the stacked packed-resident path (ops/packed_chunk.
+        pack once -> stacked kernel scan -> unpack once) silently ran
+        the per-update engine before this PR; now the choice lands in
+        the runlog ({"record": "event"} + stderr echo) with the exact
+        ineligibility reason, so a fleet operator can see why a batch
+        is not on the fast path.  Called once per run()."""
+        from avida_tpu.observability import runlog
+        from avida_tpu.ops import packed_chunk
+        w0 = self.worlds[0]
+        # params.nb_cap is the static source of the newborn-ring gate
+        # (>0 iff TPU_SYSTEMATICS; the ring arrays are shaped from it),
+        # so the report matches what batch_active actually routes on
+        reason = packed_chunk.ineligible_reason(self.params,
+                                                self.params.nb_cap > 0)
+        self.engine = "packed-stacked" if reason is None else "per-update"
+        fields = {"engine": self.engine, "worlds": len(self.worlds)}
+        if reason is not None:
+            fields["fallback_reason"] = reason
+        runlog.emit_event(w0, "multiworld_engine", **fields)
+        return reason
 
     def save_checkpoints(self):
         """One ordinary per-world checkpoint generation each, into each
@@ -439,6 +500,10 @@ class MultiWorld:
         for w in self.worlds:
             w.preempted = False
             w._preempt = False
+        if self._trips is None:
+            self._trips = jnp.zeros((len(self.worlds),), jnp.float32)
+            self._leader_trips = jnp.float32(0)
+        self._report_engine()
         handlers = self._install_preempt_handlers() if self._ckpt_on else {}
         last_ckpt = self.update
         last_audit = self.update

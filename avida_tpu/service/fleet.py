@@ -1201,23 +1201,24 @@ def format_fleet_status(spool: str, now: float | None = None) -> str:
         if state.get(member) == "batched":
             riders.setdefault(leader, []).append(member)
 
-    def world_rows(leader: str) -> dict:
-        """{world_name: (update, organisms)} from the leader batch's
-        per-world metric rows (multiworld.prom)."""
+    def world_rows(leader: str) -> tuple:
+        """({world_name: (update, organisms, straggler_lag)}, batch
+        efficiency or None) from the leader batch's per-world metric
+        rows (multiworld.prom).  The lag/efficiency gauges come from
+        MultiWorldExporter's occupancy families (PR-11)."""
         path = os.path.join(spool, leader, "data", "multiworld.prom")
         if not os.path.exists(path):
-            return {}
+            return {}, None
+        from avida_tpu.observability.exporter import multiworld_rows
         m = read_metrics(path)
-        rows: dict = {}
-        for k, v in m.items():
-            if "{world=\"" not in k:
-                continue
-            fam, label = k.split("{world=\"", 1)
-            wname = label.rstrip("\"}")
-            rows.setdefault(wname, {})[fam] = v
-        return {n: (int(d.get("avida_update", 0)),
-                    int(d.get("avida_organisms", 0)))
-                for n, d in rows.items()}
+        rows = multiworld_rows(m)
+        eff = m.get("avida_multiworld_batch_efficiency")
+        return ({n: (int(d.get("avida_update", 0)),
+                     int(d.get("avida_organisms", 0)),
+                     float(d.get(
+                         "avida_multiworld_straggler_lag_updates", 0.0)))
+                 for n, d in rows.items()},
+                None if eff is None else float(eff))
 
     for name in sorted(state):
         st = state[name]
@@ -1251,12 +1252,15 @@ def format_fleet_status(spool: str, now: float | None = None) -> str:
         lines.append(f"  {name:<24} {st}{extra}")
         if members:
             # one batched job = one row, its worlds as sub-rows (the
-            # leader's own world first, then each rider's)
-            per = world_rows(name)
+            # leader's own world first, then each rider's), each with
+            # its straggler lag; batch efficiency on the leader row
+            per, eff = world_rows(name)
+            if eff is not None:
+                lines[-1] += f"  efficiency {eff:.2f}"
             for wname in [name] + sorted(members):
-                u, orgs = per.get(wname, (None, None))
+                u, orgs, lag = per.get(wname, (None, None, 0.0))
                 detail = ("(no per-world metrics yet)" if u is None
-                          else f"u{u} organisms {orgs}")
+                          else f"u{u} organisms {orgs} lag {lag:.1f}u")
                 role = "lead" if wname == name else "batched"
                 lines.append(f"    - {wname:<20} {role}  {detail}")
     return "\n".join(lines) if lines else f"empty spool {spool!r}"

@@ -272,10 +272,33 @@ def multiworld_fields(W, side, timed=3, chunk=25):
                                 (caching-immune: every rep advances the
                                 evolved batched state)
 
+    plus the world-axis occupancy breakdown (PR-11 satellite):
+
+      per_world_trips           each world's own summed per-update trip
+                                counts over the timed chunks
+      batch_trip_efficiency     sum(per_world_trips) / (W x batch-max):
+                                the STRUCTURAL ceiling -- what fraction
+                                of the batch-uniform trip count is any
+                                world's own work (the exporter gauge's
+                                definition)
+      multiworld_phases         fenced pre/cycles/post ms of one batched
+                                update on the world-folded XLA path +
+                                cycle_loop_share (harness.
+                                measure_multiworld_phases)
+      kernel_world_skip_pct     fraction of lockstep lane-cycles the
+                                stacked kernel's per-block early exit
+                                skips ACROSS the W tenants' stacked
+                                lanes vs one global trip count
+                                (scheduler.block_skip_fraction over the
+                                world-stacked granted vector)
+
     Seeds differ per world (the batch serves distinct tenants); timing
     fences only at segment ends, identically for both protocols."""
-    from avida_tpu.observability.harness import measure_multiworld
-    from avida_tpu.ops.update import update_scan
+    from avida_tpu.observability.harness import (measure_multiworld,
+                                                 measure_multiworld_phases)
+    from avida_tpu.ops import pallas_cycles
+    from avida_tpu.ops import scheduler as sched_ops
+    from avida_tpu.ops.update import scheduler_probe, update_scan
     from avida_tpu.parallel.multiworld import multiworld_scan
 
     u0 = 1 << 20
@@ -317,18 +340,49 @@ def multiworld_fields(W, side, timed=3, chunk=25):
                                 jnp.int32(u0))
     jax.block_until_ready(bstate)
     outs = []
+    trip_rows = []
     t0 = time.perf_counter()
     for c in range(timed):
         bstate, (ex, *_rest) = multiworld_scan(
             params, bstate, chunk, bkeys, neighbors,
             jnp.int32(u0 + (c + 1) * chunk))
         outs.append(ex)
+        trip_rows.append(_rest[-1])          # trips[W, chunk]
     jax.block_until_ready(bstate)
     bat_dt = time.perf_counter() - t0
     per_world = np.zeros(W, np.int64)
     for ex in outs:
         per_world += np.asarray(ex, np.int64).sum(axis=1)
     bat_ips = float(per_world.sum()) / bat_dt
+
+    # world-axis occupancy: per-world trip totals vs the batch-max grid
+    trips = np.concatenate([np.asarray(tr, np.int64) for tr in trip_rows],
+                           axis=1)                       # [W, timed*chunk]
+    per_world_trips = trips.sum(axis=1)
+    leader_trips = trips.max(axis=0).sum()
+    trip_eff = float(per_world_trips.sum()) / max(W * leader_trips, 1)
+
+    # the stacked two-level-scheduler attribution: what the per-block
+    # early exit skips across ALL W tenants' stacked lanes relative to
+    # one global batch-max loop (the vmapped-engine cost model)
+    B, n_pad = pallas_cycles.block_dims(params, params.num_cells)
+    gs = []
+    for i in range(W):
+        st_i = jax.tree.map(lambda x, i=i: x[i], bstate)
+        g = scheduler_probe(params, st_i)[1]
+        gs.append(jnp.pad(g, (0, n_pad - g.shape[0])))
+    g_stacked = jnp.concatenate(gs)
+    world_skip = float(sched_ops.block_skip_fraction(g_stacked, B))
+
+    from avida_tpu.ops.update import use_pallas_path
+    mw_phases = None
+    if not use_pallas_path(params):
+        # the fenced pre/cycles/post stages mirror the world-FOLDED XLA
+        # engine; on the kernel paths the cycle loop is a stacked launch
+        # and the solo `phases` row already attributes it
+        mw_phases = measure_multiworld_phases(
+            params, [fresh(seed)[1] for seed in seeds], neighbors,
+            [jax.random.key(s ^ 0xF00D) for s in seeds])
 
     mw_ms, _ = measure_multiworld(
         params, [fresh(seed)[1] for seed in seeds], neighbors,
@@ -342,6 +396,10 @@ def multiworld_fields(W, side, timed=3, chunk=25):
                                    for x in per_world],
         "batch_efficiency": round(bat_ips / (W * seq_ips), 4),
         "multiworld_ms_per_update_world": round(mw_ms, 3),
+        "per_world_trips": [int(x) for x in per_world_trips],
+        "batch_trip_efficiency": round(trip_eff, 4),
+        "multiworld_phases": mw_phases,
+        "kernel_world_skip_pct": round(world_skip * 100.0, 2),
     }
     if os.environ.get("BENCH_WORLDS_SERVE", "1") != "0":
         out.update(multiworld_serve_fields(W, side))

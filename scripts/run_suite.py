@@ -15,6 +15,14 @@ Usage:
     python scripts/run_suite.py --all-tests --out SUITE_r07.txt  # FULL suite
     python scripts/run_suite.py --files test_fleet.py test_supervisor.py
     python scripts/run_suite.py --timeout 1200             # per file
+    python scripts/run_suite.py --only multiworld --slow   # slow tier of the
+                                                           # matching files only
+    python scripts/run_suite.py --only 'test_pa*'          # fnmatch patterns ok
+
+--only PATTERN keeps test files whose name contains PATTERN (or matches
+it as an fnmatch glob); --slow selects the slow-marked tests instead of
+tier-1 -- together they are how the multi-hour slow legs are swept one
+file at a time on the 1-core host without editing this script.
 
 Exit status: 0 when every file passed, 1 otherwise.  The output file is
 written incrementally (a killed sweep keeps the files already run).
@@ -88,6 +96,7 @@ def main(argv=None) -> int:
     marker = "not slow"
     timeout = 1200.0
     files = None
+    only = None
     i = 0
     while i < len(argv):
         a = argv[i]
@@ -100,6 +109,12 @@ def main(argv=None) -> int:
         elif a == "--all-tests":
             marker = None
             i += 1
+        elif a == "--slow":
+            marker = "slow"
+            i += 1
+        elif a == "--only" and i + 1 < len(argv):
+            only = argv[i + 1]
+            i += 2
         elif a == "--timeout" and i + 1 < len(argv):
             timeout = float(argv[i + 1])
             i += 2
@@ -114,6 +129,14 @@ def main(argv=None) -> int:
     if files is None:
         files = sorted(f for f in os.listdir(os.path.join(REPO, "tests"))
                        if f.startswith("test_") and f.endswith(".py"))
+    if only:
+        import fnmatch
+        files = [f for f in files
+                 if only in f or fnmatch.fnmatch(f, only)
+                 or fnmatch.fnmatch(f, f"test_{only}.py")]
+        if not files:
+            print(f"--only {only!r} matches no test file")
+            return 2
     header = (f"# Full test-suite sweep (per-file pytest processes; "
               f"marker={marker!r}, timeout={timeout:.0f}s)\n"
               f"# Split rationale: one big pytest process intermittently "

@@ -217,6 +217,121 @@ def test_multiworld_off_zero_state_and_jaxpr_digest():
     assert ok, msg
 
 
+def _mk_scan_world(seed, **overrides):
+    """A raw-scan world (no World.run machinery) for the engine-level
+    bit-exactness legs below."""
+    from avida_tpu.world import World
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 6
+    cfg.WORLD_Y = 6
+    cfg.TPU_MAX_MEMORY = 256
+    cfg.RANDOM_SEED = seed
+    cfg.AVE_TIME_SLICE = 100
+    cfg.set("SLICING_METHOD", 2)      # deterministic merit-proportional
+    #                                   stride: merit skew => budget skew
+    for k, v in overrides.items():
+        cfg.set(k, v)
+    w = World(cfg=cfg)
+    w.events = []
+    w.inject()
+    return w
+
+
+def _skew_merit(st, factor):
+    """Heavy-tailed merit on half the alive lanes: per-world max budgets
+    (and with them per-update trip counts) diverge hard across a batch."""
+    import jax.numpy as jnp
+    n = st.merit.shape[0]
+    half = st.alive & ((jnp.arange(n) % 2) == 0)
+    return st.replace(merit=jnp.where(half, st.merit * factor, st.merit))
+
+
+WARM_RAGGED = 24
+
+
+def _warmed_ragged(seed, k, **overrides):
+    """World `seed` advanced WARM_RAGGED updates solo, then merit-skewed
+    (world index k == 1 gets the x64 heavy tail)."""
+    import jax.numpy as jnp
+
+    from avida_tpu.ops.update import update_scan
+    w = _mk_scan_world(seed, **overrides)
+    st, _ = update_scan(w.params, w.state, WARM_RAGGED, w._run_key,
+                        w.neighbors, jnp.int32(0))
+    return w, _skew_merit(st, 64.0 if k == 1 else 1.0)
+
+
+def test_ragged_budget_batch_bit_exact_xla():
+    """The tentpole's acceptance core on the XLA path: a batch whose
+    worlds want DIFFERENT trip counts (heavy-tailed merit in world 1
+    only) stays bit-exact vs solo.  This is exactly the case PR-10's
+    vmapped while_loop paid for (batch-max trips + per-cycle selects)
+    and the case the world-folded loop must get right: world 0 runs
+    fully-masked iterations past its own max_k, which must be an exact
+    identity on every state leaf."""
+    import jax
+    import jax.numpy as jnp
+
+    from avida_tpu.ops.update import update_scan
+
+    solo, keys = [], []
+    for k, s in enumerate((5, 9)):
+        w, st = _warmed_ragged(s, k)
+        keys.append(w._run_key)
+        s2, _ = update_scan(w.params, st, WARM_RAGGED, w._run_key,
+                            w.neighbors, jnp.int32(WARM_RAGGED))
+        solo.append(s2)
+
+    sts = [_warmed_ragged(s, k)[1] for k, s in enumerate((5, 9))]
+    w0 = _mk_scan_world(5)
+    bstate = jax.tree.map(lambda *xs: jnp.stack(xs), *sts)
+    bst, bouts = multiworld_scan(w0.params, bstate, WARM_RAGGED,
+                                 jnp.stack(keys), w0.neighbors,
+                                 jnp.int32(WARM_RAGGED))
+    trips = np.asarray(bouts[-1])
+    # the skew must actually make world 1 the leader: every masked
+    # iteration of world 0 below is only exercised when trips diverge
+    assert trips[1].sum() > trips[0].sum()
+    assert (trips[1] > trips[0]).any()
+    for i in range(2):
+        for name in bst.__dataclass_fields__:
+            v = getattr(bst, name)
+            if v is None:
+                continue
+            np.testing.assert_array_equal(
+                np.asarray(getattr(solo[i], name)), np.asarray(v)[i],
+                err_msg=f"world {i} field {name}")
+
+
+def test_engine_report_and_fallback_reason(tmp_path, capsys):
+    """The packed-engine eligibility satellite: a batch that cannot take
+    the stacked packed-resident path reports the exact reason in the
+    runlog (stderr echo asserted here), and the reason function is the
+    single spelling `packed_chunk.active` routes through."""
+    from avida_tpu.ops import packed_chunk
+
+    worlds = [_world(s, tmp_path / f"d{s}") for s in (1, 2)]
+    mw = MultiWorld(worlds, data_dir=str(tmp_path / "root"))
+    reason = mw._report_engine()
+    err = capsys.readouterr().err
+    assert mw.engine == "per-update"
+    assert reason is not None and "multiworld_engine" in err
+    assert "fallback_reason" in err and reason in err
+    # the reason tracks the active() predicate exactly
+    assert packed_chunk.ineligible_reason(mw.params, False) == reason
+    assert not packed_chunk.active(mw.params)
+
+    # a packed-eligible config reports the stacked engine (kernel forced
+    # into interpret mode off-TPU; systematics off empties the nb ring)
+    cfg = _cfg(1, TPU_USE_PALLAS=1, TPU_SYSTEMATICS=0, TPU_LANE_PERM=0)
+    from avida_tpu.world import World as _W
+    w = _W(cfg=cfg, data_dir=str(tmp_path / "pk"))
+    assert packed_chunk.ineligible_reason(w.params, False) is None
+    # on the same otherwise-eligible config, a systematics newborn ring
+    # is the one remaining gate -- and it names itself
+    assert "newborn ring" in packed_chunk.ineligible_reason(w.params, True)
+
+
 @pytest.mark.slow
 def test_batch_matches_solo_on_pallas_and_packed_paths():
     """The kernel interaction: the batched scan composes with the
@@ -266,3 +381,126 @@ def test_batch_matches_solo_on_pallas_and_packed_paths():
                     np.asarray(getattr(solo[i], name)),
                     np.asarray(v)[i],
                     err_msg=f"packed={packed} world={i} field {name}")
+
+
+def _transplant_last_lane(st, boost=64.0):
+    """Clone the most-copied alive organism into the LAST lane of the
+    world and boost its merit, so a divide (and a birth whose data
+    movement wraps the world edge) reliably originates from the final
+    lane of the world's block -- the stacked layout's world-boundary
+    cross-talk case."""
+    import jax.numpy as jnp
+    n = st.alive.shape[0]
+    src = jnp.argmax(jnp.where(st.alive, st.copied_size, -1))
+    upd = {}
+    for name in st.__dataclass_fields__:
+        v = getattr(st, name)
+        if v is None or not hasattr(v, "shape") or v.ndim == 0:
+            continue
+        if name in ("lane_perm", "lane_inv") or v.shape[0] != n:
+            continue                  # world-level / bijective fields
+        upd[name] = v.at[n - 1].set(v[src])
+    st = st.replace(**upd)
+    return st.replace(merit=st.merit.at[n - 1].set(st.merit[src] * boost))
+
+
+@pytest.mark.slow
+def test_ragged_stacked_packed_bit_exact_with_last_lane_birth():
+    """Stage 2 under fire: a W=2 packed-resident stacked batch with
+    heavy-tailed budgets (ragged per-block trip counts ACROSS tenants)
+    and a parent dividing FROM the last lane of world 0's block, so the
+    packed flush's rolls wrap that world's edge right at the world
+    boundary of the stacked layout.  Bit-exact per world vs solo packed
+    scans, and world 1's state is untouched by world 0's edge birth (the
+    cross-talk guard -- the bit-exact compare proves it)."""
+    import jax
+    import jax.numpy as jnp
+
+    from avida_tpu.ops import packed_chunk
+    from avida_tpu.ops.update import update_scan
+
+    K = 12     # the transplanted last-lane parent needs ~10 updates to
+    #            finish its gestation and win a placement (verified: its
+    #            first birth lands inside this window)
+    over = dict(TPU_USE_PALLAS=1, TPU_SYSTEMATICS=0, TPU_LANE_PERM=0,
+                TPU_KERNEL_SHARDS=1, TPU_PACKED_CHUNK=1)
+
+    def built(k, s):
+        w, st = _warmed_ragged(s, k, **over)
+        return w, _transplant_last_lane(st)
+
+    solo, keys = [], []
+    for k, s in enumerate((5, 9)):
+        w, st = built(k, s)
+        assert packed_chunk.active(w.params, st)
+        keys.append(w._run_key)
+        s2, _ = update_scan(w.params, st, K, w._run_key, w.neighbors,
+                            jnp.int32(WARM_RAGGED))
+        solo.append(s2)
+
+    sts = [built(k, s)[1] for k, s in enumerate((5, 9))]
+    w0 = _mk_scan_world(5, **over)
+    n = sts[0].alive.shape[0]
+    bstate = jax.tree.map(lambda *xs: jnp.stack(xs), *sts)
+    bst, bouts = multiworld_scan(w0.params, bstate, K, jnp.stack(keys),
+                                 w0.neighbors, jnp.int32(WARM_RAGGED))
+    trips = np.asarray(bouts[-1])
+    assert trips[1].sum() > trips[0].sum()        # genuinely ragged
+    for i in range(2):
+        for name in bst.__dataclass_fields__:
+            v = getattr(bst, name)
+            if v is None:
+                continue
+            np.testing.assert_array_equal(
+                np.asarray(getattr(solo[i], name)), np.asarray(v)[i],
+                err_msg=f"world {i} field {name}")
+    # the boundary case actually fired: some cell of world 0 was born
+    # from the last-lane parent during the compared window
+    pid = np.asarray(bst.parent_id)[0]
+    bu = np.asarray(bst.birth_update)[0]
+    assert ((pid == n - 1) & (bu >= WARM_RAGGED)).any(), \
+        "no birth from the last lane -- retune the transplant"
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(len(__import__("jax").devices()) < 2,
+                    reason="needs 2 devices")
+def test_stacked_kernel_sharded_bit_exact():
+    """TPU_KERNEL_SHARDS=2 with the world axis stacked: the stacked
+    launch shard_maps over the combined [LP, W*n_pad] lane axis (each
+    shard gets whole world blocks) and the per-world seed bases make
+    its streams shard-count-invariant -- so the sharded stacked batch
+    matches the UNSHARDED solo scans bit-exactly, mutations on."""
+    import jax
+    import jax.numpy as jnp
+
+    from avida_tpu.ops import packed_chunk
+    from avida_tpu.ops.update import update_scan
+
+    K = 6
+    base = dict(TPU_USE_PALLAS=1, TPU_SYSTEMATICS=0, TPU_LANE_PERM=0,
+                TPU_PACKED_CHUNK=1)
+    solo, keys = [], []
+    for s in (5, 9):
+        w = _mk_scan_world(s, TPU_KERNEL_SHARDS=1, **base)
+        keys.append(w._run_key)
+        st, _ = update_scan(w.params, w.state, K, w._run_key,
+                            w.neighbors, jnp.int32(0))
+        solo.append(st)
+
+    worlds = [_mk_scan_world(s, TPU_KERNEL_SHARDS=2, **base)
+              for s in (5, 9)]
+    assert packed_chunk.active(worlds[0].params, worlds[0].state)
+    bstate = jax.tree.map(lambda *xs: jnp.stack(xs),
+                          *[w.state for w in worlds])
+    bst, _ = multiworld_scan(worlds[0].params, bstate, K,
+                             jnp.stack(keys), worlds[0].neighbors,
+                             jnp.int32(0))
+    for i in range(2):
+        for name in bst.__dataclass_fields__:
+            v = getattr(bst, name)
+            if v is None:
+                continue
+            np.testing.assert_array_equal(
+                np.asarray(getattr(solo[i], name)), np.asarray(v)[i],
+                err_msg=f"world {i} field {name}")
