@@ -81,6 +81,17 @@ TPU-build extras (no reference equivalent):
                      on one common update.  The root DATA_DIR gets the
                      aggregate metrics.prom heartbeat plus per-world
                      rows in multiworld.prom.
+  --serve-worlds CONTROL
+                     continuous serving child (parallel/multiworld.py
+                     ServeBatch): a fixed power-of-two-width batch whose
+                     slots hold live tenant worlds or inert ghosts, with
+                     membership reconciled against the CONTROL json at
+                     every checkpoint boundary -- tenants are promoted
+                     into ghost slots (resuming from their own
+                     checkpoints) and demoted back out without a
+                     recompile on either side.  The fleet serve pool
+                     (service/serve.py, `--fleet SPOOL --dynamic`)
+                     writes the control; see README "Fleet serving".
   --supervise        run under the self-healing supervisor
                      (service/supervisor.py): the remaining arguments
                      become the child run's command line (needs -d DIR
@@ -208,6 +219,56 @@ def _worlds_main(args, overrides) -> int:
     return 0
 
 
+def _serve_main(args, overrides) -> int:
+    """--serve-worlds: the continuous-serving child
+    (parallel/multiworld.ServeBatch).  The control file names the padded
+    width and the desired membership; the fleet serve pool
+    (service/serve.py) rewrites it to promote/demote tenants at
+    checkpoint boundaries.  `--resume` is accepted and implicit:
+    admission resumes any member whose checkpoint dir holds
+    generations, so one fixed command line both starts and restarts a
+    serve child bit-exactly."""
+    import json
+
+    from avida_tpu.parallel.multiworld import ServeBatch
+    from avida_tpu.service import EXIT_AUDIT
+    from avida_tpu.utils.audit import StateInvariantError
+
+    control = args.serve_worlds
+    try:
+        with open(control) as f:
+            width = int(json.load(f).get("width", 0))
+    except (OSError, ValueError) as e:
+        print(f"[avida-tpu] --serve-worlds: unreadable control file "
+              f"{control!r} ({e})", file=sys.stderr)
+        return 2
+    if width < 1:
+        print(f"[avida-tpu] --serve-worlds: {control!r} needs a "
+              f"positive integer 'width'", file=sys.stderr)
+        return 2
+    data_dir = args.data_dir or os.path.dirname(control) or "data"
+    try:
+        sb = ServeBatch(width, control, data_dir,
+                        config_dir=args.config_dir, overrides=overrides)
+    except ValueError as e:
+        print(f"[avida-tpu] --serve-worlds refused: {e}", file=sys.stderr)
+        return 2
+    t0 = time.time()
+    try:
+        sb.serve()
+    except StateInvariantError as e:
+        print(f"[avida-tpu] {e}", file=sys.stderr)
+        return EXIT_AUDIT
+    if sb.preempted:
+        print(f"[avida-tpu] preempted; {sb.num_live} live tenant "
+              f"checkpoints saved", file=sys.stderr)
+        return 0
+    if args.verbose:
+        print(f"served {sb.admissions} tenants over {sb.boundaries} "
+              f"boundaries, {time.time() - t0:.1f}s", file=sys.stderr)
+    return 0
+
+
 def main(argv=None):
     argv = list(sys.argv[1:]) if argv is None else list(argv)
     if "--supervise" in argv:
@@ -237,6 +298,7 @@ def main(argv=None):
                    metavar="DIR")
     p.add_argument("--trace", action="store_true")
     p.add_argument("--worlds", default=None, metavar="SEEDS|MANIFEST")
+    p.add_argument("--serve-worlds", default=None, metavar="CONTROL")
     p.add_argument("--status", default=None, metavar="DIR")
     p.add_argument("--max-age", type=float, default=None, metavar="SEC")
     args = p.parse_args(argv)
@@ -283,6 +345,9 @@ def main(argv=None):
         return analyze_ckpt(args.analyze, config_dir=args.config_dir,
                             overrides=overrides, data_dir=args.data_dir,
                             verbose=args.verbose)
+
+    if args.serve_worlds is not None:
+        return _serve_main(args, overrides)
 
     if args.worlds is not None:
         return _worlds_main(args, overrides)

@@ -1407,12 +1407,17 @@ def flush_births_packed_worlds(params, bst, keys, planes, update_no):
     world's own [LP, N] block, so a birth landing on the last lane of a
     world can never read or write the next world's first lane
     (tests/test_multiworld.py's boundary cross-talk guard), and each
-    world consumes its own flush key exactly as its solo run does."""
+    world consumes its own flush key exactly as its solo run does.
+    `update_no` is scalar or [W] (per-world counters, the dynamic
+    serving batch): newborns are stamped with their OWN world's update
+    number either way."""
+    update_no = jnp.broadcast_to(jnp.asarray(update_no, jnp.int32),
+                                 (bst.alive.shape[0],))
     return jax.vmap(
-        lambda st, key, pl5: flush_births_packed(params, st, key, pl5,
-                                                 update_no),
-        in_axes=(0, 0, 1), out_axes=(1, 0),
-    )(bst, keys, planes)
+        lambda st, key, pl5, un: flush_births_packed(params, st, key,
+                                                     pl5, un),
+        in_axes=(0, 0, 1, 0), out_axes=(1, 0),
+    )(bst, keys, planes, update_no)
 
 
 def flush_injections(params, st, key, neighbors):

@@ -311,25 +311,30 @@ def update_step_packed_worlds(params, pw: PackedWorlds, keys, neighbors,
     run as ONE stacked launch.  Consumes each world's solo PRNG splits
     exactly (split per world, randint seed per world, flush key per
     world), so each world is bit-exact vs its solo packed scan.
-    Returns (pw', executed[W], trips[W])."""
+    `update_no` is scalar (aligned batch) or [W] (each world its own
+    counter -- the dynamic serving batch); either way every phase sees
+    its own world's update number.  Returns
+    (pw', executed[W], trips[W])."""
     from avida_tpu.ops import update as upd
     IV_GRANTED = pallas_cycles.IV_GRANTED
     IV_INSTS = pallas_cycles.IV_INSTS_EXEC
 
     ks = jax.vmap(lambda k: jax.random.split(k, 3))(keys)
     k_budget, k_steps, k_birth = ks[:, 0], ks[:, 1], ks[:, 2]
+    update_no = jnp.broadcast_to(jnp.asarray(update_no, jnp.int32),
+                                 (pw.bst.alive.shape[0],))
 
     st = jax.vmap(
-        lambda s, k: upd.resource_phase(params, s, k, update_no)
-    )(pw.bst, keys)
+        lambda s, k, un: upd.resource_phase(params, s, k, un)
+    )(pw.bst, keys, update_no)
     budgets, granted, max_k = jax.vmap(
         lambda s, k: upd.schedule_phase(params, s, k))(st, k_budget)
     ivec = pw.ivec.at[IV_GRANTED].set(granted)
 
     if params.trace_cap:
         st, tsnap = jax.vmap(
-            lambda s, g: upd.trace_pre_phase(params, s, g, update_no)
-        )(st, granted)
+            lambda s, g, un: upd.trace_pre_phase(params, s, g, un)
+        )(st, granted, update_no)
 
     executed0 = ivec[IV_INSTS]
     seeds = pallas_cycles.world_seed_bases(k_steps)
@@ -346,8 +351,8 @@ def update_step_packed_worlds(params, pw: PackedWorlds, keys, neighbors,
 
     if params.trace_cap:
         st = jax.vmap(
-            lambda s, sn: upd.trace_post_phase(params, s, sn, update_no)
-        )(st, tsnap)
+            lambda s, sn, un: upd.trace_post_phase(params, s, sn, un)
+        )(st, tsnap, update_no)
 
     tape_t, off_t, gen_t, ivec, fvec = planes
     return pw.replace(bst=st, tape_t=tape_t, off_t=off_t, gen_t=gen_t,

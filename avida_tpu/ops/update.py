@@ -634,18 +634,23 @@ def _mw_stack_kernel_cycles(params, bst, k_steps, granted, cap):
 
 def _batched_update_step(params, bst, keys, neighbors, update_no):
     """One update for W stacked worlds -- update_step's phase order with
-    the cycle loop world-folded.  Returns (bst, executed[W], trips[W])
+    the cycle loop world-folded.  `update_no` is the [W] vector of each
+    world's OWN update counter (a dynamic-membership serving batch
+    carries worlds at different points of their runs; an aligned batch
+    passes W copies of the shared counter, which computes bit-identically
+    to the scalar it replaced).  Returns (bst, executed[W], trips[W])
     where trips is each world's own per-update trip count max_k (what
     its solo while_loop would run; the batch runs max over worlds), the
     raw material of the multiworld_batch_efficiency gauge."""
     bst, (budgets, granted, max_k, k_steps, k_birth) = jax.vmap(
-        lambda st, k: _mw_pre_phase(params, st, k, update_no))(bst, keys)
+        lambda st, k, un: _mw_pre_phase(params, st, k, un)
+    )(bst, keys, update_no)
     cap = static_cap(params)
 
     if params.trace_cap:
         bst, tsnap = jax.vmap(
-            lambda st, g: trace_pre_phase(params, st, g, update_no)
-        )(bst, granted)
+            lambda st, g, un: trace_pre_phase(params, st, g, un)
+        )(bst, granted, update_no)
 
     executed0 = bst.insts_executed
 
@@ -654,22 +659,23 @@ def _batched_update_step(params, bst, keys, neighbors, update_no):
     else:
         bst = _mw_fold_cycles_xla(params, bst, k_steps, granted, max_k)
 
-    def post(st, b, e0, kb, ks):
+    def post(st, b, e0, kb, ks, un):
         st, executed = bank_phase(params, st, b, e0)
-        st = birth_phase(params, st, kb, ks, neighbors, update_no)
+        st = birth_phase(params, st, kb, ks, neighbors, un)
         return st, executed
 
     bst, executed = jax.vmap(post)(bst, budgets, executed0, k_birth,
-                                   k_steps)
+                                   k_steps, update_no)
 
     if params.fault_nan:
         from avida_tpu.utils.faultinject import nan_phase
-        bst = jax.vmap(lambda st: nan_phase(params, st, update_no))(bst)
+        bst = jax.vmap(
+            lambda st, un: nan_phase(params, st, un))(bst, update_no)
 
     if params.trace_cap:
         bst = jax.vmap(
-            lambda st, sn: trace_post_phase(params, st, sn, update_no)
-        )(bst, tsnap)
+            lambda st, sn, un: trace_post_phase(params, st, sn, un)
+        )(bst, tsnap, update_no)
     return bst, executed, max_k
 
 
@@ -677,26 +683,36 @@ def update_scan_batched(params, bst, chunk, run_keys, neighbors, u0):
     """The W-world mirror of update_scan_impl (the engine behind
     parallel/multiworld.multiworld_scan).  bst carries a leading world
     axis on every leaf; run_keys are the stacked per-world run keys.
-    Routing mirrors the solo scan: the packed-resident chunk engine
-    when the configuration qualifies (stacked planes, pack once /
-    unpack once -- ops/packed_chunk.py), else the per-update batched
-    step above.  Returns (bst', outs) where outs adds a 7th per-update
-    vector to update_scan's six: trips[W, chunk], each world's own trip
-    count per update (the straggler/efficiency attribution input)."""
+    u0 is a scalar (every world at the same update -- the aligned
+    MultiWorld batch) or a [W] vector of PER-WORLD update counters (the
+    dynamic-membership serving batch, where a rider admitted mid-run
+    advances from its own update while its peers continue from theirs);
+    a scalar broadcasts to the vector form, and an all-equal vector
+    computes bit-identically to the scalar it replaced (each world's
+    PRNG stream stays fold_in(run_key_w, own_update)).  Routing mirrors
+    the solo scan: the packed-resident chunk engine when the
+    configuration qualifies (stacked planes, pack once / unpack once --
+    ops/packed_chunk.py), else the per-update batched step above.
+    Returns (bst', outs) where outs adds a 7th per-update vector to
+    update_scan's six: trips[W, chunk], each world's own trip count per
+    update (the straggler/efficiency attribution input)."""
     from avida_tpu.ops import packed_chunk
+
+    u0 = jnp.broadcast_to(jnp.asarray(u0, jnp.int32),
+                          (bst.alive.shape[0],))
 
     if packed_chunk.batch_active(params, bst):
         pw = packed_chunk.pack_worlds(params, bst)
 
         def pbody(pw, i):
-            keys = jax.vmap(
-                lambda rk: jax.random.fold_in(rk, u0 + i))(run_keys)
+            un = u0 + i
+            keys = jax.vmap(jax.random.fold_in)(run_keys, un)
             alive_before = pw.bst.alive.sum(axis=1)
             pw, executed, trips = packed_chunk.update_step_packed_worlds(
-                params, pw, keys, neighbors, u0 + i)
+                params, pw, keys, neighbors, un)
             births, deaths, dt, ave_gen, n_alive = jax.vmap(
-                lambda st, ab: _update_stats(params, st, ab, u0 + i)
-            )(pw.bst, alive_before)
+                lambda st, ab, u: _update_stats(params, st, ab, u)
+            )(pw.bst, alive_before, un)
             return pw, (executed, births, deaths, dt, ave_gen, n_alive,
                         trips)
 
@@ -704,14 +720,14 @@ def update_scan_batched(params, bst, chunk, run_keys, neighbors, u0):
         bst = packed_chunk.unpack_worlds(params, pw)
     else:
         def body(bst, i):
-            keys = jax.vmap(
-                lambda rk: jax.random.fold_in(rk, u0 + i))(run_keys)
+            un = u0 + i
+            keys = jax.vmap(jax.random.fold_in)(run_keys, un)
             alive_before = bst.alive.sum(axis=1)
             bst, executed, trips = _batched_update_step(
-                params, bst, keys, neighbors, u0 + i)
+                params, bst, keys, neighbors, un)
             births, deaths, dt, ave_gen, n_alive = jax.vmap(
-                lambda st, ab: _update_stats(params, st, ab, u0 + i)
-            )(bst, alive_before)
+                lambda st, ab, u: _update_stats(params, st, ab, u)
+            )(bst, alive_before, un)
             return bst, (executed, births, deaths, dt, ave_gen, n_alive,
                          trips)
 

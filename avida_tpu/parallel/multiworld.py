@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from functools import partial
 
 import jax
@@ -63,6 +64,19 @@ import numpy as np
 
 from avida_tpu.ops.update import update_scan_batched
 from avida_tpu.world import World
+
+
+# trace-time probe (the testcpu.gestation_trace_count pattern): the
+# Python increment runs only when jit TRACES a new (params, chunk,
+# shapes) variant, so the counter counts compiled program variants --
+# the serving layer's cache-warmth evidence (a rider admitted into a
+# ghost slot of a warm batch must NOT bump it; tests/test_serve_batch)
+_SCAN_TRACES = 0
+
+
+def scan_trace_count() -> int:
+    """How many multiworld_scan program variants this process traced."""
+    return _SCAN_TRACES
 
 
 @partial(jax.jit, static_argnums=(0, 2), donate_argnums=(1,))
@@ -86,7 +100,14 @@ def multiworld_scan(params, bstate, chunk, run_keys, neighbors, u0):
     batching tax; BENCH_r08_local.json).  Every world remains bit-exact
     vs its solo run.
 
+    u0 is a shared scalar (the aligned MultiWorld batch) or a [W]
+    vector of per-world update counters (the ServeBatch dynamic
+    membership path -- each world advances from its OWN update, so its
+    PRNG stream and event grid stay exactly its solo run's).
+
     The batched state is DONATED, exactly like update_scan's."""
+    global _SCAN_TRACES
+    _SCAN_TRACES += 1
     return update_scan_batched(params, bstate, chunk, run_keys,
                                neighbors, u0)
 
@@ -602,3 +623,614 @@ class MultiWorld:
     @property
     def num_worlds(self) -> int:
         return len(self.worlds)
+
+
+# ---------------------------------------------------------------------------
+# ServeBatch: ghost-padded dynamic membership (the streaming serve layer)
+# ---------------------------------------------------------------------------
+
+def pow2_floor(n: int) -> int:
+    """Largest power of two <= max(n, 1) -- the serve loop's stretch
+    quantizer.  Chunk length is a STATIC jit argument, so an arbitrary
+    gap-to-next-boundary would compile one scan program per distinct
+    gap; quantizing to powers of two bounds the compiled set to
+    log2(cap) variants, all warm after the first few boundaries."""
+    return 1 << (max(int(n), 1).bit_length() - 1)
+
+
+class ServeBatch:
+    """A fixed-width, dynamic-membership serving batch (ROADMAP item 2:
+    "from spool to service").
+
+    Where MultiWorld freezes membership at construction and requires
+    every member at the same update, ServeBatch is built at a fixed
+    padded width W (a power-of-two batchability class, the way
+    analyze/testcpu.py bucket-pads Test-CPU batches) and serves a
+    CHURNING population of tenants: slots hold either a live tenant
+    World or an inert GHOST -- an all-dead copy of the template state.
+    A fully-masked world is an exact identity (the PR-11 world-fold
+    contract for budget-exhausted lanes, proven by the ragged-budget
+    tests), so a ghost contributes zero trips, zero device work beyond
+    the shared launch, and -- because every engine phase is world-local
+    (vmapped or world-blocked) -- cannot perturb any live world by a
+    single bit.
+
+    Because the compiled scan's shapes are pinned by W (not by the live
+    member count), membership churn never changes the program: a rider
+    promoted into a ghost slot at a checkpoint boundary reaches its
+    first executed update on the ALREADY-COMPILED program
+    (scan_trace_count() is the in-tree probe), and a demoted member
+    frees its slot back to ghost without a recompile on either side.
+
+    Per-world update counters (the u0 vector of update_scan_batched)
+    let tenants ride at different points of their runs: each world's
+    PRNG stream stays fold_in(run_key_w, own_update) and its event grid
+    stays its solo grid, so every tenant's trajectory is bit-exact vs
+    its uninterrupted solo run.  (Host-side f32 `_avida_time` can
+    differ in last bits from a solo run when the chunk split differs --
+    the long-standing cross-chunking caveat; all device state, PRNG
+    streams, .dat-visible values and integer accumulators are exact.)
+
+    Membership protocol (the fleet serve pool drives this; a human can
+    too): `control_path` is an atomically-rewritten JSON document
+
+        {"width": W, "shutdown": false,
+         "members": [{"name", "seed", "data_dir", "ckpt_dir",
+                      "max_updates"}, ...]}
+
+    reconciled at every checkpoint boundary: members present in the
+    control and not in a slot are ADMITTED (resumed from their own
+    ckpt_dir when generations exist -- the solo<->batch free-transition
+    contract -- else injected fresh); live slots absent from the
+    control are RETIRED (final checkpoint, .dat files closed, slot
+    back to ghost).  A member reaching its max_updates (or an Exit
+    event) retires as "done".  The batch reports back through
+    DATA_DIR/serve.json (atomic) plus the metrics.prom heartbeat and
+    multiworld.prom per-world rows (exporter.ServeExporter), and
+    keeps serving -- idle with zero tenants it sleeps host-side,
+    holding every compiled program warm, until TPU_SERVE_IDLE_SEC
+    expires or the control sets "shutdown": true.
+
+    SIGTERM preempts exactly like a solo run: every live tenant saves
+    a final checkpoint and the process exits cleanly for the
+    supervisor to relaunch with --resume."""
+
+    def __init__(self, width: int, control_path: str, data_dir: str,
+                 config_dir: str | None = None, overrides=None,
+                 world_factory=None, clock=time.time, sleep=time.sleep):
+        if width < 1:
+            raise ValueError("ServeBatch width must be >= 1")
+        self.width = int(width)
+        self.control_path = control_path
+        self.data_dir = data_dir
+        self._config_dir = config_dir
+        self._overrides = list(overrides or [])
+        self._factory = world_factory or self._config_factory
+        self._clock = clock
+        self._sleep = sleep
+
+        # the template/ghost world: same static config as every member
+        # (seed irrelevant -- a ghost never executes), its state turned
+        # all-dead.  Dead lanes get zero grants (the audited scheduler
+        # invariant), so a ghost's trip count is 0 every update.
+        gw = self._factory({"name": "__ghost__", "seed": 0,
+                            "data_dir": os.path.join(data_dir, ".ghost"),
+                            "ckpt_dir": None})
+        if gw.tracer is not None or gw.analytics is not None \
+                or gw.faults is not None or not gw._chunkable():
+            raise ValueError(
+                "serve batches need chunkable configs with no flight "
+                "recorder, live analytics or fault injection (the same "
+                "rules as --worlds; run those workloads solo)")
+        gw.process_events()
+        if gw.state is None:
+            gw.inject()
+        self.params = gw.params
+        self.neighbors = gw.neighbors
+        self.cfg = gw.cfg
+        self._ghost_state = gw.state.replace(
+            alive=jnp.zeros_like(gw.state.alive))
+        self._ghost_key = gw._run_key
+        gw.state = None
+        for f in gw._files.values():
+            try:
+                f.close()
+            except Exception:
+                pass
+        gw._files = {}
+        self._ghost_events = [_event_key(e) for e in gw.events]
+
+        self.slots: list = [None] * self.width
+        self.names: list = [None] * self.width
+        self.max_updates: list = [None] * self.width
+        self.finished: dict = {}        # name -> {"state", "update", ...}
+        self.bstate = None
+        self._run_keys = None
+        self._avida_time = None
+        self._last_ave_gen = None
+        self._deaths_this = None
+        self._prev_alive = None
+        self._total_births = None
+        self._trips = jnp.zeros((self.width,), jnp.float32)
+        self._leader_trips = jnp.float32(0)
+        self._trips_updates = 0
+        self.admissions = 0
+        self.retirements = 0
+        self.boundaries = 0
+        self._exit = False
+        self._preempt = False
+        self.preempted = False
+        self._shutdown = False
+        self._boundary_hook = None      # test seam: after each
+        #                                 checkpoint-boundary reconcile
+        self._sysm_on = bool(int(self.cfg.get("TPU_SYSTEMATICS", 1)))
+        self.exporter = None
+        if int(self.cfg.get("TPU_METRICS", 0)):
+            from avida_tpu.observability.exporter import ServeExporter
+            self.exporter = ServeExporter(self)
+
+    # the solo preemption contract verbatim (shared spelling)
+    _install_preempt_handlers = World._install_preempt_handlers
+
+    def request_stop(self):
+        self._exit = True
+
+    # ---- membership ----
+
+    def _config_factory(self, entry):
+        ov = [(n, v) for n, v in self._overrides
+              if n not in ("RANDOM_SEED", "TPU_CKPT_DIR")]
+        ov.append(("RANDOM_SEED", int(entry["seed"])))
+        if entry.get("ckpt_dir"):
+            ov.append(("TPU_CKPT_DIR", entry["ckpt_dir"]))
+        return World(config_dir=self._config_dir, overrides=ov,
+                     data_dir=entry["data_dir"])
+
+    def _live(self) -> list:
+        return [(i, w) for i, w in enumerate(self.slots) if w is not None]
+
+    def _member_exports(self, w) -> bool:
+        """A member writes its own metrics.prom unless its data dir IS
+        the batch root's (the MultiWorld._world_exports rule)."""
+        return (w.exporter is not None
+                and os.path.abspath(w.data_dir)
+                != os.path.abspath(self.data_dir))
+
+    @property
+    def num_live(self) -> int:
+        return sum(1 for w in self.slots if w is not None)
+
+    @property
+    def num_ghosts(self) -> int:
+        return self.width - self.num_live
+
+    def _log(self, msg: str):
+        import sys
+        print(f"[serve] {msg}", file=sys.stderr)
+
+    def _read_control(self):
+        try:
+            with open(self.control_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None                 # absent/torn: keep serving as-is
+
+    def admit(self, entry) -> bool:
+        """Place one tenant into a free ghost slot (requires a synced
+        batch).  Resumes from the entry's own checkpoint dir when
+        generations exist, else starts fresh.  Returns True when the
+        tenant occupies a slot (False: rejected or already finished,
+        recorded in `finished` for the status file)."""
+        from avida_tpu.utils.checkpoint import (CheckpointError,
+                                                restore_candidates)
+        name = str(entry["name"])
+        free = [i for i, w in enumerate(self.slots) if w is None]
+        if not free:
+            self.finished[name] = {"state": "rejected",
+                                   "reason": "no free slot"}
+            return False
+        try:
+            w = self._factory(entry)
+        except (ValueError, OSError) as e:
+            self.finished[name] = {"state": "rejected", "reason": str(e)}
+            return False
+        reason = self._ineligible(w)
+        if reason is not None:
+            self.finished[name] = {"state": "rejected", "reason": reason}
+            for f in w._files.values():
+                try:
+                    f.close()
+                except Exception:
+                    pass
+            return False
+        if w._ckpt_base() and restore_candidates(w._ckpt_base()):
+            try:
+                w.resume()
+            except CheckpointError as e:
+                self.finished[name] = {"state": "rejected",
+                                       "reason": f"resume failed: {e}"}
+                return False
+        else:
+            w.process_events()
+            if w.state is None:
+                w.inject()
+        cap = entry.get("max_updates")
+        cap = None if cap is None else int(cap)
+        if cap is not None and w.update >= cap:
+            # already complete (e.g. readmitted after a crash that
+            # outran the done ack): report done without a slot
+            self.finished[name] = {"state": "done", "update": w.update,
+                                   "insts": w._cum_insts}
+            return False
+        i = free[0]
+        self.slots[i] = w
+        self.names[i] = name
+        self.max_updates[i] = cap
+        self.finished.pop(name, None)
+        self.admissions += 1
+        self._log(f"admit {name} -> slot {i} at update {w.update}"
+                  + (f" (budget {cap})" if cap is not None else ""))
+        return True
+
+    def _ineligible(self, w) -> str | None:
+        """Why a candidate World cannot join this batch (None = it
+        can).  The MultiWorld static-equality rules, per slot."""
+        if w.params != self.params:
+            return ("static config differs from the batch class "
+                    "(WorldParams mismatch)")
+        if not np.array_equal(np.asarray(w.neighbors),
+                              np.asarray(self.neighbors)):
+            return "world topology differs from the batch class"
+        if [_event_key(e) for e in w.events] != self._ghost_events:
+            return "event schedule differs from the batch class"
+        if not w._chunkable() or w.tracer is not None \
+                or w.analytics is not None or w.faults is not None:
+            return ("unchunkable config (telemetry/reversion/"
+                    "generation triggers) or per-run host pipeline "
+                    "(trace/analytics/faults)")
+        taken_d = {os.path.abspath(x.data_dir) for _, x in self._live()}
+        if os.path.abspath(w.data_dir) in taken_d:
+            return "data_dir already served by another slot"
+        if w._ckpt_base():
+            taken_c = {os.path.abspath(x._ckpt_base())
+                       for _, x in self._live() if x._ckpt_base()}
+            if os.path.abspath(w._ckpt_base()) in taken_c:
+                return "ckpt_dir already served by another slot"
+        return None
+
+    def _retire(self, i: int, state: str, save: bool = True):
+        """Free slot i back to ghost (requires a synced batch): final
+        checkpoint (the demotion/completion handoff artifact -- a
+        demoted tenant resumes solo or in another batch from it,
+        bit-exactly), .dat files closed, outcome recorded for the
+        status file."""
+        w = self.slots[i]
+        name = self.names[i]
+        if save and w._ckpt_base() and w.state is not None:
+            from avida_tpu.utils.checkpoint import (generation_update,
+                                                    list_generations)
+            gens = list_generations(w._ckpt_base())
+            if not gens or generation_update(gens[-1]) != w.update:
+                # skip the re-save when the boundary autosave just
+                # published this very update (retirement at a
+                # checkpoint boundary -- the common case)
+                w.save_checkpoint()
+        if self._member_exports(w) and w.state is not None:
+            w.exporter.export(w)        # final per-tenant heartbeat
+        insts = w._flush_exec()
+        for f in w._files.values():
+            try:
+                f.close()
+            except Exception:
+                pass
+        w._files = {}
+        w._dat_append = True
+        self.finished[name] = {"state": state, "update": w.update,
+                               "insts": insts}
+        if len(self.finished) > 4096:
+            self.finished.pop(next(iter(self.finished)))
+        self.slots[i] = None
+        self.names[i] = None
+        self.max_updates[i] = None
+        self.retirements += 1
+        self._log(f"retire {name} ({state}) at update {w.update}")
+
+    def _reconcile(self) -> bool:
+        """Converge membership to the control file (requires a synced
+        batch).  Returns True when membership changed."""
+        ctl = self._read_control()
+        if ctl is None:
+            return False
+        self._shutdown = bool(ctl.get("shutdown"))
+        want = {}
+        for e in ctl.get("members") or []:
+            if isinstance(e, dict) and e.get("name") is not None:
+                want[str(e["name"])] = e
+        changed = False
+        for i, w in self._live():
+            if self.names[i] not in want:
+                self._retire(i, "retired")      # demotion (cancel)
+                changed = True
+        current = {self.names[i] for i, _ in self._live()}
+        for name, e in want.items():
+            if name in current or name in self.finished:
+                continue                # finished waits for the ack
+            changed |= self.admit(e)
+        for name in list(self.finished):
+            if name not in want:
+                # ack: the pool saw the outcome and dropped the member
+                # from the control (or demoted it) -- forget it so a
+                # future resubmission under the same name readmits
+                del self.finished[name]
+        return changed
+
+    # ---- batched <-> per-world state movement (ghost-aware) ----
+
+    def _stack(self):
+        if self.bstate is not None:
+            return
+        sts, keys, avt, gen, dth, pal, tb = [], [], [], [], [], [], []
+        for w in self.slots:
+            if w is None:
+                sts.append(self._ghost_state)
+                keys.append(self._ghost_key)
+                avt.append(jnp.float32(0))
+                gen.append(jnp.float32(0))
+                dth.append(jnp.int32(0))
+                pal.append(jnp.int32(0))
+                tb.append(jnp.int32(0))
+            else:
+                sts.append(w.state)
+                keys.append(w._run_key)
+                avt.append(jnp.asarray(w._avida_time, jnp.float32))
+                gen.append(jnp.asarray(w._last_ave_gen, jnp.float32))
+                dth.append(jnp.asarray(w._deaths_this, jnp.int32))
+                pal.append(jnp.int32(0) if w._prev_alive is None
+                           else jnp.asarray(w._prev_alive, jnp.int32))
+                tb.append(jnp.asarray(w._total_births, jnp.int32))
+                w.state = None
+        self.bstate = jax.tree.map(lambda *xs: jnp.stack(xs), *sts)
+        self._run_keys = jnp.stack(keys)
+        self._avida_time = jnp.stack(avt)
+        self._last_ave_gen = jnp.stack(gen)
+        self._deaths_this = jnp.stack(dth)
+        self._prev_alive = jnp.stack(pal)
+        self._total_births = jnp.stack(tb)
+
+    def _sync_worlds(self):
+        if self.bstate is None:
+            return
+        for i, w in self._live():
+            w.state = jax.tree.map(lambda x, i=i: x[i], self.bstate)
+            w._avida_time = self._avida_time[i]
+            w._last_ave_gen = self._last_ave_gen[i]
+            w._deaths_this = self._deaths_this[i]
+            w._prev_alive = self._prev_alive[i]
+            w._total_births = self._total_births[i]
+            w._summary_cache_update = None
+        self.bstate = None
+
+    def _scan(self, k: int):
+        """One serving chunk: all live worlds advance k updates from
+        their OWN update counters (the u0 vector), ghosts run zero-trip
+        identities in their slots."""
+        u0 = jnp.asarray([0 if w is None else w.update
+                          for w in self.slots], jnp.int32)
+        self.bstate, (executed, births, deaths, dts, ave_gens, n_alive,
+                      trips) = \
+            multiworld_scan(self.params, self.bstate, k, self._run_keys,
+                            self.neighbors, u0)
+        self._avida_time = self._avida_time + dts.sum(axis=1)
+        self._last_ave_gen = ave_gens[:, -1]
+        self._deaths_this = deaths[:, -1]
+        self._prev_alive = n_alive[:, -1]
+        self._total_births = self._total_births + births.sum(axis=1)
+        tl = trips.astype(jnp.float32)
+        self._trips = self._trips + tl.sum(axis=1)
+        self._leader_trips = self._leader_trips + tl.max(axis=0).sum()
+        self._trips_updates += k
+        for i, w in self._live():
+            w._pending_exec.append(executed[i])
+            w.update += k
+        if self._sysm_on:
+            self._drain_newborns(k)
+
+    def _drain_newborns(self, k: int):
+        """Per-world systematics drain with per-world stamps: each
+        world's window is stamped with ITS update (post-chunk for k>1,
+        the solo run_update pre-advance convention for k=1), so each
+        member's phylogeny matches its solo run exactly."""
+        if not self._sysm_on:
+            return
+        for i, w in self._live():
+            if w.systematics is None:
+                continue
+            snap = {name: getattr(self.bstate, name)[i]
+                    for name in World._NB_SNAP_FIELDS}
+            at = w.update if k > 1 else w.update - 1
+            snap["update_at"] = at
+            snap["win_start"] = w._last_drain_update
+            w._last_drain_update = at
+            w._feed_systematics(snap)
+        self.bstate = self.bstate.replace(
+            nb_count=jnp.zeros((self.width,), jnp.int32))
+
+    # ---- status + metrics ----
+
+    def _write_status(self, idle: bool = False):
+        members = {}
+        for i, w in self._live():
+            members[self.names[i]] = {
+                "state": "live", "update": int(w.update),
+                "max_updates": self.max_updates[i],
+                "organisms": (int(np.asarray(w.state.alive).sum())
+                              if w.state is not None else None)}
+        status = {
+            "record": "serve", "time": self._clock(),
+            "width": self.width, "live": self.num_live,
+            "ghosts": self.num_ghosts, "idle": bool(idle),
+            "boundaries": self.boundaries,
+            "admissions": self.admissions,
+            "retirements": self.retirements,
+            "compiles": scan_trace_count(),
+            "preempted": bool(self.preempted or self._preempt),
+            "shutdown": self._shutdown,
+            "members": members,
+            "finished": dict(self.finished),
+        }
+        path = os.path.join(self.data_dir, "serve.json")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(self.data_dir, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(status, f, indent=1)
+                f.write("\n")
+            os.replace(tmp, path)
+        except OSError:
+            pass                        # status must not kill serving
+
+    def _publish(self, idle: bool = False, final: bool = False):
+        self._write_status(idle=idle)
+        if self.exporter is not None:
+            self.exporter.export(self, durable=final)
+
+    # ---- the serve loop ----
+
+    def serve(self) -> int:
+        """Serve until shutdown / idle timeout / preemption.  Returns
+        the number of checkpoint boundaries crossed."""
+        boundary_every = int(self.cfg.get("TPU_CKPT_EVERY", 0)) or 32
+        max_stretch = int(self.cfg.get("TPU_MAX_STRETCH", 0))
+        idle_sec = float(self.cfg.get("TPU_SERVE_IDLE_SEC", 600))
+        poll_sec = float(self.cfg.get("TPU_SERVE_POLL_SEC", 1.0))
+        cap = 8 if self._sysm_on else 128
+        if max_stretch > 0:
+            cap = min(cap, max_stretch)
+        cap = pow2_floor(cap)
+        self._preempt = False
+        self.preempted = False
+        handlers = self._install_preempt_handlers()
+        since_boundary = 0
+        idle_since = None
+        try:
+            if int(self.cfg.get("TPU_SERVE_WARM", 1)):
+                # compile-cache warmup: scan every power-of-two chunk
+                # length on the ALL-GHOST batch (zero trips -- the
+                # masked identity makes each warm scan almost free at
+                # run time) BEFORE any tenant arrives, so no admission
+                # ever waits on a compile: a rider promoted later hits
+                # only already-traced programs (scan_trace_count is
+                # flat across churn; tests/test_serve_batch.py)
+                sizes, k = [], 1
+                while k <= min(cap, boundary_every):
+                    sizes.append(k)
+                    k <<= 1
+                self._log(f"warming scan programs: chunk sizes {sizes}")
+                self._stack()
+                for k in sizes:
+                    self._scan(k)
+                self._sync_worlds()
+            self._reconcile()
+            self._publish(idle=not self._live())
+            while not self._exit and not self._preempt:
+                # retire members that hit their budget (or an Exit
+                # event) FIRST, before any event processing -- the solo
+                # loop breaks at its max_updates check before touching
+                # events, and mirroring that ordering keeps the final
+                # retirement checkpoint byte-identical to the solo
+                # TPU_CKPT_FINAL generation (same events_done_for
+                # cursor) whenever the chunk grids coincide
+                for i, w in self._live():
+                    if w._exit or (self.max_updates[i] is not None
+                                   and w.update >= self.max_updates[i]):
+                        self._sync_worlds()
+                        self._retire(i, "done")
+                live = self._live()
+                if not live:
+                    now = self._clock()
+                    if idle_since is None:
+                        idle_since = now
+                    if self._shutdown:
+                        self._log("shutdown requested; exiting")
+                        break
+                    if idle_sec > 0 and now - idle_since > idle_sec:
+                        self._log(f"idle past {idle_sec:.0f}s; exiting")
+                        break
+                    self._sleep(poll_sec)
+                    if self._reconcile():
+                        idle_since = None
+                    self._publish(idle=not self._live())
+                    continue
+                idle_since = None
+                # per-world event boundary work at the PRE-chunk
+                # updates (solo process_events ordering, including the
+                # events_done_for cursor each world's checkpoint
+                # serializes)
+                if any(w._events_fire_now() for _, w in live):
+                    self._sync_worlds()
+                    for _, w in live:
+                        w.process_events()
+                else:
+                    for _, w in live:
+                        w._events_done_for = w.update
+                if any(w._exit for _, w in live):
+                    continue            # Exit events retire at the top
+                gap = min(
+                    (min(w._next_event_due(),
+                         float("inf") if self.max_updates[i] is None
+                         else self.max_updates[i]) - w.update)
+                    for i, w in live)
+                k = pow2_floor(int(min(float(gap),
+                                       float(boundary_every
+                                             - since_boundary),
+                                       float(cap))))
+                self._stack()
+                self._scan(k)
+                since_boundary += k
+                if since_boundary >= boundary_every:
+                    # THE checkpoint boundary, in the same iteration as
+                    # the chunk that reached it (solo run-loop shape):
+                    # saves, then the membership reconcile --
+                    # promotions and demotions land here
+                    self._sync_worlds()
+                    for i, w in self._live():
+                        if w._ckpt_base():
+                            w.save_checkpoint()
+                        if self._member_exports(w):
+                            # per-tenant heartbeat refresh (the state
+                            # just synced, so the readback is free):
+                            # fleet --status member sub-rows and the
+                            # serve bench's per-tenant instruction
+                            # totals read these files
+                            w.exporter.export(w)
+                    since_boundary = 0
+                    self.boundaries += 1
+                    self._reconcile()
+                    self._publish()
+                    if self._boundary_hook is not None:
+                        self._boundary_hook(self)
+            self._sync_worlds()
+            self.preempted = self._preempt
+            if self._preempt:
+                for i, w in self._live():
+                    w._preempt = True
+                    w.preempted = True
+                    if w._ckpt_base():
+                        w.save_checkpoint()
+            self._publish(final=True)
+        finally:
+            import signal as _signal
+            for s, h in handlers.items():
+                try:
+                    _signal.signal(s, h)
+                except (ValueError, OSError):
+                    pass
+            for _, w in self._live():
+                for f in w._files.values():
+                    try:
+                        f.close()
+                    except Exception:
+                        pass
+                w._files = {}
+                w._dat_append = True
+        return self.boundaries

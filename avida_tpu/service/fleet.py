@@ -135,46 +135,28 @@ def validate_spec(spec) -> None:
     if not isinstance(spec.get("batch", False), bool):
         raise ValueError("'batch' must be a boolean (device-lane "
                          "packing opt-in)")
+    if not isinstance(spec.get("tenant", ""), str):
+        raise ValueError("'tenant' must be a string (per-tenant "
+                         "admission quota label)")
 
 
 def spec_seed_and_batch_key(spec) -> tuple:
     """(seed, static-key) for device-lane packing: the seed is lifted
-    out of the spec argv (`-s`/`--seed`/`-set RANDOM_SEED`), and the
-    key -- the seed-stripped argv plus the env -- is the host-only
-    proxy for "identical static config": two specs with equal keys
-    trace the identical update program and may share one compiled
-    batch.  seed is None when the spec never names one explicitly
-    (unbatchable: the worlds manifest needs a concrete per-world
-    seed)."""
-    argv = list(spec.get("argv") or ())
-    # precedence mirrors the solo CLI: __main__ appends the -s seed
-    # AFTER every -set override (last override wins in the config), so
-    # -s beats -set RANDOM_SEED regardless of argv position
-    s_seed = None
-    set_seed = None
-    stripped = []
-    i = 0
-    while i < len(argv):
-        a = argv[i]
-        if a in ("-s", "--seed") and i + 1 < len(argv):
-            s_seed = argv[i + 1]
-            i += 2
-            continue
-        if a == "-set" and i + 2 < len(argv) \
-                and argv[i + 1] == "RANDOM_SEED":
-            set_seed = argv[i + 2]
-            i += 3
-            continue
-        stripped.append(a)
-        i += 1
-    seed = s_seed if s_seed is not None else set_seed
-    try:
-        seed = int(seed) if seed is not None else None
-    except ValueError:
-        seed = None
-    key = (tuple(stripped),
-           tuple(sorted((spec.get("env") or {}).items())))
-    return seed, key
+    out of the spec argv (`-s`/`--seed`/`-set RANDOM_SEED`, with the
+    solo CLI's precedence), and the key is the CANONICAL static-config
+    signature (service/serve.static_signature): the spec's argv is
+    resolved the way the child CLI would resolve it -- config files
+    loaded, overrides applied -- and hashed with seeds and output/
+    checkpoint dirs stripped.  Two specs that differ only in spelling
+    (output dirs, `-s` position vs `-set RANDOM_SEED`, override order)
+    therefore share one batchability class and one compiled program;
+    before PR 12 the key was byte-equal seed-stripped argv, which split
+    classes on every cosmetic difference.  seed is None when the spec
+    never names one explicitly (unbatchable: the worlds manifest needs
+    a concrete per-world seed)."""
+    from avida_tpu.service.serve import SpecArgv, static_signature
+    seed = SpecArgv(spec.get("argv")).effective_seed
+    return seed, static_signature(spec, with_updates=True)
 
 
 class FleetConfig:
@@ -185,7 +167,9 @@ class FleetConfig:
                  breaker_k: int = 3, breaker_sec: float = 300.0,
                  drain_sec: float = 600.0, serve: bool = False,
                  journal_max_bytes: int = 64 << 20,
-                 max_batch: int = 16):
+                 max_batch: int = 16, dynamic: bool = False,
+                 tenant_max: int = 0, queue_max: int = 0,
+                 serve_min_width: int = 2):
         self.max_jobs = max(int(max_jobs), 1)
         self.poll_sec = float(poll_sec)
         self.breaker_k = int(breaker_k)
@@ -199,6 +183,20 @@ class FleetConfig:
         # resource bounding max_jobs exists for -- wider groups split
         # into multiple batches
         self.max_batch = max(int(max_batch), 2)
+        # the streaming serve layer (service/serve.py): batchable specs
+        # route into warm ghost-padded --serve-worlds children instead
+        # of the static coalescer
+        self.dynamic = bool(dynamic)
+        # per-tenant admission quota (0 = unlimited): max concurrent
+        # running/batched jobs per spec "tenant" label
+        self.tenant_max = max(int(tenant_max), 0)
+        # queue-depth backpressure (0 = unlimited): once this many jobs
+        # sit queued, the spool scanner stops ingesting new specs --
+        # they wait on disk, unscanned, until the queue drains
+        self.queue_max = max(int(queue_max), 0)
+        # smallest serve-class width: even a lone arrival gets one
+        # ghost slot of instant-admission capacity
+        self.serve_min_width = max(int(serve_min_width), 1)
 
     @classmethod
     def from_env(cls, env) -> "FleetConfig":
@@ -212,6 +210,10 @@ class FleetConfig:
             drain_sec=f("TPU_FLEET_DRAIN_SEC", 600.0),
             journal_max_bytes=int(f("TPU_RUNLOG_MAX_BYTES", 64 << 20)),
             max_batch=int(f("TPU_FLEET_MAX_BATCH", 16)),
+            dynamic=bool(int(f("TPU_FLEET_DYNAMIC", 0))),
+            tenant_max=int(f("TPU_FLEET_TENANT_MAX", 0)),
+            queue_max=int(f("TPU_FLEET_QUEUE_MAX", 0)),
+            serve_min_width=int(f("TPU_SERVE_MIN_WIDTH", 2)),
         )
 
 
@@ -286,6 +288,11 @@ class Job:
         self._batch_progress = None     # cached resume-progress key
         #                                 (None = rescan; reset whenever
         #                                 the job re-enters the queue)
+        self._serve_sig = None          # cached serve-class signature
+        self._batch_key = None          # cached (seed, static key)
+        self.spool_src = None           # where the queued spec file
+        #                                 lives (spool root, or a
+        #                                 shard-* subdir)
 
     @property
     def data_dir(self):
@@ -415,7 +422,14 @@ class FleetOrchestrator:
         os.makedirs(self.spool, exist_ok=True)
         self._pending_recovery: dict = {}
         self._recovered = False
+        self._shard_cursor = 0
         self._replay()
+        # the streaming serve layer (--dynamic / TPU_FLEET_DYNAMIC):
+        # batchable specs route into warm ghost-padded serve children
+        self.serve_pool = None
+        if self.cfg.dynamic:
+            from avida_tpu.service.serve import ServePool
+            self.serve_pool = ServePool(self)
 
     # ---- journal ----
 
@@ -488,10 +502,10 @@ class FleetOrchestrator:
             if st == "cancelling":
                 self.journal("cancelled", job=name, reason="replayed")
                 continue
-            if not os.path.exists(job.spec_path) \
-                    and os.path.exists(job.spool_spec_path):
+            src = self._find_spool_spec(name)
+            if not os.path.exists(job.spec_path) and src:
                 os.makedirs(job.dir, exist_ok=True)
-                os.replace(job.spool_spec_path, job.spec_path)
+                os.replace(src, job.spec_path)
             if st == "running":
                 self.journal("replay_resume", job=name)
         self._pending_recovery = {}
@@ -527,33 +541,79 @@ class FleetOrchestrator:
 
     # ---- admission ----
 
+    def _shard_dirs(self) -> list:
+        """Spool shards (`shard-*` subdirs, fleet_tool submit --shard):
+        a thousands-deep queue splits across shards so one poll tick
+        never stats the whole backlog."""
+        try:
+            return sorted(
+                d for d in os.listdir(self.spool)
+                if d.startswith("shard-")
+                and os.path.isdir(os.path.join(self.spool, d)))
+        except OSError:
+            return []
+
+    def _find_spool_spec(self, name: str) -> str | None:
+        """Where a queued spec file for `name` lives right now: the
+        spool root, or one of the shard subdirs."""
+        p = os.path.join(self.spool, name + ".json")
+        if os.path.exists(p):
+            return p
+        for d in self._shard_dirs():
+            p = os.path.join(self.spool, d, name + ".json")
+            if os.path.exists(p):
+                return p
+        return None
+
     def _scan_spool(self):
         """Pick up newly submitted specs; quarantine malformed ones NOW
         (a spec that cannot run must not be retried forever, and must
-        not wait for an admission slot to be found out)."""
-        for fn in sorted(os.listdir(self.spool)):
-            if not fn.endswith(".json") or fn.startswith(".") \
-                    or fn.endswith(".cancelled.json"):
+        not wait for an admission slot to be found out).  Scales to
+        thousands of queued specs two ways: shard subdirs are visited
+        round-robin (one per tick, plus the root), and with
+        TPU_FLEET_QUEUE_MAX set the scan stops ingesting once that many
+        jobs sit queued -- later specs wait ON DISK, unscanned (the
+        backpressure surface), until the queue drains."""
+        dirs = [self.spool]
+        shards = self._shard_dirs()
+        if shards:
+            dirs.append(os.path.join(
+                self.spool, shards[self._shard_cursor % len(shards)]))
+            self._shard_cursor += 1
+        queued = sum(1 for j in self.jobs.values()
+                     if j.state == "queued")
+        for d in dirs:
+            try:
+                entries = sorted(os.listdir(d))
+            except OSError:
                 continue
-            name = fn[:-len(".json")]
-            if name in self.jobs:
-                continue                # known: admitted jobs moved
+            for fn in entries:
+                if not fn.endswith(".json") or fn.startswith(".") \
+                        or fn.endswith(".cancelled.json"):
+                    continue
+                name = fn[:-len(".json")]
+                if name in self.jobs:
+                    continue            # known: admitted jobs moved
                                         # their spec, so this is a
                                         # resubmit race -- never a
                                         # double spawn
-            path = os.path.join(self.spool, fn)
-            job = Job(name, self.spool)
-            try:
-                if not legal_name(name):
-                    raise ValueError(f"illegal job name {name!r}")
-                with open(path) as f:
-                    spec = json.load(f)
-                validate_spec(spec)
-            except (ValueError, OSError) as e:
-                self._quarantine_spec(job, path, str(e))
-                continue
-            job.spec = spec
-            self.jobs[name] = job
+                if self.cfg.queue_max and queued >= self.cfg.queue_max:
+                    return              # backpressure: stop ingesting
+                path = os.path.join(d, fn)
+                job = Job(name, self.spool)
+                job.spool_src = path
+                try:
+                    if not legal_name(name):
+                        raise ValueError(f"illegal job name {name!r}")
+                    with open(path) as f:
+                        spec = json.load(f)
+                    validate_spec(spec)
+                except (ValueError, OSError) as e:
+                    self._quarantine_spec(job, path, str(e))
+                    continue
+                job.spec = spec
+                self.jobs[name] = job
+                queued += 1
 
     def _quarantine_spec(self, job: Job, path: str, error: str):
         dst = os.path.join(
@@ -569,27 +629,130 @@ class FleetOrchestrator:
                      moved_to=os.path.basename(dst))
 
     def _admit(self, now: float):
-        """Admission control: device-lane packing first (a batch serves
-        W tenants on one slot), then fill the remaining slots from the
-        queue, unless the circuit breaker holds admissions."""
+        """Admission control: batch placement first (serve-pool routing
+        under --dynamic, else the static coalescer -- either way a
+        batch serves W tenants on one slot), then fill the remaining
+        slots from the queue, unless the circuit breaker holds
+        admissions.  Per-tenant quotas (TPU_FLEET_TENANT_MAX) hold a
+        tenant's overflow in the queue without blocking others."""
         self.admissions_paused = self.breaker.is_open(now)
         if self.admissions_paused:
             return
         running = sum(1 for j in self.jobs.values()
                       if j.state == "running")
-        for members in self._form_batches():
-            if running >= self.cfg.max_jobs:
-                break
-            if self._start_batch(members):
-                running += 1
+        tenants = self._tenant_load() if self.cfg.tenant_max else None
+        if self.serve_pool is not None:
+            running = self._admit_serve(running, tenants)
+        else:
+            for members in self._form_batches():
+                if running >= self.cfg.max_jobs:
+                    break
+                if tenants is not None:
+                    # the quota covers batched riders too: over-quota
+                    # members stay queued; a batch needs >= 2 in-quota
+                    # members to still be a batch this tick
+                    members = [(j, s) for j, s in members
+                               if not self._over_quota(j, tenants)]
+                    if len(members) < 2:
+                        continue
+                if self._start_batch(members):
+                    running += 1
+                    for j, _ in members:
+                        if j.state in ("running", "batched"):
+                            self._tenant_note(tenants, j)
         for name in sorted(self.jobs):
             if running >= self.cfg.max_jobs:
                 break
             job = self.jobs[name]
             if job.state != "queued":
                 continue
+            if self.serve_pool is not None and job._serve_sig is not None:
+                # a serve-eligible spec the pool could not place THIS
+                # tick (class full / no free slot): it waits for a
+                # ghost slot or the next class spawn -- starting it
+                # solo here would pay the launch+compile the serve
+                # layer exists to remove
+                continue
+            if self._over_quota(job, tenants):
+                continue
             if self._start(job):
                 running += 1
+                self._tenant_note(tenants, job)
+
+    # ---- per-tenant quotas ----
+
+    def _spec_tenant(self, job: Job) -> str:
+        spec = self._load_spec(job)
+        return str((spec or {}).get("tenant") or "")
+
+    def _tenant_load(self) -> dict:
+        load: dict = {}
+        for j in self.jobs.values():
+            if j.state in ("running", "batched"):
+                t = self._spec_tenant(j)
+                if t:
+                    load[t] = load.get(t, 0) + 1
+        return load
+
+    def _over_quota(self, job: Job, tenants) -> bool:
+        if tenants is None:
+            return False
+        t = self._spec_tenant(job)
+        return bool(t) and tenants.get(t, 0) >= self.cfg.tenant_max
+
+    def _tenant_note(self, tenants, job: Job):
+        if tenants is None:
+            return
+        t = self._spec_tenant(job)
+        if t:
+            tenants[t] = tenants.get(t, 0) + 1
+
+    # ---- the streaming serve layer (service/serve.py) ----
+
+    def _admit_serve(self, running: int, tenants) -> int:
+        """Serve-pool admission: warm-class placements (cache hits)
+        cost NO admission slot -- the class child is already running --
+        while each cold class spawn costs one.  Ineligible batch specs
+        fall back to the ordinary solo queue with the reason
+        journaled."""
+        from avida_tpu.service.serve import (SpecArgv,
+                                             batch_ineligible_reason)
+        pool = self.serve_pool
+        groups: dict = {}
+        for name in sorted(self.jobs):
+            job = self.jobs[name]
+            if job.state != "queued":
+                continue
+            spec = self._load_spec(job)
+            if spec is None or not spec.get("batch"):
+                continue
+            if spec.get("fault_plan"):
+                self._batch_fallback(job, "fault_plan is per-process")
+                continue
+            reason = batch_ineligible_reason(spec)
+            if reason is not None:
+                self._batch_fallback(job, reason)
+                continue
+            if SpecArgv(spec.get("argv")).effective_seed is None:
+                self._batch_fallback(job, "no explicit seed in argv")
+                continue
+            if self._over_quota(job, tenants):
+                continue
+            if pool.offer(job, spec):
+                self._tenant_note(tenants, job)
+                continue
+            if job.state != "queued":
+                continue                # quarantined by a failed place
+            groups.setdefault(job._serve_sig, []).append((job, spec))
+        for sig in sorted(groups):
+            if running >= self.cfg.max_jobs:
+                break
+            if pool.spawn_class(groups[sig]):
+                running += 1
+                for job, _ in groups[sig]:
+                    if job.state == "batched":
+                        self._tenant_note(tenants, job)
+        return running
 
     # ---- device-lane packing (spec "batch": true) ----
 
@@ -599,7 +762,10 @@ class FleetOrchestrator:
         admission path surfaces the error."""
         if job.spec is not None:
             return job.spec
-        for path in (job.spec_path, job.spool_spec_path):
+        src = (job.spool_src if job.spool_src
+               and os.path.exists(job.spool_src)
+               else self._find_spool_spec(job.name))
+        for path in filter(None, (job.spec_path, src)):
             try:
                 with open(path) as f:
                     spec = json.load(f)
@@ -639,7 +805,14 @@ class FleetOrchestrator:
             if spec.get("fault_plan"):
                 self._batch_fallback(job, "fault_plan is per-process")
                 continue
-            seed, key = spec_seed_and_batch_key(spec)
+            # the signature now resolves config files and hashes the
+            # config dir's contents -- cache it per job like
+            # _batch_progress below (a queued spec cannot change, and
+            # re-hashing thousands of parked specs' config dirs every
+            # poll tick would hammer the disk)
+            if job._batch_key is None:
+                job._batch_key = spec_seed_and_batch_key(spec)
+            seed, key = job._batch_key
             if seed is None:
                 self._batch_fallback(job, "no explicit seed in argv")
                 continue
@@ -690,7 +863,6 @@ class FleetOrchestrator:
         if len(admitted) == 1:
             return self._start(admitted[0][0])
         leader, _ = admitted[0]
-        _, key = spec_seed_and_batch_key(leader.spec)
         manifest = [{"name": j.name, "seed": s,
                      "data_dir": j.data_dir, "ckpt_dir": j.ckpt_dir}
                     for j, s in admitted]
@@ -705,7 +877,12 @@ class FleetOrchestrator:
             self.journal("batch_fallback", job=leader.name,
                          reason=f"manifest write failed: {e}")
             return self._start(leader)
-        argv = list(key[0]) + [
+        # the child argv template: the leader's argv with per-member
+        # routing stripped (the worlds manifest carries seeds + dirs);
+        # static-equal peers may SPELL their configs differently, but
+        # they resolve identically -- that is what the signature proved
+        from avida_tpu.service.serve import member_argv
+        argv = member_argv(leader.spec) + [
             "--worlds", mpath,
             "-d", leader.data_dir, "-set", "TPU_CKPT_DIR",
             leader.ckpt_dir]
@@ -759,6 +936,7 @@ class FleetOrchestrator:
                 m.state = "queued"
                 m.sup = None
                 m._batch_progress = None   # checkpoints advanced
+                m._batch_key = None
                 self.journal("requeued", job=m.name,
                              reason="batch_"
                                     + ("cancelled"
@@ -773,15 +951,18 @@ class FleetOrchestrator:
         move before respawning.  False = quarantined (path blocked)."""
         if os.path.exists(job.spec_path):
             return True
+        src = (job.spool_src if job.spool_src
+               and os.path.exists(job.spool_src)
+               else self._find_spool_spec(job.name)) \
+            or job.spool_spec_path
         self.journal("admit", job=job.name)
         try:
             os.makedirs(job.dir, exist_ok=True)
-            os.replace(job.spool_spec_path, job.spec_path)
+            os.replace(src, job.spec_path)
         except OSError as e:
             # e.g. the job-dir path is blocked by a file: quarantine
             # rather than crash-loop the whole orchestrator
-            self._quarantine_spec(job, job.spool_spec_path,
-                                  f"spec move failed: {e}")
+            self._quarantine_spec(job, src, f"spec move failed: {e}")
             return False
         return True
 
@@ -851,22 +1032,45 @@ class FleetOrchestrator:
 
     def _cancel(self, name: str):
         job = self.jobs.get(name)
-        if job is None or job.state in ("done", "failed", "cancelled",
-                                        "quarantined"):
+        if job is None:
+            # not ingested yet -- the spec can sit on disk unscanned
+            # behind TPU_FLEET_QUEUE_MAX backpressure or a later shard's
+            # round-robin turn; park it NOW so a future scan cannot
+            # admit a job the operator already cancelled
+            src = self._find_spool_spec(name)
+            if src:
+                try:
+                    os.replace(src, os.path.join(
+                        self.spool, name + ".cancelled.json"))
+                except OSError:
+                    return
+                self.journal("cancelled", job=name,
+                             reason="cancelled before ingestion")
+            return
+        if job.state in ("done", "failed", "cancelled", "quarantined"):
             return
         if job.state == "queued":
             # park an unadmitted spec so a rescan cannot resurrect it
-            if os.path.exists(job.spool_spec_path):
-                os.replace(job.spool_spec_path,
-                           os.path.join(self.spool,
-                                        name + ".cancelled.json"))
+            src = (job.spool_src if job.spool_src
+                   and os.path.exists(job.spool_src)
+                   else self._find_spool_spec(name))
+            if src:
+                os.replace(src, os.path.join(self.spool,
+                                             name + ".cancelled.json"))
             job.state = "cancelled"
             self.journal("cancelled", job=name)
             return
         if job.state == "batched":
-            # a rider has no child of its own: preempt the whole batch
-            # gracefully -- this member lands `cancelled`, its peers
-            # requeue from their per-world checkpoints (_finish_batch)
+            if self.serve_pool is not None \
+                    and self.serve_pool.cancel(job):
+                # serve member: demoted alone -- the class child
+                # retires it with a final checkpoint at the next
+                # boundary while its classmates keep running
+                return
+            # a static-batch rider has no child of its own: preempt the
+            # whole batch gracefully -- this member lands `cancelled`,
+            # its peers requeue from their per-world checkpoints
+            # (_finish_batch)
             job.cancel_requested = True
             leader = self.jobs.get(job.batch_leader or "")
             if leader is not None and leader.sup is not None:
@@ -892,6 +1096,7 @@ class FleetOrchestrator:
         job.cancel_requested = False
         job.state = "queued"
         job._batch_progress = None
+        job._batch_key = None
         self.journal("requeued", job=name, reason=reason)
 
     # ---- the poll loop ----
@@ -926,6 +1131,7 @@ class FleetOrchestrator:
             job.state = "queued"
             job.sup = None
             job._batch_progress = None   # checkpoints advanced
+            job._batch_key = None
             self.journal("requeued", job=job.name, reason="drain")
         if job.batch_members:
             self._finish_batch(job)
@@ -972,6 +1178,11 @@ class FleetOrchestrator:
         closed = self.breaker.maybe_close(now)
         if closed is not None:
             self.journal("breaker_close", failure_class=closed)
+        if self.serve_pool is not None:
+            # settle member outcomes BEFORE admission: a member the
+            # child finished must journal `done` before the admit pass
+            # could mistake its freed slot for capacity twice
+            self.serve_pool.poll()
         self._admit(now)
         for job in [j for j in self.jobs.values()
                     if j.state == "running" and j.sup is not None]:
@@ -1005,10 +1216,16 @@ class FleetOrchestrator:
              int(self.xla_fallback)),
             ("avida_fleet_max_jobs", "gauge",
              "admission-control concurrency budget", self.cfg.max_jobs),
+            ("avida_fleet_queue_depth", "gauge",
+             "jobs ingested and waiting for admission (backpressure "
+             "holds later specs on disk past TPU_FLEET_QUEUE_MAX)",
+             counts.get("queued", 0)),
             ("avida_fleet_heartbeat_timestamp_seconds", "gauge",
              "unix time of the last orchestrator export",
              round(time.time(), 3)),
         ]
+        if self.serve_pool is not None:
+            fams += self.serve_pool.gauges()
         try:
             write_metrics(self.metrics_path, render_families(fams),
                           durable=False)
@@ -1246,6 +1463,19 @@ def format_fleet_status(spool: str, now: float | None = None) -> str:
             age = "?" if d["age"] is None else str(d["age"])
             extra += (f"  census u{d['update']} age {age}u "
                       f"depth {d['depth']} tasks {d['tasks_held']}")
+        serve_json = os.path.join(spool, name, "data", "serve.json")
+        if os.path.exists(serve_json):
+            # a serve-class child: width/ghost occupancy + compile
+            # count from its status file (parallel/multiworld.ServeBatch)
+            try:
+                with open(serve_json) as f:
+                    sj = json.load(f)
+                extra += (f"  serve w{sj.get('width')} "
+                          f"live {sj.get('live')} "
+                          f"ghosts {sj.get('ghosts')} "
+                          f"compiles {sj.get('compiles')}")
+            except (OSError, ValueError):
+                pass
         members = riders.get(name, ())
         if members:
             extra = f"  (batch x{1 + len(members)}){extra}"
@@ -1314,6 +1544,9 @@ def fleet_main(argv: list) -> int:
     if "--serve" in argv:
         cfg.serve = True
         argv.remove("--serve")
+    if "--dynamic" in argv:
+        cfg.dynamic = True
+        argv.remove("--dynamic")
     if argv:
         print(f"unrecognized --fleet arguments: {argv}", file=sys.stderr)
         return 2

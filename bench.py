@@ -237,6 +237,8 @@ def main():
                                   "120" if on_tpu else "20"))
         line.update(multiworld_fields(int(os.environ["BENCH_WORLDS"]),
                                       side, timed=4 if on_tpu else 3))
+    if os.environ.get("BENCH_SERVE", "0") == "1":
+        line.update(serve_churn_fields())
     if os.environ.get("BENCH_PHASES", "1") != "0":
         phases = phase_breakdown(world)
         line["phases"] = phases
@@ -469,6 +471,166 @@ def multiworld_serve_fields(W, side, updates=40):
         "multiworld_serve_inst_per_sec": round(mw_insts / mw_sec, 1),
         "serve_speedup_x": round((mw_insts / mw_sec)
                                  / max(seq_insts / seq_sec, 1e-9), 2),
+    }
+
+
+def serve_churn_fields(trace_path=None):
+    """BENCH_SERVE=1: the streaming serve layer under CHURN -- replay
+    the committed churn trace (CHURN_r10.trace, utils/churntrace.py
+    grammar; BENCH_SERVE_TRACE overrides) through a REAL fleet
+    orchestrator three ways:
+
+      ppj       process-per-job (no batching): every tenant pays its
+                own python + jax launch and its own compile
+      static    PR-10 static coalescing (--batch, dynamic off): queued
+                static-equal specs coalesce into --worlds children at
+                admission time; late arrivals that miss the coalesce
+                window spawn their own children
+      dynamic   the serve layer (--batch + --dynamic): arrivals route
+                into ONE warm ghost-padded --serve-worlds child; late
+                arrivals are compile-cache hits promoted at checkpoint
+                boundaries
+
+    Per mode: wall seconds from first submission until every tenant is
+    terminal, aggregate org-inst/s (sum of the tenants' final
+    metrics.prom instruction counters / wall -- trajectories are
+    bit-identical across modes, so the aggregate is pure wall time),
+    p50/p95 queue wait (submission -> journal admit record), and for
+    the dynamic mode the compile-cache hit rate from fleet.prom.  The
+    orchestrator runs in-process on a background thread (host-only
+    logic); every child is a real subprocess."""
+    import shutil
+    import statistics
+    import tempfile
+    import threading
+
+    from avida_tpu.observability.exporter import read_metrics
+    from avida_tpu.observability.runlog import read_records
+    from avida_tpu.service.fleet import FleetConfig, FleetOrchestrator
+    from avida_tpu.utils import churntrace
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    trace_path = trace_path or os.environ.get(
+        "BENCH_SERVE_TRACE", os.path.join(repo, "CHURN_r10.trace"))
+    events = churntrace.parse_trace(trace_path)
+    tenants = sorted({e.job for e in events if e.kind == "submit"})
+    terminal = ("done", "failed", "cancelled", "quarantined")
+    mut = ["0.0075", "0.0085", "0.0095", "0.0065"]  # class=K variants
+
+    def argv_for(ev):
+        args = ["-u", ev.args["u"],
+                "-set", "WORLD_X", "8", "-set", "WORLD_Y", "8",
+                "-set", "TPU_MAX_MEMORY", "256",
+                "-set", "AVE_TIME_SLICE", "100",
+                "-set", "TPU_MAX_STEPS_PER_UPDATE",
+                os.environ.get("BENCH_CAP", "0"),
+                "-set", "TPU_CKPT_EVERY", "8",
+                "-set", "TPU_CKPT_AUDIT", "0",
+                "-set", "TPU_SERVE_POLL_SEC", "0.3",
+                "-set", "TPU_METRICS", "1"]
+        k = int(ev.args.get("class", 0))
+        if k:
+            args += ["-set", "COPY_MUT_PROB", mut[k % len(mut)]]
+        return args + ["-s", ev.args["seed"]]
+
+    def leg(mode, deadline_sec=1200.0):
+        from avida_tpu.service.fleet import (JOURNAL_FILE,
+                                             journal_states)
+        td = tempfile.mkdtemp(prefix=f"bench-serve-{mode}-")
+        spool = os.path.join(td, "spool")
+        env = dict(os.environ)
+        env.pop("BENCH_SERVE", None)
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)   # PR-6 landmine
+        cfg = FleetConfig(max_jobs=2, poll_sec=0.3, serve=True,
+                          dynamic=(mode == "dynamic"),
+                          serve_min_width=8)
+        fleet = FleetOrchestrator(spool, cfg=cfg, env=env)
+        th = threading.Thread(target=fleet.run, daemon=True)
+        t0 = time.perf_counter()
+        th.start()
+        submits = churntrace.replay(
+            spool, events, argv_for, batch=(mode != "ppj"),
+            clock=time.time, sleep=time.sleep)
+        deadline = time.time() + deadline_sec
+        while time.time() < deadline:
+            st, _, _ = journal_states(os.path.join(spool,
+                                                   JOURNAL_FILE))
+            if all(st.get(t) in terminal for t in tenants):
+                break
+            time.sleep(1.0)
+        wall = time.perf_counter() - t0
+        st, _, _ = journal_states(os.path.join(spool, JOURNAL_FILE))
+        fleet.request_stop()
+        th.join(120)
+        insts = 0
+        for t in tenants:
+            mp = os.path.join(spool, t, "data", "metrics.prom")
+            try:
+                insts += int(read_metrics(mp).get(
+                    "avida_insts_total", 0))
+            except OSError:
+                pass
+        waits = []
+        admits = {}
+        for rec in read_records(os.path.join(spool, JOURNAL_FILE)):
+            if rec.get("record") == "fleet" \
+                    and rec.get("event") == "admit" \
+                    and rec.get("job") in submits \
+                    and rec["job"] not in admits:
+                admits[rec["job"]] = rec["time"]
+        for t, ts in submits.items():
+            if t in admits:
+                waits.append(max(admits[t] - ts, 0.0))
+        out = {
+            "wall_sec": round(wall, 1),
+            "insts": insts,
+            "agg_inst_per_sec": round(insts / wall, 1),
+            "completed": sum(1 for t in tenants
+                             if st.get(t) == "done"),
+            "cancelled": sum(1 for t in tenants
+                             if st.get(t) == "cancelled"),
+            "queue_wait_p50_s": round(statistics.median(waits), 2)
+            if waits else None,
+            "queue_wait_p95_s": round(
+                sorted(waits)[max(int(len(waits) * 0.95) - 1, 0)], 2)
+            if waits else None,
+        }
+        if mode == "dynamic":
+            try:
+                m = read_metrics(os.path.join(spool, "fleet.prom"))
+                hits = m.get("avida_fleet_serve_cache_hits_total", 0)
+                miss = m.get("avida_fleet_serve_cache_misses_total", 0)
+                out["cache_hit_rate"] = round(
+                    hits / max(hits + miss, 1), 3)
+                out["cache_hits"] = int(hits)
+                out["cache_misses"] = int(miss)
+            except OSError:
+                pass
+            for n in sorted(os.listdir(spool)):
+                sj = os.path.join(spool, n, "data", "serve.json")
+                if os.path.exists(sj):
+                    try:
+                        with open(sj) as f:
+                            out["serve_compiles"] = json.load(
+                                f).get("compiles")
+                    except (OSError, ValueError):
+                        pass
+                    break
+        shutil.rmtree(td, ignore_errors=True)
+        return out
+
+    legs = {m: leg(m) for m in ("ppj", "static", "dynamic")}
+    dyn, ppj = legs["dynamic"], legs["ppj"]
+    return {
+        "serve_churn_trace": os.path.basename(trace_path),
+        "serve_churn_tenants": len(tenants),
+        "serve_churn": legs,
+        "serve_churn_speedup_dynamic_vs_ppj": round(
+            dyn["agg_inst_per_sec"] / max(ppj["agg_inst_per_sec"],
+                                          1e-9), 2),
+        "serve_churn_speedup_dynamic_vs_static": round(
+            dyn["agg_inst_per_sec"]
+            / max(legs["static"]["agg_inst_per_sec"], 1e-9), 2),
     }
 
 

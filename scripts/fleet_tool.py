@@ -2,10 +2,14 @@
 
 Usage:
     python scripts/fleet_tool.py submit SPOOL NAME [--batch]
+            [--tenant T] [--shard N] [--backpressure MAX]
             [--fault-plan S/S...] [--env K=V]... -- CHILD_ARGV...
     python scripts/fleet_tool.py list SPOOL
     python scripts/fleet_tool.py cancel SPOOL NAME
     python scripts/fleet_tool.py requeue SPOOL NAME
+    python scripts/fleet_tool.py gen-trace OUT --seed N [--jobs N]
+            [--classes N] [--cancel FRAC] [--span SEC] [--updates U]
+            [--tenants N]
 
 `submit` writes `SPOOL/NAME.json` atomically (tmp + rename), so a live
 orchestrator can never pick up a half-written spec.  Everything after
@@ -21,14 +25,28 @@ the fleet journal plus the spool contents, so it answers "what happened
 to my sweep?" after everything has exited.
 
 `--batch` marks the spec for device-lane packing: the orchestrator
-coalesces queued --batch specs whose argv (minus the seed) and env are
-identical into ONE supervised MultiWorld child (`--worlds`,
-avida_tpu/parallel/multiworld.py), so a W-seed sweep costs one process,
-one compile and one device program instead of W.  Each world keeps its
-own job dir, .dat output and solo-compatible checkpoints; on a static
-mismatch (or no peer, or a fault plan) the spec falls back to
-process-per-job with the reason journaled.  The argv must name its seed
-explicitly (`-s N`).
+coalesces queued --batch specs of one batchability class -- the
+CANONICAL resolved-static-config signature (service/serve.py), so
+specs may differ in dirs, seed spelling or override order -- into ONE
+supervised MultiWorld child (`--worlds`), or, under `--fleet ...
+--dynamic`, routes them into a warm ghost-padded serve child
+(`--serve-worlds`).  Each world keeps its own job dir, .dat output and
+solo-compatible checkpoints; on a static mismatch (or no peer, or a
+fault plan) the spec falls back to process-per-job with the reason
+journaled.  The argv must name its seed explicitly (`-s N`).
+
+Streaming-admission flags: `--tenant T` labels the spec for the
+per-tenant quota (TPU_FLEET_TENANT_MAX); `--shard N` spreads specs
+over `shard-<k>/` subdirs the orchestrator scans round-robin (one per
+poll tick -- thousands of queued specs never stall a tick); and
+`--backpressure MAX` refuses the submit (exit 3) while MAX specs
+already sit queued on disk -- the producer-side half of
+TPU_FLEET_QUEUE_MAX.
+
+`gen-trace` writes a deterministic arrival/cancel churn trace
+(utils/churntrace.py grammar, seeded like TPU_FAULT specs) -- the
+input of the serve acceptance bench (bench.py BENCH_SERVE=1) and the
+chaos suite's SIGKILL-mid-churn drill.
 """
 
 from __future__ import annotations
@@ -44,12 +62,59 @@ def _repo_path():
         sys.path.insert(0, repo)
 
 
+class QueueFullError(RuntimeError):
+    """--backpressure MAX refused the submit (the queue is full)."""
+
+
+def _queued_count(spool: str) -> int:
+    """Specs waiting on disk: spool root + every shard-* subdir."""
+    n = 0
+    try:
+        entries = os.listdir(spool)
+    except OSError:
+        return 0
+    for fn in entries:
+        p = os.path.join(spool, fn)
+        if fn.startswith("shard-") and os.path.isdir(p):
+            n += sum(1 for s in os.listdir(p)
+                     if s.endswith(".json") and not s.startswith(".")
+                     and not s.endswith(".cancelled.json"))
+        elif fn.endswith(".json") and not fn.startswith(".") \
+                and not fn.endswith(".cancelled.json"):
+            n += 1
+    return n
+
+
+def _spec_exists(spool: str, name: str) -> bool:
+    """A queued spec anywhere in the spool: the root OR any shard-*
+    subdir.  The duplicate check must span all of them -- the same name
+    submitted with different --shard values hashes to different dirs,
+    and the orchestrator would ingest one and silently strand the
+    other (inflating --backpressure counts forever)."""
+    if os.path.exists(os.path.join(spool, name + ".json")):
+        return True
+    try:
+        entries = os.listdir(spool)
+    except OSError:
+        return False
+    return any(fn.startswith("shard-")
+               and os.path.isfile(os.path.join(spool, fn,
+                                               name + ".json"))
+               for fn in entries)
+
+
 def submit(spool: str, name: str, argv: list, fault_plan=(),
-           env=None, batch: bool = False) -> str:
+           env=None, batch: bool = False, tenant: str = "",
+           shard: int | None = None,
+           backpressure: int = 0) -> str:
     """Write one job spec atomically; returns its path.  Validates with
     the orchestrator's own schema check so a typo is caught here, not
-    quarantined later."""
+    quarantined later.  `shard=N` hashes the job into `shard-<k>/`
+    (k = hash(name) % N); `backpressure=MAX` raises QueueFullError
+    while MAX specs already wait on disk."""
     _repo_path()
+    import zlib
+
     from avida_tpu.service.fleet import (legal_name,
                                          spec_seed_and_batch_key,
                                          validate_spec)
@@ -60,6 +125,8 @@ def submit(spool: str, name: str, argv: list, fault_plan=(),
         spec["fault_plan"] = list(fault_plan)
     if env:
         spec["env"] = dict(env)
+    if tenant:
+        spec["tenant"] = str(tenant)
     if batch:
         spec["batch"] = True
         if fault_plan:
@@ -69,9 +136,18 @@ def submit(spool: str, name: str, argv: list, fault_plan=(),
             raise ValueError("--batch needs an explicit seed in the "
                              "child argv (-s N) to key the world")
     validate_spec(spec)
-    os.makedirs(spool, exist_ok=True)
-    path = os.path.join(spool, name + ".json")
-    if os.path.exists(path) or os.path.isdir(os.path.join(spool, name)):
+    if backpressure and _queued_count(spool) >= int(backpressure):
+        raise QueueFullError(
+            f"{spool!r} already holds >= {backpressure} queued specs "
+            f"(backpressure); resubmit once the fleet drains")
+    dest = spool
+    if shard:
+        k = zlib.crc32(name.encode()) % int(shard)
+        dest = os.path.join(spool, f"shard-{k:02d}")
+    os.makedirs(dest, exist_ok=True)
+    path = os.path.join(dest, name + ".json")
+    if _spec_exists(spool, name) \
+            or os.path.isdir(os.path.join(spool, name)):
         raise ValueError(f"job {name!r} already exists in {spool!r}")
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
@@ -113,6 +189,7 @@ def main(argv=None) -> int:
         sep = rest.index("--")
         flags, child = rest[1:sep], rest[sep + 1:]
         fault_plan, env, batch = (), {}, False
+        tenant, shard, backpressure = "", None, 0
         i = 0
         while i < len(flags):
             if flags[i] == "--fault-plan" and i + 1 < len(flags):
@@ -126,16 +203,65 @@ def main(argv=None) -> int:
             elif flags[i] == "--batch":
                 batch = True
                 i += 1
+            elif flags[i] == "--tenant" and i + 1 < len(flags):
+                tenant = flags[i + 1]
+                i += 2
+            elif flags[i] == "--shard" and i + 1 < len(flags) \
+                    and flags[i + 1].isdigit():
+                shard = int(flags[i + 1])
+                i += 2
+            elif flags[i] == "--backpressure" and i + 1 < len(flags) \
+                    and flags[i + 1].isdigit():
+                backpressure = int(flags[i + 1])
+                i += 2
             else:
                 print(f"unknown submit flag {flags[i]!r}")
                 return 2
         try:
             path = submit(spool, name, child, fault_plan=fault_plan,
-                          env=env, batch=batch)
+                          env=env, batch=batch, tenant=tenant,
+                          shard=shard, backpressure=backpressure)
+        except QueueFullError as e:
+            print(f"submit held: {e}")
+            return 3
         except ValueError as e:
             print(f"submit rejected: {e}")
             return 2
         print(f"submitted {path}")
+        return 0
+    if cmd == "gen-trace":
+        # `spool` is the OUT path for this subcommand
+        _repo_path()
+        from avida_tpu.utils import churntrace
+        opts = {"seed": None, "jobs": 12, "classes": 1, "cancel": 0.2,
+                "span": 30.0, "updates": 40, "tenants": 1}
+        i = 0
+        while i < len(rest):
+            key = rest[i].lstrip("-")
+            if rest[i].startswith("--") and key in opts \
+                    and i + 1 < len(rest):
+                opts[key] = float(rest[i + 1]) if key == "cancel" \
+                    else (float(rest[i + 1]) if key == "span"
+                          else int(rest[i + 1]))
+                i += 2
+            else:
+                print(f"unknown gen-trace flag {rest[i]!r}")
+                return 2
+        if opts["seed"] is None:
+            print("gen-trace needs --seed N (determinism is the point)")
+            return 2
+        events = churntrace.generate(
+            opts["seed"], jobs=opts["jobs"], classes=opts["classes"],
+            cancel_frac=opts["cancel"], span=opts["span"],
+            updates=opts["updates"], tenants=opts["tenants"])
+        text = churntrace.format_trace(
+            events, seed=opts["seed"],
+            note=(f"jobs={opts['jobs']} classes={opts['classes']} "
+                  f"cancel={opts['cancel']} span={opts['span']} "
+                  f"updates={opts['updates']} tenants={opts['tenants']}"))
+        with open(spool, "w") as f:
+            f.write(text)
+        print(f"wrote {len(events)} events to {spool}")
         return 0
     if cmd == "list":
         jobs = list_jobs(spool)

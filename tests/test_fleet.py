@@ -589,10 +589,10 @@ def test_fleet_main_cli_parse(tmp_path):
 def test_spec_seed_and_batch_key():
     from avida_tpu.service.fleet import spec_seed_and_batch_key
     s, k = spec_seed_and_batch_key({"argv": ["-u", "10", "-s", "7"]})
-    assert s == 7 and k[0] == ("-u", "10")
+    assert s == 7 and k.startswith("sig:")
     s2, k2 = spec_seed_and_batch_key(
         {"argv": ["-u", "10", "-set", "RANDOM_SEED", "9"]})
-    assert s2 == 9 and k2[0] == ("-u", "10")
+    assert s2 == 9
     assert k == k2                       # seed spelling doesn't split keys
     s3, k3 = spec_seed_and_batch_key({"argv": ["-u", "10"]})
     assert s3 is None                    # no explicit seed: unbatchable
@@ -607,6 +607,45 @@ def test_spec_seed_and_batch_key():
     validate_spec({"argv": ["-u", "1"], "batch": True})
     with pytest.raises(ValueError):
         validate_spec({"argv": ["-u", "1"], "batch": "yes"})
+
+
+def test_batch_key_is_canonical_not_byte_equal():
+    """The PR-12 over-strict-coalesce fix: the batchability key is the
+    RESOLVED static config, so specs that differ only in output dirs,
+    `-s` position, override order, or defaults spelled out vs omitted
+    share one class (they fell back to process-per-job before)."""
+    from avida_tpu.service.fleet import spec_seed_and_batch_key
+    base = {"argv": ["-u", "10", "-s", "7", "-set", "WORLD_X", "60"]}
+    _, k = spec_seed_and_batch_key(base)
+    # output dirs + checkpoint dirs are per-member routing, not statics
+    _, k_dirs = spec_seed_and_batch_key(
+        {"argv": ["-d", "out/a", "-set", "TPU_CKPT_DIR", "ck/a",
+                  "-u", "10", "-s", "8", "-set", "WORLD_X", "60"]})
+    assert k_dirs == k
+    # seed spelling/position + override order are cosmetic
+    _, k_pos = spec_seed_and_batch_key(
+        {"argv": ["-set", "WORLD_X", "60", "-u", "10",
+                  "-set", "RANDOM_SEED", "9"]})
+    assert k_pos == k
+    # a default spelled out explicitly resolves identically
+    _, k_spelled = spec_seed_and_batch_key(
+        {"argv": ["-u", "10", "-s", "7", "-set", "WORLD_X", "60",
+                  "-set", "WORLD_Y", "60"]})
+    assert k_spelled == k                # WORLD_Y 60 is the default
+    # genuinely different statics still split
+    _, k_other = spec_seed_and_batch_key(
+        {"argv": ["-u", "10", "-s", "7", "-set", "WORLD_X", "50"]})
+    assert k_other != k
+    # a different run budget splits the STATIC coalescer's key (one
+    # shared -u per --worlds child; the serve pool strips it instead)
+    _, k_u = spec_seed_and_batch_key(
+        {"argv": ["-u", "20", "-s", "7", "-set", "WORLD_X", "60"]})
+    assert k_u != k
+    from avida_tpu.service.serve import static_signature
+    assert static_signature(base, with_updates=False) == \
+        static_signature({"argv": ["-u", "20", "-s", "7",
+                                   "-set", "WORLD_X", "60"]},
+                         with_updates=False)
 
 
 def test_fleet_batch_coalesces_static_equal_specs(tmp_path):

@@ -1,0 +1,501 @@
+"""Streaming serve layer, host-only tier (service/serve.py + the fleet
+integration + utils/churntrace.py + fleet_tool flags).
+
+Everything here runs on a fake clock with SCRIPTED children -- the
+serve-class child is emulated at the PROTOCOL level (control.json in,
+serve.json + heartbeat out) through the Supervisor._spawn seam, so no
+test compiles a world.  The jax side of the same contract (ghost
+identity, rider promotion without a recompile, demotion checkpoints)
+lives in tests/test_serve_batch.py."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import pytest
+
+import test_supervisor as ts
+from avida_tpu.observability.exporter import read_metrics
+from avida_tpu.observability.runlog import read_records
+from avida_tpu.service.fleet import (JOURNAL_FILE, FleetConfig,
+                                     FleetOrchestrator)
+from avida_tpu.service.serve import (SpecArgv, batch_ineligible_reason,
+                                     member_argv, static_signature,
+                                     width_class)
+from avida_tpu.utils import churntrace
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "scripts"))
+import fleet_tool  # noqa: E402
+
+SUP_ENV = {"TPU_WATCHDOG_SEC": "10", "TPU_SUPERVISE_POLL_SEC": "0.5",
+           "TPU_SUPERVISE_GRACE_SEC": "30",
+           "TPU_SUPERVISE_MAX_RETRIES": "2",
+           "TPU_SUPERVISE_BACKOFF_BASE": "0.1",
+           "TPU_SUPERVISE_BACKOFF_CAP": "0.5",
+           "TPU_SUPERVISE_HEALTHY_SEC": "1000000000"}
+
+ARGS = ["-u", "40", "-set", "WORLD_X", "8", "-set", "WORLD_Y", "8"]
+
+
+# ---------------------------------------------------------------------------
+# the signature / width-class / eligibility units
+# ---------------------------------------------------------------------------
+
+def test_spec_argv_parsing():
+    pa = SpecArgv(["-s", "7", "-set", "RANDOM_SEED", "9", "-u", "50",
+                   "-d", "out", "-c", "cfgdir", "-v"])
+    assert pa.effective_seed == 7          # -s beats -set RANDOM_SEED
+    assert pa.max_updates == 50
+    assert pa.data_dir == "out"
+    assert pa.config_dir == "cfgdir"
+    assert pa.residual == ["-v"]
+    assert SpecArgv(["-set", "RANDOM_SEED", "9"]).effective_seed == 9
+    assert SpecArgv(["-u", "10"]).effective_seed is None
+
+
+def test_width_class_pow2_set():
+    assert [width_class(n, 2, 16) for n in (1, 2, 3, 4, 5, 9, 17, 100)] \
+        == [2, 2, 4, 4, 8, 16, 16, 16]
+    assert width_class(1, 4, 16) == 4      # min width floors
+    assert width_class(3, 2, 6) == 4       # cap rounds DOWN to pow2
+
+
+def test_signature_resolves_config_dir_contents(tmp_path):
+    """Two config dirs with identical contents coalesce; editing a
+    config file splits the class even when argv is unchanged."""
+    d1, d2 = tmp_path / "c1", tmp_path / "c2"
+    for d in (d1, d2):
+        os.makedirs(d)
+        with open(d / "avida.cfg", "w") as f:
+            f.write("WORLD_X 8\nWORLD_Y 8\n")
+    s1 = static_signature({"argv": ["-c", str(d1), "-s", "1"]})
+    s2 = static_signature({"argv": ["-c", str(d2), "-s", "2"]})
+    assert s1 == s2
+    with open(d2 / "avida.cfg", "a") as f:
+        f.write("COPY_MUT_PROB 0.01\n")
+    assert static_signature({"argv": ["-c", str(d2), "-s", "2"]}) != s1
+
+
+def test_member_argv_strips_routing_keeps_statics():
+    spec = {"argv": ["-s", "3", "-d", "out", "-set", "TPU_CKPT_DIR",
+                     "ck", "-set", "WORLD_X", "8", "-u", "40"]}
+    assert member_argv(spec) == ["-set", "WORLD_X", "8", "-u", "40"]
+
+
+def test_batch_ineligible_reasons():
+    assert batch_ineligible_reason({"argv": ARGS}) is None
+    assert "solo" in batch_ineligible_reason(
+        {"argv": ARGS + ["--telemetry"]})
+    assert "solo" in batch_ineligible_reason(
+        {"argv": ARGS + ["-set", "TPU_TRACE", "1"]})
+    assert "per-process" in batch_ineligible_reason(
+        {"argv": ARGS + ["-set", "TPU_FAULT", "crash"]})
+    assert batch_ineligible_reason(
+        {"argv": ARGS + ["-set", "TPU_TRACE", "0"]}) is None
+
+
+# ---------------------------------------------------------------------------
+# churn traces (the gen-trace satellite)
+# ---------------------------------------------------------------------------
+
+def test_churntrace_grammar_and_determinism(tmp_path):
+    evs = churntrace.generate(7, jobs=6, classes=2, cancel_frac=0.34,
+                              span=20, updates=30)
+    text = churntrace.format_trace(evs, seed=7)
+    assert text == churntrace.format_trace(
+        churntrace.generate(7, jobs=6, classes=2, cancel_frac=0.34,
+                            span=20, updates=30), seed=7)
+    path = tmp_path / "t.trace"
+    path.write_text(text)
+    parsed = churntrace.parse_trace(str(path))
+    assert [e.text for e in parsed] == [e.text for e in evs]
+    assert {e.kind for e in parsed} == {"submit", "cancel"}
+    # times are sorted, cancels follow their submit
+    assert [e.t for e in parsed] == sorted(e.t for e in parsed)
+    for bad in ("submit:job=a,seed=1,u=5", "nope:job=a@t=1",
+                "submit:seed=1,u=5@t=1", "submit:job=a,bare@t=1",
+                "submit:job=a,seed=x,u=5@t=1"):
+        with pytest.raises(ValueError):
+            churntrace.parse_event(bad)
+
+
+def test_churntrace_replay_drives_spool(tmp_path):
+    spool = str(tmp_path / "spool")
+    clk = ts.FakeClock()
+    evs = churntrace.parse_trace([
+        "submit:job=a,seed=1,u=5@t=0",
+        "submit:job=b,seed=2,u=5,tenant=org1@t=1",
+        "cancel:job=a@t=2",
+    ])
+    seen = []
+    churntrace.replay(spool, evs, lambda e: ARGS + ["-s",
+                                                    e.args["seed"]],
+                      clock=clk, sleep=clk.sleep,
+                      on_event=lambda e: seen.append(e.kind))
+    assert seen == ["submit", "submit", "cancel"]
+    assert os.path.exists(os.path.join(spool, "a.json"))
+    assert os.path.exists(os.path.join(spool, "a.cancel"))
+    spec_b = json.load(open(os.path.join(spool, "b.json")))
+    assert spec_b["tenant"] == "org1" and spec_b["batch"] is True
+
+
+def test_fleet_tool_gen_trace_cli(tmp_path):
+    out = str(tmp_path / "x.trace")
+    assert fleet_tool.main(["gen-trace", out, "--seed", "5",
+                            "--jobs", "4", "--classes", "2"]) == 0
+    evs = churntrace.parse_trace(out)
+    assert sum(1 for e in evs if e.kind == "submit") == 4
+    assert fleet_tool.main(["gen-trace", str(tmp_path / "y")]) == 2
+
+
+def test_fleet_tool_shard_and_backpressure(tmp_path):
+    spool = str(tmp_path / "spool")
+    p1 = fleet_tool.submit(spool, "s1", ARGS, shard=4)
+    p2 = fleet_tool.submit(spool, "s2", ARGS, shard=4)
+    assert "/shard-" in p1 and "/shard-" in p2
+    # duplicate detection reaches across shards
+    with pytest.raises(ValueError, match="already exists"):
+        fleet_tool.submit(spool, "s1", ARGS, shard=4)
+    with pytest.raises(fleet_tool.QueueFullError):
+        fleet_tool.submit(spool, "s3", ARGS, backpressure=2)
+    # CLI exit code 3 for the held submit
+    assert fleet_tool.main(["submit", spool, "s3", "--backpressure",
+                            "2", "--", "-u", "1"]) == 3
+    assert fleet_tool.submit(spool, "s3", ARGS, backpressure=5)
+
+
+# ---------------------------------------------------------------------------
+# the serve pool against protocol-level stub children
+# ---------------------------------------------------------------------------
+
+class StubServeProc(ts.FakeProc):
+    """A --serve-worlds child emulated at the protocol level: admits
+    members from control.json at every poll, advances them `rate`
+    updates per fake second, retires them at their max_updates (or on
+    demotion), reports through serve.json, keeps the supervisor
+    heartbeat fresh, and exits on shutdown."""
+
+    def __init__(self, clock, rate=10.0, crash_after=None):
+        super().__init__(clock, code=0, runtime=None)
+        self.rate = rate
+        self.crash_after = crash_after  # fake seconds -> exit 1
+        self.members: dict = {}
+        self.finished: dict = {}
+        self._last_t = None
+
+    def _spawned(self, argv, env, logf):
+        super()._spawned(argv, env, logf)
+        i = argv.index("--serve-worlds")
+        self.control = argv[i + 1]
+        self.data = argv[argv.index("-d") + 1]
+        self._last_t = self.clock()
+
+    def poll(self):
+        if self.returncode is not None:
+            return self.returncode
+        now = self.clock()
+        dt, self._last_t = now - self._last_t, now
+        if self.crash_after is not None \
+                and now - self.t0 >= self.crash_after:
+            self.returncode = 1
+            return self.returncode
+        try:
+            with open(self.control) as f:
+                ctl = json.load(f)
+        except (OSError, ValueError):
+            ctl = {}
+        width = int(ctl.get("width", 2))
+        want = {e["name"]: e for e in ctl.get("members") or []}
+        for n in list(self.members):
+            if n not in want:               # demotion
+                self.finished[n] = {
+                    "state": "retired",
+                    "update": int(self.members.pop(n)["u"])}
+        for n, e in want.items():
+            if n not in self.members and n not in self.finished \
+                    and len(self.members) < width:
+                self.members[n] = {"u": 0.0, "entry": e}
+        for n in list(self.finished):
+            if n not in want:               # ack consumed
+                del self.finished[n]
+        for n, m in list(self.members.items()):
+            m["u"] += self.rate * dt
+            cap = m["entry"].get("max_updates")
+            if cap is not None and m["u"] >= cap:
+                self.finished[n] = {"state": "done", "update": int(cap)}
+                del self.members[n]
+        status = {
+            "width": width, "live": len(self.members),
+            "ghosts": width - len(self.members), "compiles": 3,
+            "members": {n: {"state": "live", "update": int(m["u"])}
+                        for n, m in self.members.items()},
+            "finished": dict(self.finished),
+        }
+        os.makedirs(self.data, exist_ok=True)
+        with open(os.path.join(self.data, "serve.json"), "w") as f:
+            json.dump(status, f)
+        ts._write_metrics(self.data, hb=now)
+        if ctl.get("shutdown") and not self.members:
+            self.returncode = 0
+        return self.returncode
+
+    def terminate(self):
+        if self.returncode is None:
+            ts._write_metrics(self.data, hb=self.clock(), preempted=1)
+            self.returncode = 0
+
+
+class ServeStubs:
+    """spawn_factory: serve-class leaders get StubServeProc, plain jobs
+    get the scripted FakeProc from `scripts` (test_fleet pattern)."""
+
+    def __init__(self, clock, scripts=None, serve_kw=None):
+        self.clock = clock
+        self.scripts = {k: list(v) for k, v in (scripts or {}).items()}
+        self.serve_kw = list(serve_kw or [])
+        self.spawned = []
+
+    def factory(self, job):
+        def spawn(argv, env, logf):
+            if "--serve-worlds" in argv:
+                kw = self.serve_kw.pop(0) if self.serve_kw else {}
+                proc = StubServeProc(self.clock, **kw)
+            else:
+                proc = self.scripts[job.name].pop(0)()
+            proc._spawned(argv, env, logf)
+            if not isinstance(proc, StubServeProc) and "-d" in argv:
+                proc._data = argv[argv.index("-d") + 1]
+            self.spawned.append((job.name, proc, argv))
+            return proc
+        return spawn
+
+
+def _cfg(**kw):
+    base = dict(max_jobs=2, poll_sec=0.5, breaker_k=3, breaker_sec=60.0,
+                drain_sec=30.0, dynamic=True)
+    base.update(kw)
+    return FleetConfig(**base)
+
+
+def _mk_fleet(tmp_path, clk, scripts=None, serve_kw=None, **cfg_kw):
+    spool = str(tmp_path / "spool")
+    stubs = ServeStubs(clk, scripts, serve_kw)
+    fleet = FleetOrchestrator(spool, cfg=_cfg(**cfg_kw),
+                              env=dict(SUP_ENV), clock=clk,
+                              sleep=clk.sleep,
+                              spawn_factory=stubs.factory)
+    return fleet, spool, stubs
+
+
+def _drive(fleet, clk, max_ticks=400):
+    for _ in range(max_ticks):
+        if not fleet.poll_once():
+            return
+        clk.sleep(0.5)
+    raise AssertionError("fleet did not drain within the tick budget")
+
+
+def _events(spool):
+    recs = [r for r in read_records(os.path.join(spool, JOURNAL_FILE))
+            if r.get("record") == "fleet"]
+    return [(r["event"], r.get("job")) for r in recs], recs
+
+
+def test_serve_pool_hit_miss_done_and_gauges(tmp_path):
+    """Three same-class arrivals spawn ONE warm child (cache miss); a
+    late rider routes into its free ghost slot (cache hit, no new
+    child); every member journals done; the idle class is asked to shut
+    down so the fleet drains."""
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    for n, s in (("t1", 7), ("t2", 8), ("t3", 9)):
+        fleet_tool.submit(spool, n, ARGS + ["-s", str(s)], batch=True)
+    fleet, spool, stubs = _mk_fleet(tmp_path, clk)
+    # drive until the class child is up, then submit the rider
+    for _ in range(6):
+        fleet.poll_once()
+        clk.sleep(0.5)
+    leaders = [n for n, j in fleet.jobs.items()
+               if n.startswith("serve-") and j.state == "running"]
+    assert len(leaders) == 1
+    fleet_tool.submit(spool, "t4", ARGS + ["-s", "10"], batch=True)
+    _drive(fleet, clk)
+    states = {n: j.state for n, j in fleet.jobs.items()}
+    assert states[leaders[0]] == "done"
+    assert all(states[t] == "done" for t in ("t1", "t2", "t3", "t4"))
+    events, recs = _events(spool)
+    coal = [r for r in recs if r["event"] == "coalesced"]
+    assert len(coal) == 4
+    assert [r["cache"] for r in coal].count("hit") == 1
+    assert next(r for r in coal if r["job"] == "t4")["cache"] == "hit"
+    # one class child total: the rider spawned NO new process
+    assert sum(1 for n, _, _ in stubs.spawned
+               if n.startswith("serve-")) == 1
+    m = read_metrics(os.path.join(spool, "fleet.prom"))
+    assert m["avida_fleet_serve_cache_hits_total"] == 1
+    assert m["avida_fleet_serve_cache_misses_total"] == 1
+    assert m["avida_fleet_serve_promotions_total"] == 4
+
+
+def test_serve_cancel_demotes_member_alone(tmp_path):
+    """Cancelling a serve member demotes only IT: the control loses the
+    member, the child retires it, the journal lands `cancelled`, and
+    the classmates run on to completion undisturbed."""
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    for n, s in (("c1", 7), ("c2", 8)):
+        fleet_tool.submit(spool, n, ARGS + ["-s", str(s)], batch=True)
+    fleet, spool, stubs = _mk_fleet(tmp_path, clk,
+                                    serve_kw=[{"rate": 2.0}])
+    for _ in range(6):
+        fleet.poll_once()
+        clk.sleep(0.5)
+    assert fleet.jobs["c1"].state == "batched"
+    fleet_tool.main(["cancel", spool, "c1"])
+    _drive(fleet, clk)
+    states = {n: j.state for n, j in fleet.jobs.items()}
+    assert states["c1"] == "cancelled" and states["c2"] == "done"
+    events, _ = _events(spool)
+    assert ("cancel_requested", "c1") in events
+    assert ("cancelled", "c1") in events
+    assert ("done", "c2") in events
+    m = read_metrics(os.path.join(spool, "fleet.prom"))
+    assert m["avida_fleet_serve_demotions_total"] == 1
+
+
+def test_serve_replay_reattaches_class_after_orchestrator_kill(tmp_path):
+    """The crash-safety acceptance: an orchestrator SIGKILLed mid-churn
+    replays its journal, reattaches the serve class from the on-disk
+    control file, re-marks its members batched (no solo double-spawn),
+    and the tenants complete."""
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    for n, s in (("r1", 7), ("r2", 8)):
+        fleet_tool.submit(spool, n, ARGS + ["-s", str(s)], batch=True)
+    f1, spool, stubs1 = _mk_fleet(tmp_path, clk,
+                                  serve_kw=[{"rate": 0.5}])
+    for _ in range(6):
+        f1.poll_once()
+        clk.sleep(0.5)
+    assert {f1.jobs["r1"].state, f1.jobs["r2"].state} == {"batched"}
+    # abandon f1 (in-process SIGKILL); the stub child dies with it
+    # (same-process emulation), so f2's supervisor restarts the class
+    for _, proc, _ in stubs1.spawned:
+        proc.kill()
+    stubs2 = ServeStubs(clk)
+    f2 = FleetOrchestrator(spool, cfg=_cfg(), env=dict(SUP_ENV),
+                           clock=clk, sleep=clk.sleep,
+                           spawn_factory=stubs2.factory)
+    # the reattached class must carry the ORIGINAL member signature
+    # (the stored serve_sig): re-hashing the leader's own argv -- which
+    # carries --serve-worlds and strips member routing -- would never
+    # match an arrival, so every post-restart same-class spec would
+    # cold-spawn a duplicate child past the warm one (regression:
+    # caught in review, the sig fell back to the leader-argv hash)
+    f2.poll_once()
+    from avida_tpu.service.serve import static_signature
+    member_sig = static_signature(
+        {"argv": ARGS + ["-s", "9"], "batch": True},
+        with_updates=False)
+    assert [c.sig for c in f2.serve_pool.classes.values()] \
+        == [member_sig]
+    _drive(f2, clk)
+    events, _ = _events(spool)
+    assert any(e == "serve_reattach" for e, _ in events)
+    states = {n: j.state for n, j in f2.jobs.items()}
+    assert states["r1"] == "done" and states["r2"] == "done"
+    # the members never spawned their own solo children in EITHER life
+    solo_spawns = [n for n, _, _ in stubs1.spawned + stubs2.spawned
+                   if not n.startswith("serve-")]
+    assert solo_spawns == []
+
+
+def test_serve_leader_failure_requeues_members(tmp_path):
+    """A class child that dies terminally (supervisor budget exhausted)
+    requeues its members -- their solo-format checkpoints make that
+    safe -- and a fresh class picks them up."""
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    for n, s in (("f1", 7), ("f2", 8)):
+        fleet_tool.submit(spool, n, ARGS + ["-s", str(s)], batch=True)
+    fleet, spool, stubs = _mk_fleet(
+        tmp_path, clk,
+        serve_kw=[{"crash_after": 2.0}, {"crash_after": 2.0},
+                  {"crash_after": 2.0}, {}])
+    _drive(fleet, clk)
+    events, _ = _events(spool)
+    assert any(e == "requeued" and j in ("f1", "f2")
+               for e, j in events)
+    states = {n: j.state for n, j in fleet.jobs.items()}
+    assert states["f1"] == "done" and states["f2"] == "done"
+    # two classes existed: the crashed one and its replacement
+    assert sum(1 for e, _ in events if e == "serve_class") == 2
+
+
+def test_tenant_quota_holds_overflow_in_queue(tmp_path):
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    for n in ("q1", "q2"):
+        fleet_tool.submit(spool, n, ["-u", "10"], tenant="acme")
+    fleet, spool, stubs = _mk_fleet(
+        tmp_path, clk,
+        scripts={n: [lambda: ts.FakeProc(clk, code=0, runtime=3.0)]
+                 for n in ("q1", "q2")},
+        dynamic=False, tenant_max=1, max_jobs=4)
+    seen_both_running = []
+
+    real_poll = fleet.poll_once
+
+    def poll():
+        active = real_poll()
+        running = [n for n, j in fleet.jobs.items()
+                   if j.state == "running"]
+        seen_both_running.append(len(running))
+        return active
+
+    fleet.poll_once = poll
+    _drive(fleet, clk)
+    assert max(seen_both_running) == 1     # never two acme jobs at once
+    assert all(j.state == "done" for j in fleet.jobs.values())
+
+
+def test_queue_backpressure_bounds_ingestion(tmp_path):
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    for i in range(5):
+        fleet_tool.submit(spool, f"b{i}", ["-u", "10"])
+    fleet, spool, stubs = _mk_fleet(
+        tmp_path, clk,
+        scripts={f"b{i}": [lambda: ts.FakeProc(clk, code=0,
+                                               runtime=1.0)]
+                 for i in range(5)},
+        dynamic=False, queue_max=2, max_jobs=1)
+    fleet.poll_once()
+    ingested = sum(1 for j in fleet.jobs.values()
+                   if j.state in ("queued", "running"))
+    assert ingested <= 3                   # 2 queued + 1 admitted
+    _drive(fleet, clk)
+    assert all(j.state == "done" for j in fleet.jobs.values())
+
+
+def test_shard_dirs_scanned_round_robin(tmp_path):
+    clk = ts.FakeClock()
+    spool = str(tmp_path / "spool")
+    paths = [fleet_tool.submit(spool, f"s{i}", ["-u", "10"], shard=3)
+             for i in range(4)]
+    assert all("/shard-" in p for p in paths)
+    fleet, spool, stubs = _mk_fleet(
+        tmp_path, clk,
+        scripts={f"s{i}": [lambda: ts.FakeProc(clk, code=0,
+                                               runtime=1.0)]
+                 for i in range(4)},
+        dynamic=False, max_jobs=2)
+    _drive(fleet, clk)
+    assert all(j.state == "done" for j in fleet.jobs.values())
+    # fault domains still live at the spool ROOT (shards hold only
+    # queued specs)
+    for i in range(4):
+        assert os.path.isdir(os.path.join(spool, f"s{i}"))

@@ -1,0 +1,443 @@
+"""ServeBatch: ghost-padded dynamic membership (parallel/multiworld.py).
+
+The jax side of the streaming serve layer's contract, on the fast XLA
+tier: a W=4 class padded from 3 live worlds runs bit-exact vs the 3
+solo runs; a rider promoted MID-RUN at a checkpoint boundary reaches
+its first executed update with ZERO fresh compiles (the all-ghost
+warmup traced every chunk variant; scan_trace_count is the probe) and
+finishes bit-exact vs its own solo run; a member demoted at a boundary
+leaves a checkpoint byte-identical to the solo generation and resumes
+solo bit-exactly.  The packed/Pallas stacked leg and the
+SIGKILL-mid-churn orchestrator drill are slow-marked.
+
+Host-only protocol tests live in tests/test_serve.py."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from avida_tpu.config import AvidaConfig
+from avida_tpu.parallel import multiworld as mwmod
+from avida_tpu.parallel.multiworld import ServeBatch
+from avida_tpu.utils import checkpoint as ckpt_mod
+from avida_tpu.world import World
+
+U = 17
+SEEDS = {"m0": 3, "m1": 11, "m2": 29, "m3": 41}
+_NB_SCRATCH = ("nb_genome", "nb_len", "nb_cell", "nb_parent",
+               "nb_update")
+
+
+def _cfg(seed, ck=None, **extra):
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 8
+    cfg.WORLD_Y = 8
+    cfg.TPU_MAX_MEMORY = 256
+    cfg.RANDOM_SEED = seed
+    cfg.AVE_TIME_SLICE = 100
+    cfg.TPU_MAX_STEPS_PER_UPDATE = 100
+    cfg.set("TPU_CKPT_AUDIT", 0)
+    cfg.set("TPU_CKPT_EVERY", 8)
+    cfg.set("TPU_CKPT_FINAL", 1)
+    cfg.set("TPU_METRICS", 1)
+    if ck:
+        cfg.set("TPU_CKPT_DIR", str(ck))
+    for k, v in extra.items():
+        cfg.set(k, v)
+    return cfg
+
+
+def _world(seed, data, ck=None, **extra):
+    w = World(cfg=_cfg(seed, ck, **extra), data_dir=str(data))
+    w.events = []
+    return w
+
+
+@pytest.fixture(scope="module")
+def solo_refs(tmp_path_factory):
+    """Uninterrupted solo reference runs (checkpoints on) for every
+    tenant the serve legs admit."""
+    td = tmp_path_factory.mktemp("solo")
+    refs = {}
+    for name, s in SEEDS.items():
+        w = _world(s, td / name / "d", td / name / "ck")
+        w.run(max_updates=U)
+        refs[name] = (w, str(td / name / "ck"))
+    return refs
+
+
+def _assert_world_equal(a, b, name, exact_time=True,
+                        scratch_exact=True):
+    for fname in a.state.__dataclass_fields__:
+        va = getattr(a.state, fname)
+        if va is None:
+            continue
+        va = np.asarray(va)
+        vb = np.asarray(getattr(b.state, fname))
+        if fname in _NB_SCRATCH and not scratch_exact:
+            cnt = int(np.asarray(a.state.nb_count))
+            va, vb = va[:cnt], vb[:cnt]
+        np.testing.assert_array_equal(va, vb,
+                                      err_msg=f"{name} field {fname}")
+    assert int(np.asarray(a._total_births)) \
+        == int(np.asarray(b._total_births)), name
+    ta, tb = (float(np.asarray(a._avida_time)),
+              float(np.asarray(b._avida_time)))
+    if exact_time:
+        assert ta == tb, name
+    else:
+        # a rider's chunk grid differs from solo -> f32 association
+        # wiggle in the HOST time accumulator only (device state above
+        # is exact)
+        assert np.isclose(ta, tb), name
+    assert a._flush_exec() == b._flush_exec(), name
+    assert a.systematics.num_genotypes == b.systematics.num_genotypes
+    assert sorted(g.sequence.tobytes()
+                  for g in a.systematics.live_genotypes()) \
+        == sorted(g.sequence.tobytes()
+                  for g in b.systematics.live_genotypes())
+
+
+def _member_entry(td, name, **extra):
+    e = {"name": name, "seed": SEEDS[name],
+         "data_dir": str(td / "serve" / name / "d"),
+         "ckpt_dir": str(td / "serve" / name / "ck"),
+         "max_updates": U}
+    e.update(extra)
+    return e
+
+
+def _write_control(path, members, width=4, shutdown=False):
+    tmp = str(path) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"width": width, "shutdown": shutdown,
+                   "members": members}, f)
+    os.replace(tmp, str(path))
+
+
+def test_serve_batch_ghost_rider_demotion(solo_refs, tmp_path):
+    """The acceptance core on the XLA path, one serve lifetime:
+
+      * W=4 padded from 3 live (slot 3 stays ghost until the rider);
+      * boundary u=8: m1 demoted + rider m3 promoted (admitted at the
+        u=16 boundary reconcile, starting from ITS update 0 while its
+        classmates continue from 16 -- the per-world u0 vector);
+      * zero multiworld_scan traces beyond the all-ghost warmup: the
+        rider reached its first executed update on warm programs;
+      * completed members bit-exact vs their solo runs; the demoted
+        member's checkpoint byte-identical to the solo generation and
+        solo-resumable bit-exactly."""
+    td = tmp_path
+    prebuilt = {}
+
+    def factory(entry):
+        name = entry["name"]
+        if name == "__ghost__":
+            return _world(0, entry["data_dir"])
+        w = _world(SEEDS[name], entry["data_dir"], entry["ckpt_dir"])
+        prebuilt[name] = w
+        return w
+
+    ctl = td / "control.json"
+    _write_control(ctl, [_member_entry(td, n)
+                         for n in ("m0", "m1", "m2")])
+    sb = ServeBatch(4, str(ctl), str(td / "serve" / "root"),
+                    world_factory=factory)
+    traces0 = mwmod.scan_trace_count()
+
+    def hook(s):
+        if s.boundaries == 1:
+            # at the u=8 boundary: demote m1, queue rider m3 (the next
+            # boundary's reconcile admits it)
+            _write_control(ctl, [_member_entry(td, n)
+                                 for n in ("m0", "m2", "m3")])
+
+    sb._boundary_hook = hook
+    real_sleep = time.sleep
+
+    def idle_sleep(sec):
+        if not sb._live() and all(
+                sb.finished.get(n, {}).get("state") == "done"
+                for n in ("m0", "m2", "m3")):
+            _write_control(ctl, [_member_entry(td, n)
+                                 for n in ("m0", "m2", "m3")],
+                           shutdown=True)
+        real_sleep(0.01)
+
+    sb._sleep = idle_sleep
+    sb.serve()
+
+    # the compile-cache claim: the warmup traced every pow2 chunk
+    # variant (1,2,4,8) and NOTHING about the churn -- admission,
+    # demotion, ragged per-world updates -- traced a new program
+    assert mwmod.scan_trace_count() - traces0 == 4
+    assert sb.admissions == 4 and sb.retirements == 4
+    # slot bookkeeping: the batch ended all-ghost
+    assert sb.num_ghosts == 4 and sb.num_live == 0
+    # ghost slots did zero device work: slot 3 was ghost until the
+    # rider arrived, and the rider reused m1's freed slot 1 -- so slot
+    # 3's lifetime trip count is exactly 0
+    assert float(np.asarray(sb._trips)[3]) == 0.0
+
+    # completed members bit-exact vs solo (m0/m2 share the solo chunk
+    # grid -> exact host time too; the rider's grid differs)
+    _assert_world_equal(solo_refs["m0"][0], prebuilt["m0"], "m0")
+    _assert_world_equal(solo_refs["m2"][0], prebuilt["m2"], "m2")
+    _assert_world_equal(solo_refs["m3"][0], prebuilt["m3"], "m3",
+                        exact_time=False)
+
+    # the demoted member's handoff artifact: its u=16 generation is
+    # byte-identical to the solo run's (same grid up to the demotion)
+    ua = {ckpt_mod.generation_update(p): p
+          for p in ckpt_mod.list_generations(solo_refs["m1"][1])}
+    ub = {ckpt_mod.generation_update(p): p
+          for p in ckpt_mod.list_generations(
+              str(td / "serve" / "m1" / "ck"))}
+    assert 16 in ua and 16 in ub
+    for fn in sorted(os.listdir(ua[16])):
+        with open(os.path.join(ua[16], fn), "rb") as f:
+            ba = f.read()
+        with open(os.path.join(ub[16], fn), "rb") as f:
+            bb = f.read()
+        if fn == ckpt_mod.MANIFEST:
+            ja, jb = json.loads(ba), json.loads(bb)
+            ja.pop("saved_at"), jb.pop("saved_at")
+            assert ja == jb, fn
+        else:
+            assert ba == bb, fn
+
+    # demotion -> solo is a free transition: resume from the serve
+    # checkpoint and finish bit-exact vs the uninterrupted solo run
+    w1 = _world(SEEDS["m1"], td / "resume" / "d",
+                td / "serve" / "m1" / "ck")
+    assert w1.resume() == 16
+    w1.run(max_updates=U)
+    _assert_world_equal(solo_refs["m1"][0], w1, "m1-resumed",
+                        scratch_exact=False)
+
+    # observability: serve.json + the two .prom files
+    st = json.load(open(td / "serve" / "root" / "serve.json"))
+    assert st["width"] == 4 and st["ghosts"] == 4
+    assert st["compiles"] >= 4
+    from avida_tpu.observability.exporter import read_metrics
+    m = read_metrics(str(td / "serve" / "root" / "metrics.prom"))
+    assert m["avida_serve_width"] == 4
+    assert m["avida_serve_admissions_total"] == 4
+    assert m["avida_serve_retirements_total"] == 4
+
+
+def test_serve_cli_rejects_bad_control(tmp_path):
+    from avida_tpu.__main__ import main
+    assert main(["--serve-worlds", str(tmp_path / "nope.json")]) == 2
+    bad = tmp_path / "ctl.json"
+    bad.write_text('{"width": 0, "members": []}')
+    assert main(["--serve-worlds", str(bad)]) == 2
+
+
+@pytest.mark.slow
+def test_serve_batch_packed_pallas_rider(tmp_path):
+    """The kernel leg: a packed-resident stacked ServeBatch (interpret
+    Pallas) serves 2 tenants + ghosts at W=4, admits a rider mid-run,
+    and every tenant matches its solo run bit-exactly -- the per-world
+    u0 vector composes with the stacked kernel launch and the packed
+    whole-chunk residency."""
+    from avida_tpu.ops import packed_chunk
+
+    over = dict(TPU_USE_PALLAS=1, TPU_SYSTEMATICS=0, TPU_LANE_PERM=0,
+                TPU_KERNEL_SHARDS=1, TPU_PACKED_CHUNK=1,
+                TPU_CKPT_EVERY=4)
+    UU = 12
+    seeds = {"p0": 5, "p1": 9, "p2": 23}
+    solos = {}
+    for n, s in seeds.items():
+        w = _world(s, tmp_path / "solo" / n / "d",
+                   tmp_path / "solo" / n / "ck", **over)
+        w.run(max_updates=UU)
+        solos[n] = w
+
+    prebuilt = {}
+
+    def factory(entry):
+        name = entry["name"]
+        if name == "__ghost__":
+            return _world(0, entry["data_dir"], **over)
+        w = _world(seeds[name], entry["data_dir"], entry["ckpt_dir"],
+                   **over)
+        prebuilt[name] = w
+        return w
+
+    def entry(n):
+        return {"name": n, "seed": seeds[n],
+                "data_dir": str(tmp_path / "serve" / n / "d"),
+                "ckpt_dir": str(tmp_path / "serve" / n / "ck"),
+                "max_updates": UU}
+
+    ctl = tmp_path / "control.json"
+    _write_control(ctl, [entry("p0"), entry("p1")])
+    sb = ServeBatch(4, str(ctl), str(tmp_path / "serve" / "root"),
+                    world_factory=factory)
+    assert packed_chunk.active(sb.params, sb._ghost_state)
+    traces0 = mwmod.scan_trace_count()
+
+    def hook(s):
+        if s.boundaries == 1:
+            _write_control(ctl, [entry(n) for n in seeds])
+
+    sb._boundary_hook = hook
+    real_sleep = time.sleep
+
+    def idle_sleep(sec):
+        if not sb._live() and all(
+                sb.finished.get(n, {}).get("state") == "done"
+                for n in seeds):
+            _write_control(ctl, [entry(n) for n in seeds],
+                           shutdown=True)
+        real_sleep(0.01)
+
+    sb._sleep = idle_sleep
+    sb.serve()
+    assert mwmod.scan_trace_count() - traces0 == 3   # warmup 1,2,4 only
+    for n in seeds:
+        # the serve boundary grid (every 4) differs from the solo
+        # planner's [8,4] grid, so host f32 time association differs;
+        # all device state is exact
+        _assert_world_equal_nosys(solos[n], prebuilt[n], n,
+                                  exact_time=False)
+
+
+def _assert_world_equal_nosys(a, b, name, exact_time=True):
+    for fname in a.state.__dataclass_fields__:
+        va = getattr(a.state, fname)
+        if va is None:
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(va), np.asarray(getattr(b.state, fname)),
+            err_msg=f"{name} field {fname}")
+    assert int(np.asarray(a._total_births)) \
+        == int(np.asarray(b._total_births)), name
+    if exact_time:
+        assert float(np.asarray(a._avida_time)) \
+            == float(np.asarray(b._avida_time)), name
+    assert a._flush_exec() == b._flush_exec(), name
+
+
+# ---------------------------------------------------------------------------
+# the SIGKILL-mid-churn drill: real orchestrator, real serve children
+# ---------------------------------------------------------------------------
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHILD_SETS = [("WORLD_X", "8"), ("WORLD_Y", "8"),
+              ("TPU_MAX_MEMORY", "256"), ("AVE_TIME_SLICE", "100"),
+              ("TPU_MAX_STEPS_PER_UPDATE", "100"),
+              ("TPU_CKPT_EVERY", "4"), ("TPU_CKPT_AUDIT", "0"),
+              ("TPU_SERVE_POLL_SEC", "0.3")]
+
+
+def _child_args(seed, u):
+    args = ["-u", str(u)]
+    for n, v in CHILD_SETS:
+        args += ["-set", n, v]
+    return args + ["-s", str(seed)]
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)  # PR-6 landmine
+    env["PYTHONPATH"] = REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    return env
+
+
+def _spawn_fleet(spool):
+    return subprocess.Popen(
+        [sys.executable, "-m", "avida_tpu", "--fleet", spool,
+         "--dynamic", "--max-jobs", "2"],
+        cwd=REPO, env=_env(), stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT)
+
+
+@pytest.mark.slow
+def test_serve_sigkill_mid_churn_resumable(tmp_path):
+    """The acceptance drill: tenants stream into a dynamic fleet, the
+    ORCHESTRATOR is SIGKILLed mid-churn (no drain, serve child left as
+    an orphan), and a fresh orchestrator replays the journal, reaps the
+    orphan, reattaches the class and finishes every tenant -- each
+    resumable from its own per-world checkpoints, final state bit-exact
+    vs an uninterrupted solo run."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import fleet_tool
+    from avida_tpu.service.fleet import journal_states
+
+    spool = str(tmp_path / "spool")
+    UU = 24
+    seeds = {"t1": 7, "t2": 8, "t3": 9}
+    for n, s in seeds.items():
+        fleet_tool.submit(spool, n, _child_args(s, UU), batch=True)
+    proc = _spawn_fleet(spool)
+    try:
+        # wait for mid-churn evidence: some tenant has a published
+        # checkpoint generation (so the kill lands after real progress)
+        deadline = time.time() + 420
+        while time.time() < deadline:
+            if any(ckpt_mod.list_generations(os.path.join(spool, n,
+                                                          "ck"))
+                   for n in seeds):
+                break
+            time.sleep(2)
+        else:
+            raise AssertionError("no tenant checkpointed in time")
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    # fresh orchestrator: replay + orphan reap + reattach + finish
+    proc2 = _spawn_fleet(spool)
+    try:
+        assert proc2.wait(timeout=600) == 0
+    finally:
+        if proc2.poll() is None:
+            proc2.kill()
+            proc2.wait()
+    st, _, _ = journal_states(os.path.join(spool, "fleet.jsonl"))
+    assert all(st[n] == "done" for n in seeds), st
+
+    # bit-exactness: every tenant's final checkpoint equals an
+    # uninterrupted in-process solo run with the same resolved config
+    for n, s in seeds.items():
+        cfg = AvidaConfig()
+        for k, v in CHILD_SETS:
+            cfg.set(k, v)
+        cfg.set("RANDOM_SEED", s)
+        cfg.set("TPU_METRICS", 1)
+        cfg.set("TPU_CKPT_FINAL", 1)
+        solo = World(cfg=cfg, data_dir=str(tmp_path / "ref" / n))
+        solo.run(max_updates=UU)
+        cfg2 = AvidaConfig()
+        for k, v in CHILD_SETS:
+            cfg2.set(k, v)
+        cfg2.set("RANDOM_SEED", s)
+        restored = World(cfg=cfg2,
+                         data_dir=str(tmp_path / "res" / n))
+        assert restored.resume(os.path.join(spool, n, "ck")) == UU
+        for fname in solo.state.__dataclass_fields__:
+            va = getattr(solo.state, fname)
+            if va is None:
+                continue
+            va = np.asarray(va)
+            vb = np.asarray(getattr(restored.state, fname))
+            if fname in _NB_SCRATCH:
+                cnt = int(np.asarray(solo.state.nb_count))
+                va, vb = va[:cnt], vb[:cnt]
+            np.testing.assert_array_equal(
+                va, vb, err_msg=f"{n} field {fname}")
