@@ -249,6 +249,23 @@ class AvidaConfig:
     # attacked in-kernel instead (TPU_KERNEL_ROWSKIP row-tile skipping +
     # the per-block while_loop early exit).
     TPU_PACKED_CHUNK: int = 1
+    # Persistent AOT program cache (utils/compilecache.py): 1 = the
+    # engine's compiled scan programs (update_scan / multiworld_scan)
+    # are AOT-serialized into an on-disk store and deserialized in
+    # milliseconds by later processes with the same static config --
+    # a cold-spawned serve/fleet child skips the ~25-40s compile
+    # window.  0 is a HARD kill switch (the env var TPU_COMPILE_CACHE=0
+    # kills it too); entries are CRC-manifested and any toolchain or
+    # code drift falls back loudly to a fresh trace.  This is NOT
+    # JAX_COMPILATION_CACHE_DIR (which corrupts resumed runs on this
+    # toolchain -- README "Known landmines"): it is avida-tpu's own
+    # store with its own integrity checks.
+    TPU_COMPILE_CACHE: int = 1
+    # Cache root directory ("-" = resolve from the TPU_COMPILE_CACHE_DIR
+    # env var, else ~/.cache/avida_tpu/compile).  The fleet orchestrator
+    # points children at SPOOL/compile-cache so one class child's
+    # compile warms every sibling.
+    TPU_COMPILE_CACHE_DIR: str = "-"
     # Runtime telemetry (avida_tpu/observability/): 1 = phase-fenced
     # staged updates, device counters and a telemetry.jsonl run log in
     # DATA_DIR.  Opt-in: 0 (default) compiles to the identical update

@@ -28,6 +28,8 @@ import time
 
 import numpy as np
 
+from avida_tpu.utils import compilecache
+
 METRICS_FILE = "metrics.prom"
 MULTIWORLD_METRICS_FILE = "multiworld.prom"
 
@@ -103,7 +105,10 @@ def render_families(families) -> str:
 
 def _render(values: dict, trace) -> str:
     """Exposition text from a resolved values dict (+ optional trace
-    counter triple (events_total, dropped_total, code_totals))."""
+    counter triple (events_total, dropped_total, code_totals)).  The
+    avida_compile_cache_* families ride every flavor of the run
+    heartbeat (empty for cache-off processes, so those files are
+    byte-compatible with pre-cache builds)."""
     if trace is not None:
         events_total, dropped_total, _ = trace
         values = dict(values,
@@ -116,6 +121,7 @@ def _render(values: dict, trace) -> str:
             ("avida_trace_code_total", *_HELP["avida_trace_code_total"],
              {f'code="{code}"': count
               for code, count in trace[2].items()}))
+    families += compilecache.prom_families()
     return render_families(families)
 
 
@@ -169,6 +175,23 @@ def format_status(metrics: dict, now: float | None = None) -> str:
             f"trace       "
             f"{int(metrics['avida_trace_events_total'])} events, "
             f"{int(metrics.get('avida_trace_dropped_total', 0))} dropped")
+    if "avida_compile_cache_hits_total" in metrics \
+            or "avida_compile_cache_misses_total" in metrics:
+        # persistent AOT program cache (utils/compilecache.py): how
+        # this process got its compiled programs -- deserialized (hits)
+        # vs freshly traced (misses) -- and what each side cost
+        lines.append(
+            f"cache       "
+            f"{int(metrics.get('avida_compile_cache_hits_total', 0))} "
+            f"loads "
+            f"({metrics.get('avida_compile_cache_load_ms_total', 0.0):.0f}"
+            f"ms), "
+            f"{int(metrics.get('avida_compile_cache_misses_total', 0))} "
+            f"compiles "
+            f"({metrics.get('avida_compile_cache_compile_ms_total', 0.0):.0f}"
+            f"ms), "
+            f"{int(metrics.get('avida_compile_cache_errors_total', 0))} "
+            f"fallbacks")
     if metrics.get("avida_preempted"):
         lines.append("preempted   yes (resume with --resume)")
     return "\n".join(lines)
@@ -583,7 +606,7 @@ class ServeExporter:
              "multiworld_scan program variants traced by this process "
              "(flat after warmup = the compile cache is doing its job)",
              scan_trace_count()),
-        ]
+        ] + compilecache.prom_families()
         per_fams = [(name, *_HELP[name],
                      {f'world="{n}"': r[name] for n, r in rows.items()})
                     for name in self._PER_WORLD if rows]

@@ -79,6 +79,11 @@ def scan_trace_count() -> int:
     return _SCAN_TRACES
 
 
+def _compilecache_loads() -> int:
+    from avida_tpu.utils import compilecache
+    return compilecache.cache_load_count()
+
+
 @partial(jax.jit, static_argnums=(0, 2), donate_argnums=(1,))
 def multiworld_scan(params, bstate, chunk, run_keys, neighbors, u0):
     """Advance W worlds by `chunk` updates in ONE device program.
@@ -346,10 +351,14 @@ class MultiWorld:
         The extra `trips` vector feeds the batch-efficiency /
         straggler-lag gauges: trips[w, u] is world w's OWN trip count
         at update u, while the batch ran max over worlds."""
+        from avida_tpu.utils import compilecache
         self.bstate, (executed, births, deaths, dts, ave_gens, n_alive,
                       trips) = \
-            multiworld_scan(self.params, self.bstate, k, self._run_keys,
-                            self.neighbors, jnp.int32(self.update))
+            compilecache.call(
+                multiworld_scan, "multiworld_scan",
+                (self.params, self.bstate, k, self._run_keys,
+                 self.neighbors, jnp.int32(self.update)),
+                cfg=self.cfg, log=self._compile_cache_log)
         self._avida_time = self._avida_time + dts.sum(axis=1)
         self._last_ave_gen = ave_gens[:, -1]
         self._deaths_this = deaths[:, -1]
@@ -367,6 +376,13 @@ class MultiWorld:
         self.update += k
         for w in self.worlds:
             w.update = self.update
+
+    def _compile_cache_log(self, **fields):
+        """compile_cache journal shim for the batch's cached program
+        constructions -- lands in the lead member's telemetry when
+        armed, stderr always (runlog.emit_event)."""
+        from avida_tpu.observability.runlog import emit_event
+        emit_event(self.worlds[0], "compile_cache", **fields)
 
     def _events_due(self) -> bool:
         for ev in self.worlds[0].events:
@@ -764,6 +780,11 @@ class ServeBatch:
         self._boundary_hook = None      # test seam: after each
         #                                 checkpoint-boundary reconcile
         self._sysm_on = bool(int(self.cfg.get("TPU_SYSTEMATICS", 1)))
+        # the batchability-class signature the pool stamped into the
+        # control file (absent on hand-written controls): stored into
+        # compile-cache entry manifests so cache_tool can attribute an
+        # entry to its serve class
+        self._serve_sig = (self._read_control() or {}).get("sig")
         self.exporter = None
         if int(self.cfg.get("TPU_METRICS", 0)):
             from avida_tpu.observability.exporter import ServeExporter
@@ -807,6 +828,13 @@ class ServeBatch:
     def _log(self, msg: str):
         import sys
         print(f"[serve] {msg}", file=sys.stderr)
+
+    def _compile_cache_log(self, **fields):
+        """compile_cache journal shim for the serve child's cached
+        program constructions (stderr via runlog.emit_event; no member
+        owns the batch-wide program, so no telemetry writer)."""
+        from avida_tpu.observability.runlog import emit_event
+        emit_event(None, "compile_cache", **fields)
 
     def _read_control(self):
         try:
@@ -1016,10 +1044,15 @@ class ServeBatch:
         identities in their slots."""
         u0 = jnp.asarray([0 if w is None else w.update
                           for w in self.slots], jnp.int32)
+        from avida_tpu.utils import compilecache
         self.bstate, (executed, births, deaths, dts, ave_gens, n_alive,
                       trips) = \
-            multiworld_scan(self.params, self.bstate, k, self._run_keys,
-                            self.neighbors, u0)
+            compilecache.call(
+                multiworld_scan, "multiworld_scan",
+                (self.params, self.bstate, k, self._run_keys,
+                 self.neighbors, u0),
+                cfg=self.cfg, log=self._compile_cache_log,
+                sig=self._serve_sig)
         self._avida_time = self._avida_time + dts.sum(axis=1)
         self._last_ave_gen = ave_gens[:, -1]
         self._deaths_this = deaths[:, -1]
@@ -1073,6 +1106,11 @@ class ServeBatch:
             "admissions": self.admissions,
             "retirements": self.retirements,
             "compiles": scan_trace_count(),
+            # warm-start evidence's other half: programs deserialized
+            # from the persistent AOT cache (utils/compilecache.py) --
+            # a cold child warming from a sibling's executables shows
+            # cache_loads == program count with compiles == 0
+            "cache_loads": _compilecache_loads(),
             "preempted": bool(self.preempted or self._preempt),
             "shutdown": self._shutdown,
             "members": members,
@@ -1130,6 +1168,10 @@ class ServeBatch:
                 for k in sizes:
                     self._scan(k)
                 self._sync_worlds()
+                self._log(
+                    f"warm: {scan_trace_count()} traced, "
+                    f"{_compilecache_loads()} loaded from the persistent "
+                    f"compile cache")
             self._reconcile()
             self._publish(idle=not self._live())
             while not self._exit and not self._preempt:

@@ -886,8 +886,7 @@ class FleetOrchestrator:
             "--worlds", mpath,
             "-d", leader.data_dir, "-set", "TPU_CKPT_DIR",
             leader.ckpt_dir]
-        env = dict(self._base_env)
-        env.update(leader.spec.get("env") or {})
+        env = self._child_env(leader.spec)
         try:
             sup = Supervisor(argv, cfg=SupervisorConfig.from_env(env),
                              env=env, spawn=self._spawn_factory(leader),
@@ -943,6 +942,23 @@ class FleetOrchestrator:
                                        if leader.state == "cancelled"
                                        else "drain"))
 
+    def _child_env(self, spec) -> dict:
+        """The environment every child (solo, --worlds batch, serve
+        class) is spawned with.  Beyond base env + per-spec overrides,
+        the fleet points children at ONE spool-level persistent AOT
+        program cache (utils/compilecache.py) unless the operator or
+        the spec routed it elsewhere -- so a cold-spawned class child
+        deserializes a sibling's executables in milliseconds instead
+        of re-paying the compile window, and fleet-wide warmup is paid
+        once per (signature, width), not once per child.
+        TPU_COMPILE_CACHE=0 anywhere in the inherited env still kills
+        the cache inside the child (the hard switch)."""
+        env = dict(self._base_env)
+        env.update(spec.get("env") or {})
+        env.setdefault("TPU_COMPILE_CACHE_DIR",
+                       os.path.join(self.spool, "compile-cache"))
+        return env
+
     def _admit_spec_move(self, job: Job) -> bool:
         """The transactional half of admission, shared by solo and
         batched starts: journal-first ("admit"), THEN atomically move
@@ -982,8 +998,7 @@ class FleetOrchestrator:
                 return False
         argv = list(job.spec["argv"]) + [
             "-d", job.data_dir, "-set", "TPU_CKPT_DIR", job.ckpt_dir]
-        env = dict(self._base_env)
-        env.update(job.spec.get("env") or {})
+        env = self._child_env(job.spec)
         try:
             sup = Supervisor(argv,
                              fault_plan=job.spec.get("fault_plan") or (),
@@ -1450,16 +1465,30 @@ def format_fleet_status(spool: str, now: float | None = None) -> str:
                             if k.startswith(
                                 "avida_supervisor_failures_total")))
             extra = f"  (boots {boots}, failures {fails})"
+        run_prom = os.path.join(spool, name, "data", "metrics.prom")
+        runm = read_metrics(run_prom) if os.path.exists(run_prom) \
+            else None
+        if runm is not None and (
+                "avida_compile_cache_hits_total" in runm
+                or "avida_compile_cache_misses_total" in runm):
+            # persistent-compile-cache column (utils/compilecache.py
+            # families in the child's own heartbeat): hits/misses and
+            # the milliseconds spent deserializing -- a warm fleet
+            # shows Nh/0m with single-digit-second load totals where a
+            # cold one burned minutes compiling
+            extra += (
+                "  cache "
+                f"{int(runm.get('avida_compile_cache_hits_total', 0))}h/"
+                f"{int(runm.get('avida_compile_cache_misses_total', 0))}m"
+                f" load "
+                f"{runm.get('avida_compile_cache_load_ms_total', 0.0):.0f}"
+                f"ms")
         ana_prom = os.path.join(spool, name, "data", "analytics.prom")
         if os.path.exists(ana_prom):
             # per-tenant census column (analyze/pipeline.py live mode):
             # dominant lineage depth / census age / tasks-held, derived
             # by the same digest helper as the single-run --status line
-            run_prom = os.path.join(spool, name, "data", "metrics.prom")
-            d = analytics_census_digest(
-                read_metrics(ana_prom),
-                read_metrics(run_prom) if os.path.exists(run_prom)
-                else None)
+            d = analytics_census_digest(read_metrics(ana_prom), runm)
             age = "?" if d["age"] is None else str(d["age"])
             extra += (f"  census u{d['update']} age {age}u "
                       f"depth {d['depth']} tasks {d['tasks_held']}")

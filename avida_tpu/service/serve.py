@@ -59,6 +59,10 @@ NONSTATIC_VARS = frozenset((
     "TPU_CKPT_DIR", "TPU_CKPT_EVERY", "TPU_CKPT_KEEP", "TPU_CKPT_FINAL",
     "TPU_CKPT_AUDIT", "TPU_METRICS", "TPU_SERVE_IDLE_SEC",
     "TPU_SERVE_POLL_SEC", "TPU_SERVE_WARM",
+    # the persistent AOT program cache changes neither the compiled
+    # program's semantics nor the trajectory (utils/compilecache.py) --
+    # cache knobs must not split a batchability class
+    "TPU_COMPILE_CACHE", "TPU_COMPILE_CACHE_DIR",
 ))
 
 # spec env vars that are per-job operational knobs, not program inputs
@@ -67,6 +71,7 @@ _NONSTATIC_ENV = frozenset((
     "TPU_SUPERVISE_MAX_RETRIES", "TPU_SUPERVISE_BACKOFF_BASE",
     "TPU_SUPERVISE_BACKOFF_CAP", "TPU_SUPERVISE_HEALTHY_SEC",
     "TPU_SUPERVISE_SEED", "TPU_PROGRESS_SEC",
+    "TPU_COMPILE_CACHE", "TPU_COMPILE_CACHE_DIR",
 ))
 
 
@@ -299,7 +304,11 @@ class ServeClass:
         return self.width - len(self.members)
 
     def write_control(self):
-        doc = {"width": self.width, "shutdown": self.shutdown_sent,
+        # `sig` rides along so the child can stamp its batchability
+        # class into the compile-cache entries it publishes (the
+        # cache_tool listing's sig column; informational, not keyed)
+        doc = {"width": self.width, "sig": self.sig,
+               "shutdown": self.shutdown_sent,
                "members": sorted(self.members.values(),
                                  key=lambda e: e["name"])}
         tmp = f"{self.control_path}.tmp.{os.getpid()}"

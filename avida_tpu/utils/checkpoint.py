@@ -435,7 +435,18 @@ def _build_state(world, arrays: dict):
         raise CheckpointMismatchError(
             f"checkpoint state fields do not match this build "
             f"(missing {missing[:4]}, unknown {extra[:4]})")
-    vals = {name: (jnp.asarray(arrays[_STATE_PREFIX + name])
+    # DEVICE-OWNED copies, not views: jnp.asarray on a freshly-loaded
+    # numpy array may zero-copy alias the numpy-owned memory on the CPU
+    # backend, and these leaves are DONATED into the update scan.  The
+    # jit dispatch path quietly refuses to donate such buffers, but an
+    # ahead-of-time Compiled program (utils/compilecache.py) donates
+    # unconditionally -- the runtime then frees memory numpy owns:
+    # "free(): invalid pointer" heap aborts at process teardown, the
+    # same failure mode that condemned JAX_COMPILATION_CACHE_DIR in
+    # PR 6 (resumed runs loading cached executables).  One copy per
+    # resume is noise; tests/test_compile_cache.py's SIGKILL+resume
+    # drill is the regression net.
+    vals = {name: (jnp.copy(jnp.asarray(arrays[_STATE_PREFIX + name]))
                    if _STATE_PREFIX + name in arrays else None)
             for name in fields}
     cap = int(world.params.trace_cap)

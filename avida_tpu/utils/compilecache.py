@@ -1,0 +1,677 @@
+"""Persistent AOT program cache: serialized executables, not warm processes.
+
+PR 12's "program cache" was process reuse: a warm `--serve-worlds` child
+holds its compiled programs in memory, so warmth dies with the process
+and every COLD child re-pays the full ~25-40s trace+compile window --
+BENCH_r10_local.json shows that window is the entire reason static
+coalescing still beat dynamic serving on raw wall.  This module is the
+production-inference lever on top: the engine's compiled scan programs
+are ahead-of-time lowered (`jit_fn.lower(...).compile()`), their PJRT
+executables serialized (`jax.experimental.serialize_executable`), and
+stored on disk with the checkpoint subsystem's atomic-publish +
+CRC-manifest discipline -- so a cold-spawned class child deserializes a
+sibling's executable in milliseconds instead of re-tracing.
+
+This is explicitly NOT `JAX_COMPILATION_CACHE_DIR`.  That knob is the
+PR-6 landmine: on this toolchain a resumed run loading XLA's own cached
+executables produced glibc heap corruption and garbage state
+(README "Known landmines"; tests/test_chaos.py strips the variable).
+This cache is our own store with our own integrity root:
+
+  * every entry is published atomically (`.tmp-*` sibling, fsync,
+    rename) and carries a manifest with per-file CRC32s -- a byte flip,
+    a truncation or a torn publish fails verification and falls back to
+    a fresh trace with a journaled `compile_cache` event;
+  * entries that verify but were built by a DIFFERENT toolchain or code
+    version (jax/jaxlib version, backend platform, the in-repo source
+    digest -- scripts/check_jaxpr.py's update_step jaxpr snapshot folded
+    in) are refused loudly and overwritten by the fresh compile;
+  * `TPU_COMPILE_CACHE=0` (env var or config var -- either kills) is a
+    hard kill switch restoring the plain jit path, and the chaos drill
+    in tests/test_compile_cache.py proves SIGKILL+resume with the cache
+    ON stays bit-exact vs cache OFF -- the exact failure mode that
+    condemned the on-disk XLA cache.
+
+Cache key (the entry directory name): sha256 over the program tag
+(`update_scan` / `multiworld_scan`), a digest of the static WorldParams
+(every trace-relevant config fact, serve.static_signature's device-side
+shadow), the static chunk length, the shape/dtype of every dynamic
+input leaf (which pins the padded serve width W), the backend
+platform/device-kind/device-count, the x64 flag and the
+program-affecting env (TPU_KERNEL_ROWSKIP / TPU_TASKS_UNCOND /
+TPU_KERNEL_ABLATE read at trace time, plus XLA_FLAGS -- different
+compiler flags build genuinely different executables).
+Toolchain + code versions deliberately live in the MANIFEST rather than
+the key: a drifted entry is *found* and refused with a per-cause
+journaled reason (then overwritten), instead of silently orphaned.
+
+The module imports jax lazily: `scripts/cache_tool.py` (list / verify /
+prune) runs the pure-host entry plumbing without initializing a device.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import shutil
+import time
+import zlib
+
+from avida_tpu.utils.checkpoint import _crc32_file, _fsync_dir
+
+MANIFEST = "manifest.json"
+EXEC_FILE = "exec.bin"
+TREES_FILE = "trees.pkl"
+FORMAT = "avida-compile-cache-v1"
+
+# env knobs that change the COMPILED PROGRAM without touching
+# WorldParams, so they must split the cache key: the trace-time kernel
+# knobs (ops/pallas_cycles.py module level) plus XLA_FLAGS -- two
+# processes under different XLA flags compile genuinely different
+# executables (fast-math, host device count, ...) and must never share
+# an entry
+_TRACE_ENV_KNOBS = ("TPU_KERNEL_ROWSKIP", "TPU_TASKS_UNCOND",
+                    "TPU_KERNEL_ABLATE", "XLA_FLAGS")
+
+
+class CompileCacheError(RuntimeError):
+    """An entry failed verification (truncated/corrupt/unreadable)."""
+
+
+class CompileCacheMiss(CompileCacheError):
+    """No entry at this key -- the ordinary cold path, distinguished
+    structurally from corruption so call() never has to grep an error
+    message to decide whether to journal a loud fallback."""
+
+
+class CompileCacheStale(CompileCacheError):
+    """An entry is intact but was built by a different toolchain or
+    code version -- refused loudly, then overwritten by the fresh
+    compile (the self-healing flavor of invalidation)."""
+
+
+# ---------------------------------------------------------------------------
+# process-level state: the loaded-program memo and the observability counters
+# ---------------------------------------------------------------------------
+
+_memo: dict = {}                # key -> jax.stages.Compiled
+_key_failed_tags: set = set()   # tags whose key computation failed (once)
+_counters = {
+    "hits": 0,                  # programs deserialized from disk
+    "misses": 0,                # programs compiled fresh (entry absent)
+    "errors": 0,                # corrupt/stale/store-failure fallbacks
+    "load_ms": 0.0,
+    "compile_ms": 0.0,
+    "store_ms": 0.0,
+}
+
+
+def cache_load_count() -> int:
+    """How many programs this process deserialized from the persistent
+    cache -- the scan_trace_count()-style probe: a warm serve child
+    should run every chunk shape with cache_load_count() == len(shapes)
+    and scan_trace_count() == 0 (zero-trace warmup)."""
+    return _counters["hits"]
+
+
+def cache_miss_count() -> int:
+    return _counters["misses"]
+
+
+def cache_error_count() -> int:
+    return _counters["errors"]
+
+
+def counters() -> dict:
+    return dict(_counters)
+
+
+def reset_for_tests():
+    """Clear the memos + counters (tests simulate a fresh process)."""
+    _memo.clear()
+    _key_memo.clear()
+    _params_digests.clear()
+    _key_failed_tags.clear()
+    for k in _counters:
+        _counters[k] = 0 if isinstance(_counters[k], int) else 0.0
+
+
+def prom_families() -> list:
+    """The avida_compile_cache_* exposition families, render_families
+    shaped.  Empty when the process never touched the cache, so
+    cache-off runs publish byte-identical metrics files."""
+    c = _counters
+    if not (c["hits"] or c["misses"] or c["errors"]):
+        return []
+    return [
+        ("avida_compile_cache_hits_total", "counter",
+         "programs deserialized from the persistent compile cache",
+         c["hits"]),
+        ("avida_compile_cache_misses_total", "counter",
+         "programs compiled fresh (cache entry absent)", c["misses"]),
+        ("avida_compile_cache_errors_total", "counter",
+         "corrupt/stale/store-failure fallbacks (each journaled as a "
+         "compile_cache event)", c["errors"]),
+        ("avida_compile_cache_load_ms_total", "counter",
+         "milliseconds spent deserializing cached executables",
+         round(c["load_ms"], 1)),
+        ("avida_compile_cache_compile_ms_total", "counter",
+         "milliseconds spent in fresh trace+compile on cache misses",
+         round(c["compile_ms"], 1)),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# configuration: kill switch + cache root resolution (host-only)
+# ---------------------------------------------------------------------------
+
+def enabled(cfg=None) -> bool:
+    """TPU_COMPILE_CACHE=0 anywhere -- environment OR config -- is a
+    hard kill switch; the cache is on only when neither side disables
+    it (config default 1)."""
+    if os.environ.get("TPU_COMPILE_CACHE", "1") == "0":
+        return False
+    if cfg is not None and not int(cfg.get("TPU_COMPILE_CACHE", 1)):
+        return False
+    return True
+
+
+def cache_dir(cfg=None) -> str:
+    """Config TPU_COMPILE_CACHE_DIR beats env beats the per-user
+    default.  The fleet orchestrator points children at
+    SPOOL/compile-cache so sibling class children share one store."""
+    if cfg is not None:
+        d = str(cfg.get("TPU_COMPILE_CACHE_DIR", "-") or "-")
+        if d not in ("-", ""):
+            return d
+    d = os.environ.get("TPU_COMPILE_CACHE_DIR", "")
+    if d:
+        return d
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(base, "avida_tpu", "compile")
+
+
+# ---------------------------------------------------------------------------
+# key + code digest
+# ---------------------------------------------------------------------------
+
+_CODE_DIGEST = None
+
+
+def code_digest() -> str:
+    """Digest of the in-repo engine source: sha256 over every
+    avida_tpu/**/*.py file's contents plus the recorded update_step
+    jaxpr snapshot (scripts/jaxpr_digest.json -- check_jaxpr.py's
+    digest, the code-version component ROADMAP asked to reuse).  ANY
+    source edit therefore invalidates every cached executable loudly
+    (manifest check at load) -- conservative by design: a stale
+    executable that runs is worse than a spurious recompile."""
+    global _CODE_DIGEST
+    if _CODE_DIGEST is not None:
+        return _CODE_DIGEST
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    pkg = os.path.join(repo, "avida_tpu")
+    h = hashlib.sha256()
+    # sorted() materializes the whole walk before iteration, so the
+    # root-path sort alone fixes the traversal order deterministically
+    for root, _dirs, files in sorted(os.walk(pkg)):
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            h.update(os.path.relpath(path, pkg).encode())
+            try:
+                with open(path, "rb") as f:
+                    h.update(hashlib.sha256(f.read()).digest())
+            except OSError:
+                h.update(b"?")
+    snap = os.path.join(repo, "scripts", "jaxpr_digest.json")
+    try:
+        with open(snap, "rb") as f:
+            h.update(f.read())
+    except OSError:
+        pass
+    _CODE_DIGEST = h.hexdigest()
+    return _CODE_DIGEST
+
+
+def _aval_specs(dyn_args) -> list:
+    """(shape, dtype) of every dynamic-argument leaf, in tree order --
+    pins the world geometry, memory cap, serve width W and the PRNG key
+    dtype into the key."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(dyn_args)
+    return [[list(getattr(x, "shape", ())), str(getattr(x, "dtype", type(x)))]
+            for x in leaves]
+
+
+def _toolchain() -> dict:
+    import jax
+    import jaxlib
+
+    dev = jax.devices()[0]
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "device_count": jax.device_count(),
+        "x64": bool(jax.config.jax_enable_x64),
+        "code": code_digest(),
+    }
+
+
+_params_digests: dict = {}
+_key_memo: dict = {}
+
+
+def _params_digest(params) -> str:
+    """sha256 of the WorldParams repr, memoized on the (hashable,
+    all-static) params object -- the repr walks every instruction-set
+    tuple, far too heavy to redo once per chunk in the update loop."""
+    d = _params_digests.get(params)
+    if d is None:
+        d = hashlib.sha256(repr(params).encode()).hexdigest()
+        _params_digests[params] = d
+    return d
+
+
+def cache_key(tag: str, params, chunk, dyn_args) -> str:
+    """The entry name.  Everything that selects a DIFFERENT compiled
+    program must be here; toolchain/code versions are manifest-checked
+    instead (module header).  Memoized per (tag, params, chunk, aval
+    set): the scan drivers call this once per CHUNK, and everything in
+    the key is frozen per process (the env knobs are read at
+    pallas_cycles import; devices cannot change under a live backend).
+    """
+    import jax
+
+    avals = tuple((tuple(getattr(x, "shape", ())),
+                   str(getattr(x, "dtype", type(x))))
+                  for x in jax.tree_util.tree_leaves(dyn_args))
+    memo_key = (tag, params, int(chunk), avals)
+    key = _key_memo.get(memo_key)
+    if key is not None:
+        return key
+    dev = jax.devices()[0]
+    body = {
+        "tag": tag,
+        "params": _params_digest(params),
+        "chunk": int(chunk),
+        "avals": [[list(s), d] for s, d in avals],
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "device_count": jax.device_count(),
+        "x64": bool(jax.config.jax_enable_x64),
+        "env": {k: os.environ.get(k, "") for k in _TRACE_ENV_KNOBS},
+    }
+    text = json.dumps(body, sort_keys=True)
+    key = hashlib.sha256(text.encode()).hexdigest()[:40]
+    _key_memo[memo_key] = key
+    return key
+
+
+# ---------------------------------------------------------------------------
+# the on-disk entry store (pure host; checkpoint atomic-publish pattern)
+# ---------------------------------------------------------------------------
+
+def entry_path(root: str, key: str) -> str:
+    return os.path.join(root, key)
+
+
+def list_entries(root: str) -> list:
+    """Paths of all published entries under one cache root (dirs whose
+    manifest declares our format), sorted oldest-first by mtime."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        p = os.path.join(root, name)
+        if name.startswith((".tmp-", ".old-")) or not os.path.isdir(p):
+            continue
+        if os.path.exists(os.path.join(p, MANIFEST)):
+            out.append(p)
+    return sorted(out, key=lambda p: (os.path.getmtime(p), p))
+
+
+# the manifest fields that decide whether an existing same-key entry is
+# EQUIVALENT to what we are about to publish (write_entry's skip test)
+# -- the same set _verify_toolchain enforces at load time
+_TOOLCHAIN_FIELDS = ("jax", "jaxlib", "platform", "device_kind",
+                     "device_count", "x64", "code")
+
+
+def write_entry(root: str, key: str, payload: bytes, trees: bytes,
+                meta: dict) -> str:
+    """Atomically publish one cache entry (the checkpoint
+    write_generation discipline: tmp sibling, fsync everything, one
+    rename).  A same-key entry that already verifies AND matches this
+    publish's toolchain/code fields is left untouched: two sibling
+    class children compiling the same program concurrently is the
+    normal fleet warmup pattern, and yanking the winner's entry out
+    from under a third child mid-load would journal a false corruption
+    and re-open its compile window.  Corrupt or toolchain-stale
+    entries are still replaced (the self-healing path)."""
+    os.makedirs(root, exist_ok=True)
+    final = entry_path(root, key)
+    if os.path.isdir(final):
+        try:
+            existing = verify_entry(final)
+            if all(existing.get(f) == meta.get(f)
+                   for f in _TOOLCHAIN_FIELDS):
+                return final            # a sibling already published it
+        except CompileCacheError:
+            pass                        # corrupt/foreign: replace below
+    tmp = os.path.join(root, f".tmp-{key}.{os.getpid()}")
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    manifest = {
+        "format": FORMAT,
+        "key": key,
+        "created_at": time.time(),
+        "files": {},
+        **meta,
+    }
+    for name, blob in ((EXEC_FILE, payload), (TREES_FILE, trees)):
+        fpath = os.path.join(tmp, name)
+        with open(fpath, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        manifest["files"][name] = {
+            "size": len(blob),
+            "crc32": zlib.crc32(blob) & 0xFFFFFFFF,
+        }
+    mpath = os.path.join(tmp, MANIFEST)
+    with open(mpath, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_dir(tmp)
+    aside = None
+    if os.path.exists(final):
+        aside = os.path.join(root, f".old-{key}.{os.getpid()}")
+        if os.path.exists(aside):
+            shutil.rmtree(aside)
+        os.rename(final, aside)
+    os.rename(tmp, final)
+    _fsync_dir(root)
+    if aside is not None:
+        shutil.rmtree(aside, ignore_errors=True)
+    _sweep_debris(root)
+    return final
+
+
+# another process's in-flight .tmp- entry must survive our janitor: the
+# fleet points EVERY child at one SPOOL/compile-cache, and two cold
+# class children publishing concurrently is the normal warmup pattern,
+# not an edge case.  Own-pid debris is always stale (we only sweep
+# after our own publish); foreign debris is only swept once it is old
+# enough that its writer is surely dead or wedged.
+_DEBRIS_MAX_AGE_SEC = 3600.0
+
+
+def _sweep_debris(root: str) -> list:
+    removed = []
+    mine = f".{os.getpid()}"
+    now = time.time()
+    for d in os.listdir(root):
+        if not d.startswith((".tmp-", ".old-")):
+            continue
+        p = os.path.join(root, d)
+        if not d.endswith(mine):
+            try:
+                if now - os.path.getmtime(p) < _DEBRIS_MAX_AGE_SEC:
+                    continue            # possibly another writer, live
+            except OSError:
+                continue
+        shutil.rmtree(p, ignore_errors=True)
+        removed.append(p)
+    return removed
+
+
+def verify_entry(path: str) -> dict:
+    """Manifest + CRC sweep of one entry; returns the manifest.
+    Raises CompileCacheError on any missing/truncated/corrupt piece."""
+    mpath = os.path.join(path, MANIFEST)
+    if not os.path.exists(mpath):
+        raise CompileCacheError(f"{path}: no {MANIFEST}")
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (json.JSONDecodeError, OSError) as e:
+        raise CompileCacheError(f"{path}: torn or unreadable manifest ({e})")
+    if manifest.get("format") != FORMAT:
+        raise CompileCacheStale(
+            f"{path}: entry format {manifest.get('format')!r} "
+            f"(want {FORMAT})")
+    for name, spec in manifest.get("files", {}).items():
+        fpath = os.path.join(path, name)
+        if not os.path.exists(fpath):
+            raise CompileCacheError(f"{path}: missing {name}")
+        if os.path.getsize(fpath) != spec["size"]:
+            raise CompileCacheError(f"{path}: truncated {name}")
+        crc = _crc32_file(fpath)
+        if crc != spec["crc32"]:
+            raise CompileCacheError(
+                f"{path}: CRC mismatch on {name} "
+                f"({crc:#010x} != {spec['crc32']:#010x})")
+    return manifest
+
+
+def _verify_toolchain(path: str, manifest: dict):
+    """The loud invalidation gate: refuse an intact entry built by a
+    different jax/jaxlib, backend or code version.  Runs BEFORE any
+    byte of the pickled payload is touched -- unpickling another
+    toolchain's treedefs is exactly the kind of undefined behavior this
+    cache exists to never exercise."""
+    cur = _toolchain()
+    for field, label in (("jax", "jax version"),
+                         ("jaxlib", "jaxlib version"),
+                         ("platform", "backend platform"),
+                         ("device_kind", "device kind"),
+                         ("device_count", "device count"),
+                         ("x64", "x64 flag"),
+                         ("code", "code digest")):
+        want, have = manifest.get(field), cur[field]
+        if want != have:
+            raise CompileCacheStale(
+                f"{path}: stale {label} ({want!r} != {have!r})")
+
+
+def load_entry(root: str, key: str):
+    """(compiled, manifest) for one verified, toolchain-current entry.
+    Any failure raises CompileCacheError/CompileCacheStale -- callers
+    fall back to a fresh trace and journal the reason."""
+    from jax.experimental import serialize_executable as _se
+
+    path = entry_path(root, key)
+    if not os.path.isdir(path):
+        raise CompileCacheMiss(f"{path}: no entry")
+    manifest = verify_entry(path)
+    _verify_toolchain(path, manifest)
+    with open(os.path.join(path, TREES_FILE), "rb") as f:
+        in_tree, out_tree = pickle.loads(f.read())
+    with open(os.path.join(path, EXEC_FILE), "rb") as f:
+        payload = f.read()
+    compiled = _se.deserialize_and_load(payload, in_tree, out_tree)
+    return compiled, manifest
+
+
+def prune(root: str, keep: int = 0) -> list:
+    """Drop cache entries beyond the newest `keep` (0 = drop all), plus
+    stale .tmp-/.old- publish debris.  "Newest" is by directory mtime,
+    which load_entry refreshes on every successful load -- retention
+    keeps the most recently USED programs, not the most recently
+    published ones.  Returns removed paths.  Debris
+    goes through the same live-writer age guard as write_entry's
+    janitor (_sweep_debris): pruning a LIVE fleet's shared store must
+    not destroy a sibling child's in-flight publish."""
+    removed = []
+    if not os.path.isdir(root):
+        return removed
+    entries = list_entries(root)
+    drop = entries if keep <= 0 else entries[:-keep]
+    for p in drop:
+        shutil.rmtree(p, ignore_errors=True)
+        removed.append(p)
+    removed += _sweep_debris(root)
+    return removed
+
+
+def looks_like_cache_dir(path: str) -> bool:
+    """Does `path` hold at least one of our entries?  (cache_tool
+    --all's tree-walk screen, the ckpt_tool.prune_all pattern.)"""
+    if not os.path.isdir(path):
+        return False
+    for name in os.listdir(path):
+        mpath = os.path.join(path, name, MANIFEST)
+        try:
+            if os.path.exists(mpath):
+                with open(mpath) as f:
+                    if json.load(f).get("format") == FORMAT:
+                        return True
+        except (OSError, ValueError):
+            continue
+    return False
+
+
+# ---------------------------------------------------------------------------
+# the cached call (the only jax-touching entry point)
+# ---------------------------------------------------------------------------
+
+def call(jit_fn, tag: str, args: tuple, *, static_argnums=(0, 2),
+         cfg=None, log=None, sig: str | None = None):
+    """Run `jit_fn(*args)` through the persistent program cache.
+
+    args is the FULL positional tuple (statics included, jit call
+    order); static_argnums mirrors the jit wrapper's.  Disabled (kill
+    switch) -> the plain jit call, byte-for-byte the pre-cache path.
+    Process memo hit -> call the loaded executable (zero host work).
+    Disk hit -> verify CRCs + toolchain, deserialize, call.  Miss or
+    any verification failure -> fresh `lower().compile()` (identical
+    programs to what jit itself builds -- bit-exactness is by
+    construction and proven in tests/test_compile_cache.py), then
+    serialize + atomically publish so the next process loads it.
+
+    `log(**fields)` (World/ServeBatch pass a runlog emit_event shim)
+    journals every load / store / fallback as a `compile_cache` event.
+    Never lets a cache failure take down the run: the jit path is the
+    universal fallback."""
+    if not enabled(cfg):
+        return jit_fn(*args)
+
+    statics = sorted(static_argnums)
+    params = args[statics[0]]
+    chunk = args[statics[1]] if len(statics) > 1 else 0
+    dyn_args = tuple(a for i, a in enumerate(args) if i not in statics)
+    try:
+        key = cache_key(tag, params, chunk, dyn_args)
+    except Exception as e:                      # never block the run
+        # counted + journaled ONCE per tag: a persistent key failure
+        # would otherwise spam one journal line per chunk while the
+        # errors counter showed a healthy cache-off process
+        if tag not in _key_failed_tags:
+            _key_failed_tags.add(tag)
+            _counters["errors"] += 1
+            _note(log, action="key_failed", tag=tag, error=str(e))
+        return jit_fn(*args)
+
+    compiled = _memo.get(key)
+    if compiled is not None:
+        return compiled(*dyn_args)
+
+    root = cache_dir(cfg)
+    t0 = time.monotonic()
+    loaded = None
+    try:
+        loaded, _manifest = load_entry(root, key)
+    except CompileCacheMiss:
+        pass                                    # the ordinary cold path
+    except CompileCacheStale as e:
+        _counters["errors"] += 1
+        _note(log, action="stale", tag=tag, key=key, error=str(e))
+    except CompileCacheError as e:
+        _counters["errors"] += 1
+        _note(log, action="corrupt", tag=tag, key=key, error=str(e))
+    except Exception as e:
+        # deserialization itself failed (runtime refused the payload):
+        # same recovery as corruption -- fresh trace, overwrite
+        _counters["errors"] += 1
+        _note(log, action="deserialize_failed", tag=tag, key=key,
+              error=str(e))
+    if loaded is not None:
+        ms = (time.monotonic() - t0) * 1000.0
+        _counters["hits"] += 1
+        _counters["load_ms"] += ms
+        _memo[key] = loaded
+        try:
+            # touch: list_entries/prune order by mtime, and "recently
+            # LOADED" must count as recently used -- otherwise
+            # `--prune --keep N` evicts the fleet's hottest programs
+            # just because they were published first
+            os.utime(entry_path(root, key))
+        except OSError:
+            pass
+        _note(log, action="load", tag=tag, key=key, chunk=int(chunk),
+              ms=round(ms, 1))
+        # EXECUTION stays outside the try: a runtime error from the
+        # program itself must propagate exactly like the jit path's
+        # (the donated inputs are consumed -- retrying against them
+        # would mask the real error with "Array has been deleted")
+        return loaded(*dyn_args)
+
+    t0 = time.monotonic()
+    lowered = jit_fn.lower(*args)
+    compiled = lowered.compile()
+    compile_ms = (time.monotonic() - t0) * 1000.0
+    _counters["misses"] += 1
+    _counters["compile_ms"] += compile_ms
+    _memo[key] = compiled
+    _note(log, action="compile", tag=tag, key=key, chunk=int(chunk),
+          ms=round(compile_ms, 1))
+
+    t0 = time.monotonic()
+    try:
+        from jax.experimental import serialize_executable as _se
+        payload, in_tree, out_tree = _se.serialize(compiled)
+        trees = pickle.dumps((in_tree, out_tree))
+        meta = {
+            "tag": tag,
+            "chunk": int(chunk),
+            "avals": _aval_specs(dyn_args),
+            "params_digest": _params_digest(params),
+            "compile_ms": round(compile_ms, 1),
+            **_toolchain(),
+        }
+        if sig:
+            meta["sig"] = sig
+        write_entry(root, key, payload, trees, meta)
+        _counters["store_ms"] += (time.monotonic() - t0) * 1000.0
+        _note(log, action="store", tag=tag, key=key,
+              bytes=len(payload))
+    except Exception as e:
+        # unserializable executable (PJRT serialization support varies
+        # by backend: ValueError / NotImplementedError / XlaRuntimeError
+        # have all been seen in the wild), unpicklable treedef, or an
+        # unwritable cache root: the run proceeds on the in-memory
+        # program -- a store failure must never take down the run
+        _counters["errors"] += 1
+        _note(log, action="store_failed", tag=tag, key=key, error=str(e))
+    return compiled(*dyn_args)
+
+
+def _note(log, **fields):
+    if log is None:
+        return
+    try:
+        log(**fields)
+    except Exception:
+        pass

@@ -219,4 +219,8 @@ def restore_population(params, orgs, key, neighbors=None):
     if max_off > 0:
         st = jax.lax.fori_loop(
             0, max_off, lambda s, stx: body(s, stx), st)
-    return st
+    # device-owned copies: several leaves above are jnp.asarray views of
+    # numpy buffers, and the state is DONATED into the update scan --
+    # an AOT-cached program would free numpy-owned memory (the exact
+    # landmine utils/checkpoint._build_state documents)
+    return jax.tree.map(jnp.copy, st)

@@ -744,8 +744,12 @@ class World:
         if os.path.exists(side):
             totals = np.load(side)
             if totals.shape == tuple(self.state.task_exe_total.shape):
+                # device-owned copy, never a numpy view: this leaf is
+                # donated into the update scan (the AOT-cache landmine
+                # utils/checkpoint._build_state documents)
                 self.state = self.state.replace(
-                    task_exe_total=jnp.asarray(totals, jnp.int32))
+                    task_exe_total=jnp.copy(
+                        jnp.asarray(totals, jnp.int32)))
         self._reset_task_exe_baseline()
         if self.systematics is not None:
             from avida_tpu.systematics import GenotypeArbiter
@@ -960,9 +964,13 @@ class World:
         calls, i.e. strictly after the chunk-boundary unpack
         (tests/test_native_checkpoint.py, tests/test_tracer.py)."""
         assert self.state is not None, "no population injected"
+        from avida_tpu.utils import compilecache
         self.state, (executed, births, deaths, dts, ave_gens, n_alive) = \
-            update_scan(self.params, self.state, k, self._run_key,
-                        self.neighbors, jnp.int32(self.update))
+            compilecache.call(
+                update_scan, "update_scan",
+                (self.params, self.state, k, self._run_key,
+                 self.neighbors, jnp.int32(self.update)),
+                cfg=self.cfg, log=self._compile_cache_log)
         # avida time advances by 1/ave_gestation per update (the reference's
         # cStats::ProcessUpdate bookkeeping).  All accumulators stay device-
         # side scalars -- no host sync in the update loop.
@@ -1169,6 +1177,15 @@ class World:
     def _ckpt_base(self) -> str | None:
         d = str(self.cfg.get("TPU_CKPT_DIR", "-") or "-")
         return None if d in ("-", "") else d
+
+    def _compile_cache_log(self, **fields):
+        """Journal one persistent-program-cache action as a
+        {"record": "event", "event": "compile_cache"} runlog line:
+        loads/compiles/stores are the warmth evidence; corrupt / stale /
+        store-failure fallbacks are the loud invalidation trail the
+        cache contract promises (utils/compilecache.py)."""
+        from avida_tpu.observability.runlog import emit_event
+        emit_event(self, "compile_cache", **fields)
 
     def _install_preempt_handlers(self):
         """SIGTERM/SIGINT set a flag that World.run checks at update-chunk

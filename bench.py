@@ -184,6 +184,15 @@ def kernel_facts(params, st):
 def main():
     from avida_tpu.ops.update import update_step
 
+    # The bench is caching-immune by the round-9 harness rule: the
+    # persistent AOT program cache (utils/compilecache.py, default-on
+    # in production) is disabled for this process AND every child it
+    # spawns, so no measurement is flattered by a prior run's store --
+    # and no bench run mutates the user's ~/.cache.  The explicit cache
+    # arms (BENCH_COMPILE, the dynamic+cache churn leg) re-enable it
+    # against isolated roots; an operator override survives setdefault.
+    os.environ.setdefault("TPU_COMPILE_CACHE", "0")
+
     # 320x320 = 102,400 organisms (BASELINE.json config: 100k target scale).
     # Smaller on CPU so the bench terminates quickly off-TPU.
     on_tpu = jax.devices()[0].platform == "tpu"
@@ -237,6 +246,8 @@ def main():
                                   "120" if on_tpu else "20"))
         line.update(multiworld_fields(int(os.environ["BENCH_WORLDS"]),
                                       side, timed=4 if on_tpu else 3))
+    if os.environ.get("BENCH_COMPILE", "0") == "1":
+        line.update(compile_cache_fields())
     if os.environ.get("BENCH_SERVE", "0") == "1":
         line.update(serve_churn_fields())
     if os.environ.get("BENCH_PHASES", "1") != "0":
@@ -437,6 +448,10 @@ def multiworld_serve_fields(W, side, updates=40):
             "-set", "TPU_METRICS", "1", "-u", str(updates)]
     env = dict(os.environ)
     env.pop("BENCH_WORLDS", None)
+    # every solo child must pay its own full launch+compile (the whole
+    # point of the serve comparison): the persistent AOT cache would
+    # let child 2..W deserialize child 1's programs in milliseconds
+    env["TPU_COMPILE_CACHE"] = "0"
 
     def child(argv):
         t0 = time.perf_counter()
@@ -472,6 +487,97 @@ def multiworld_serve_fields(W, side, updates=40):
         "serve_speedup_x": round((mw_insts / mw_sec)
                                  / max(seq_insts / seq_sec, 1e-9), 2),
     }
+
+
+def compile_cache_fields():
+    """BENCH_COMPILE=1: the persistent AOT program cache
+    (utils/compilecache.py) measured per program, caching-immune via
+    FRESH subprocess children (scripts/compile_bench_child.py; the
+    round-9 harness rule -- process death is the only reliable jit-cache
+    flush).  For each engine scan program -- solo update_scan and the
+    W-world multiworld_scan -- a COLD child against an empty store
+    measures the fresh trace+compile (trace_ms) and the serialize+store
+    cost, then a WARM child against the now-populated store measures
+    the deserialize path (cache_load_ms, cache_hit).  speedup_x =
+    trace_ms / warm construct wall: the committed acceptance number
+    (>= 10x on this host)."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    child = os.path.join(repo, "scripts", "compile_bench_child.py")
+    side = os.environ.get("BENCH_COMPILE_SIDE", "8")
+    mem = os.environ.get("BENCH_COMPILE_MEM", "256")
+    chunk = os.environ.get("BENCH_COMPILE_CHUNK", "8")
+    worlds = os.environ.get("BENCH_COMPILE_WORLDS", "8")
+    reps = int(os.environ.get("BENCH_COMPILE_REPS", "3"))
+    out = {}
+    speedups = []
+    for tag in ("update_scan", "multiworld_scan"):
+        td = tempfile.mkdtemp(prefix=f"bench-cc-{tag}-")
+        rows = {}
+        try:
+            # one cold child (a full compile is too expensive to
+            # repeat), then `reps` warm children taking the MIN -- the
+            # deserialize path is seconds-scale on a 1-core host where
+            # scheduler noise only ever ADDS time, so the min is the
+            # honest construction cost (disclosed via warm_reps)
+            def run_child():
+                env = dict(os.environ)
+                env.pop("BENCH_COMPILE", None)
+                env.pop("JAX_COMPILATION_CACHE_DIR", None)  # PR-6 landmine
+                env["TPU_COMPILE_CACHE"] = "1"
+                env["TPU_COMPILE_CACHE_DIR"] = td
+                proc = subprocess.run(
+                    [sys.executable, child, "--tag", tag, "--side", side,
+                     "--mem", mem, "--chunk", chunk, "--worlds", worlds],
+                    env=env, capture_output=True, text=True, timeout=1800)
+                if proc.returncode != 0:
+                    raise RuntimeError(proc.stderr[-500:])
+                return json.loads(proc.stdout.strip().splitlines()[-1])
+
+            try:
+                rows["cold"] = run_child()
+                warms = [run_child() for _ in range(max(reps, 1))]
+                rows["warm"] = min(warms,
+                                   key=lambda r: r["construct_ms"])
+            except RuntimeError as e:
+                out[f"compile_cache_{tag}"] = {"error": str(e)}
+                continue
+        finally:
+            shutil.rmtree(td, ignore_errors=True)
+        if rows["cold"]["cache_hit"] or not rows["warm"]["cache_hit"]:
+            # the cold child's store silently failed (journaled
+            # store_failed: unserializable executable / full disk) or
+            # the store was pre-populated: record it per-tag instead of
+            # killing every other BENCH_* measurement in this run
+            out[f"compile_cache_{tag}"] = {
+                "error": "cold/warm hit pattern wrong "
+                         f"(cold hit={rows['cold']['cache_hit']}, "
+                         f"warm hit={rows['warm']['cache_hit']}) -- "
+                         "store likely failed; see the cold child's "
+                         "journal", **{f"cold_{k}": v for k, v
+                                       in rows["cold"].items()}}
+            continue
+        speedup = rows["cold"]["compile_ms"] / max(
+            rows["warm"]["construct_ms"], 1e-9)
+        speedups.append(speedup)
+        out[f"compile_cache_{tag}"] = {
+            "chunk": rows["cold"]["chunk"],
+            "worlds": rows["cold"]["worlds"],
+            "trace_ms": rows["cold"]["compile_ms"],
+            "store_ms": rows["cold"]["store_ms"],
+            "cache_load_ms": rows["warm"]["load_ms"],
+            "warm_construct_ms": rows["warm"]["construct_ms"],
+            "warm_reps": max(reps, 1),
+            "cache_hit": rows["warm"]["cache_hit"],
+            "payload_bytes": rows["cold"]["payload_bytes"],
+            "speedup_x": round(speedup, 1),
+        }
+    if speedups:
+        out["compile_cache_speedup_min_x"] = round(min(speedups), 1)
+    return out
 
 
 def serve_churn_fields(trace_path=None):
@@ -533,7 +639,7 @@ def serve_churn_fields(trace_path=None):
             args += ["-set", "COPY_MUT_PROB", mut[k % len(mut)]]
         return args + ["-s", ev.args["seed"]]
 
-    def leg(mode, deadline_sec=1200.0):
+    def leg(mode, deadline_sec=1200.0, cache_env=None):
         from avida_tpu.service.fleet import (JOURNAL_FILE,
                                              journal_states)
         td = tempfile.mkdtemp(prefix=f"bench-serve-{mode}-")
@@ -541,6 +647,12 @@ def serve_churn_fields(trace_path=None):
         env = dict(os.environ)
         env.pop("BENCH_SERVE", None)
         env.pop("JAX_COMPILATION_CACHE_DIR", None)   # PR-6 landmine
+        # the three baseline arms stay caching-immune (and comparable
+        # with BENCH_r10): the persistent AOT cache is OFF unless this
+        # leg is the dynamic+cache arm, which points at a store that
+        # SURVIVES across legs -- that persistence is the feature
+        env["TPU_COMPILE_CACHE"] = "0"
+        env.update(cache_env or {})
         cfg = FleetConfig(max_jobs=2, poll_sec=0.3, serve=True,
                           dynamic=(mode == "dynamic"),
                           serve_min_width=8)
@@ -611,8 +723,9 @@ def serve_churn_fields(trace_path=None):
                 if os.path.exists(sj):
                     try:
                         with open(sj) as f:
-                            out["serve_compiles"] = json.load(
-                                f).get("compiles")
+                            doc = json.load(f)
+                        out["serve_compiles"] = doc.get("compiles")
+                        out["serve_cache_loads"] = doc.get("cache_loads")
                     except (OSError, ValueError):
                         pass
                     break
@@ -620,17 +733,41 @@ def serve_churn_fields(trace_path=None):
         return out
 
     legs = {m: leg(m) for m in ("ppj", "static", "dynamic")}
+    # the fourth arm (round 11): dynamic serving with the persistent AOT
+    # executable store (utils/compilecache.py).  The FIRST replay against
+    # an empty store is the producer pass (children compile AND
+    # serialize; its wall is reported honestly as the prewarm cost); the
+    # SECOND replay against the now-populated store is steady-state
+    # serving -- what production traffic sees once executables persist
+    # across orchestrator restarts: a cold-spawned class child
+    # deserializes its programs in milliseconds, so no arrival ever
+    # lands inside a compile window.
+    ccdir = tempfile.mkdtemp(prefix="bench-serve-cc-")
+    cache_env = {"TPU_COMPILE_CACHE": "1", "TPU_COMPILE_CACHE_DIR": ccdir}
+    prewarm = leg("dynamic", cache_env=cache_env)
+    legs["dynamic+cache"] = leg("dynamic", cache_env=cache_env)
+    shutil.rmtree(ccdir, ignore_errors=True)
     dyn, ppj = legs["dynamic"], legs["ppj"]
+    dyc = legs["dynamic+cache"]
     return {
         "serve_churn_trace": os.path.basename(trace_path),
         "serve_churn_tenants": len(tenants),
         "serve_churn": legs,
+        "serve_churn_cache_prewarm": prewarm,
         "serve_churn_speedup_dynamic_vs_ppj": round(
             dyn["agg_inst_per_sec"] / max(ppj["agg_inst_per_sec"],
                                           1e-9), 2),
         "serve_churn_speedup_dynamic_vs_static": round(
             dyn["agg_inst_per_sec"]
             / max(legs["static"]["agg_inst_per_sec"], 1e-9), 2),
+        "serve_churn_speedup_cache_vs_ppj": round(
+            dyc["agg_inst_per_sec"] / max(ppj["agg_inst_per_sec"],
+                                          1e-9), 2),
+        "serve_churn_speedup_cache_vs_static": round(
+            dyc["agg_inst_per_sec"]
+            / max(legs["static"]["agg_inst_per_sec"], 1e-9), 2),
+        "serve_churn_cache_takes_raw_wall_from_static":
+            dyc["wall_sec"] < legs["static"]["wall_sec"],
     }
 
 

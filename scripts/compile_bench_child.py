@@ -1,0 +1,124 @@
+"""One caching-immune measurement child for BENCH_COMPILE=1 (bench.py).
+
+Builds ONE engine scan program -- solo `update_scan` or the W-world
+`multiworld_scan` -- through the persistent AOT program cache
+(utils/compilecache.py) and prints a single JSON line with what the
+construction cost and where the program came from:
+
+    {"tag": ..., "chunk": ..., "worlds": ..., "construct_ms": ...,
+     "cache_hit": true|false, "compile_ms": ..., "load_ms": ...,
+     "store_ms": ..., "payload_bytes": ...}
+
+bench.py runs this twice per tag in FRESH subprocesses against one
+cache dir (the round-9 harness rule: microbenchmarks must be
+caching-immune, and process death is the only reliable jit-cache
+flush): the first child measures the fresh trace+compile (+ serialize/
+store), the second measures the deserialize path -- their ratio is the
+committed cache speedup.  TPU_COMPILE_CACHE_DIR points both at the
+shared store.
+
+Run standalone for a quick eyeball:
+    TPU_COMPILE_CACHE_DIR=/tmp/cc python scripts/compile_bench_child.py \
+        --tag update_scan --side 8 --mem 256 --chunk 8
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    args = dict(tag="update_scan", side=8, mem=256, chunk=8, worlds=8)
+    argv = sys.argv[1:]
+    i = 0
+    while i < len(argv):
+        a = argv[i].lstrip("-")
+        if a in args and i + 1 < len(argv):
+            args[a] = type(args[a])(argv[i + 1])
+            i += 2
+        else:
+            print(__doc__)
+            return 2
+
+    import jax
+    import jax.numpy as jnp
+
+    from avida_tpu.config import AvidaConfig
+    from avida_tpu.config.environment import default_logic9_environment
+    from avida_tpu.config.instset import default_instset
+    from avida_tpu.core.state import make_world_params, zeros_population
+    from avida_tpu.ops import birth as birth_ops
+    from avida_tpu.utils import compilecache
+
+    cfg = AvidaConfig()
+    cfg.WORLD_X = cfg.WORLD_Y = int(args["side"])
+    cfg.TPU_MAX_MEMORY = int(args["mem"])
+    p = make_world_params(cfg, default_instset(),
+                          default_logic9_environment())
+    # the state World itself would build (init_population's kwargs):
+    # systematics newborn ring included -- the measured program must be
+    # the PRODUCTION update program, not a stripped-down cousin
+    st = zeros_population(p.num_cells, p.max_memory, p.num_reactions,
+                          p.num_global_res, p.num_spatial_res,
+                          p.num_demes, smt=(p.hw_type in (1, 2)),
+                          num_registers=p.num_registers, nb_cap=p.nb_cap,
+                          n_deme_res=p.num_deme_res,
+                          max_threads=p.max_cpu_threads,
+                          trace_cap=p.trace_cap)
+    nb = jnp.asarray(birth_ops.neighbor_table(cfg.WORLD_X, cfg.WORLD_Y,
+                                              p.geometry))
+    key = jax.random.key(1)
+    chunk = int(args["chunk"])
+    if args["tag"] == "update_scan":
+        from avida_tpu.ops.update import update_scan
+        call = (update_scan, "update_scan",
+                (p, st, chunk, key, nb, jnp.int32(0)))
+        worlds = 1
+    elif args["tag"] == "multiworld_scan":
+        from avida_tpu.parallel.multiworld import multiworld_scan
+        worlds = int(args["worlds"])
+        bst = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (worlds,) + x.shape).copy()
+            if x is not None else None, st)
+        keys = jnp.stack([jax.random.key(7 + w) for w in range(worlds)])
+        call = (multiworld_scan, "multiworld_scan",
+                (p, bst, chunk, keys, nb, jnp.int32(0)))
+    else:
+        print(f"unknown --tag {args['tag']!r}")
+        return 2
+
+    jax.block_until_ready(jnp.zeros(()))        # backend init off the clock
+    t0 = time.monotonic()
+    out = compilecache.call(call[0], call[1], call[2])
+    jax.block_until_ready(jax.tree_util.tree_leaves(out)[0])
+    construct_ms = (time.monotonic() - t0) * 1000.0
+
+    c = compilecache.counters()
+    payload = 0
+    root = compilecache.cache_dir()
+    for path in compilecache.list_entries(root):
+        m = json.load(open(os.path.join(path, compilecache.MANIFEST)))
+        if m.get("tag") == call[1]:
+            payload = m["files"][compilecache.EXEC_FILE]["size"]
+    print(json.dumps({
+        "tag": call[1],
+        "chunk": chunk,
+        "worlds": worlds,
+        "construct_ms": round(construct_ms, 1),
+        "cache_hit": c["hits"] > 0,
+        "compile_ms": round(c["compile_ms"], 1),
+        "load_ms": round(c["load_ms"], 1),
+        "store_ms": round(c["store_ms"], 1),
+        "payload_bytes": payload,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,15 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Hermeticity: the persistent AOT program cache (utils/compilecache.py,
+# default-on in production) must not let one test's compiled programs --
+# or a stale ~/.cache store from an earlier build -- leak into another
+# test's run.  Kill it suite-wide via the env half of the hard kill
+# switch; the dedicated cache tests (tests/test_compile_cache.py) opt
+# back in with monkeypatch.setenv + a tmp_path cache root.
+os.environ["TPU_COMPILE_CACHE"] = os.environ.get(
+    "TPU_COMPILE_CACHE_FOR_TESTS", "0")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

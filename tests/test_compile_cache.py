@@ -1,0 +1,558 @@
+"""Persistent AOT program cache (utils/compilecache.py).
+
+Contract under test, layer by layer:
+
+  * entry store: atomic publish, CRC-manifest verification, prune and
+    the cache_tool CLI -- pure host, no compile;
+  * cache semantics on a cheap jitted scan: miss -> compile+store,
+    fresh-process load -> bit-identical outputs, and the THREE loud
+    fallbacks the issue names -- truncated entry, CRC-mismatched entry,
+    stale code-digest (and stale-jax-version) entry -- each recovering
+    with a fresh trace, the journaled `compile_cache` reason and an
+    overwritten (healed) entry;
+  * engine integration: a World trajectory is bit-exact across
+    {cache miss, cache load, cache off} on the XLA path (fast) and the
+    packed/Pallas(interpret) path (slow), with cache_load_count() as
+    the warm-process probe;
+  * the serve-child warm start: a second all-ghost ServeBatch of the
+    same class constructs every chunk program with ZERO new traces
+    (scan_trace_count flat, cache_load_count == program count) -- the
+    fleet-wide warmup paid once per (signature, width) (slow);
+  * the chaos drill that condemned JAX_COMPILATION_CACHE_DIR (PR-6
+    heap corruption): SIGKILL mid-run, supervised resume with the cache
+    ON -- the resumed boot loads serialized executables into donated
+    buffers -- stays bit-exact vs an uninterrupted cache-OFF reference
+    (slow).
+
+Cache tests opt back IN to the cache (tests/conftest.py kills it
+suite-wide for hermeticity) via monkeypatch + a tmp_path root.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from functools import partial
+
+import numpy as np
+import pytest
+
+from avida_tpu.utils import compilecache as cc
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+import cache_tool  # noqa: E402
+
+
+@pytest.fixture()
+def cache_root(tmp_path, monkeypatch):
+    """A fresh enabled cache rooted under tmp_path (env half of the
+    kill switch re-armed; conftest disables it suite-wide)."""
+    root = tmp_path / "cc"
+    monkeypatch.setenv("TPU_COMPILE_CACHE", "1")
+    monkeypatch.setenv("TPU_COMPILE_CACHE_DIR", str(root))
+    cc.reset_for_tests()
+    yield str(root)
+    cc.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# host-only: kill switch, dir resolution, entry store, cache_tool
+# ---------------------------------------------------------------------------
+
+class _Cfg(dict):
+    def get(self, name, default=None):
+        return super().get(name, default)
+
+
+def test_kill_switch_and_dir_resolution(monkeypatch, tmp_path):
+    monkeypatch.delenv("TPU_COMPILE_CACHE", raising=False)
+    monkeypatch.delenv("TPU_COMPILE_CACHE_DIR", raising=False)
+    assert cc.enabled() and cc.enabled(_Cfg())
+    # env kill beats an enabling config; config kill beats a silent env
+    monkeypatch.setenv("TPU_COMPILE_CACHE", "0")
+    assert not cc.enabled(_Cfg(TPU_COMPILE_CACHE=1))
+    monkeypatch.setenv("TPU_COMPILE_CACHE", "1")
+    assert not cc.enabled(_Cfg(TPU_COMPILE_CACHE=0))
+    assert cc.enabled(_Cfg(TPU_COMPILE_CACHE=1))
+    # dir: config beats env beats the per-user default
+    monkeypatch.setenv("TPU_COMPILE_CACHE_DIR", str(tmp_path / "env"))
+    assert cc.cache_dir(_Cfg(TPU_COMPILE_CACHE_DIR=str(tmp_path / "cfg"))) \
+        == str(tmp_path / "cfg")
+    assert cc.cache_dir(_Cfg(TPU_COMPILE_CACHE_DIR="-")) \
+        == str(tmp_path / "env")
+    monkeypatch.delenv("TPU_COMPILE_CACHE_DIR")
+    assert cc.cache_dir(None).endswith(os.path.join("avida_tpu", "compile"))
+
+
+def _fake_entry(root, key=None, payload=b"x" * 4096, meta=None):
+    return cc.write_entry(str(root), key or "k" * 40, payload,
+                          b"trees", dict({"tag": "update_scan",
+                                          "chunk": 2, "jax": "0",
+                                          "jaxlib": "0", "code": "c",
+                                          "avals": [[[36, 128], "int32"]]},
+                                         **(meta or {})))
+
+
+def test_entry_store_roundtrip_and_prune(tmp_path):
+    p1 = _fake_entry(tmp_path, key="a" * 40)
+    p2 = _fake_entry(tmp_path, key="b" * 40)
+    assert sorted(cc.list_entries(str(tmp_path))) == sorted([p1, p2])
+    m = cc.verify_entry(p1)
+    assert m["files"][cc.EXEC_FILE]["size"] == 4096
+    # same-key republish under an EQUIVALENT toolchain is a no-op (a
+    # sibling already published this program -- never yank a live entry
+    # out from under a concurrent reader) ...
+    _fake_entry(tmp_path, key="a" * 40, payload=b"y" * 8)
+    assert cc.verify_entry(p1)["files"][cc.EXEC_FILE]["size"] == 4096
+    # ... while a toolchain/code drift still replaces it atomically
+    # (the self-healing path), leaving no .tmp/.old debris
+    _fake_entry(tmp_path, key="a" * 40, payload=b"y" * 8,
+                meta={"code": "c2"})
+    assert cc.verify_entry(p1)["files"][cc.EXEC_FILE]["size"] == 8
+    assert not [d for d in os.listdir(tmp_path)
+                if d.startswith((".tmp-", ".old-"))]
+    assert cc.looks_like_cache_dir(str(tmp_path))
+    assert not cc.looks_like_cache_dir(str(tmp_path / ("a" * 40)))
+    # prune: keep newest 1, then drop all
+    removed = cc.prune(str(tmp_path), keep=1)
+    assert len(cc.list_entries(str(tmp_path))) == 1
+    assert removed
+    cc.prune(str(tmp_path), keep=0)
+    assert cc.list_entries(str(tmp_path)) == []
+
+
+def test_publish_janitor_spares_live_foreign_tmp(tmp_path):
+    """Sibling class children share one SPOOL/compile-cache: publishing
+    our entry must not rmtree another process's FRESH in-flight .tmp-
+    dir (it would turn that sibling's store into a journaled
+    store_failed and re-open its compile window); stale foreign debris
+    and our own pid's debris are swept."""
+    fresh = tmp_path / f".tmp-{'c' * 40}.99999"
+    os.makedirs(fresh)
+    (fresh / cc.EXEC_FILE).write_bytes(b"half-written")
+    stale = tmp_path / f".tmp-{'d' * 40}.99998"
+    os.makedirs(stale)
+    old = time.time() - 2 * cc._DEBRIS_MAX_AGE_SEC
+    os.utime(stale, (old, old))
+    mine = tmp_path / f".old-{'e' * 40}.{os.getpid()}"
+    os.makedirs(mine)
+    _fake_entry(tmp_path, key="a" * 40)
+    assert fresh.is_dir(), "live sibling tmp was destroyed"
+    assert not stale.exists() and not mine.exists()
+
+
+def test_entry_corruption_detected(tmp_path):
+    path = _fake_entry(tmp_path)
+    # truncation
+    with open(os.path.join(path, cc.EXEC_FILE), "r+b") as f:
+        f.truncate(10)
+    with pytest.raises(cc.CompileCacheError, match="truncated"):
+        cc.verify_entry(path)
+    # CRC flip at unchanged size
+    path = _fake_entry(tmp_path)
+    with open(os.path.join(path, cc.EXEC_FILE), "r+b") as f:
+        f.seek(100)
+        f.write(b"\xff")
+    with pytest.raises(cc.CompileCacheError, match="CRC mismatch"):
+        cc.verify_entry(path)
+    # torn manifest
+    path = _fake_entry(tmp_path)
+    with open(os.path.join(path, cc.MANIFEST), "w") as f:
+        f.write('{"format": "avi')
+    with pytest.raises(cc.CompileCacheError, match="torn"):
+        cc.verify_entry(path)
+    # foreign format
+    path = _fake_entry(tmp_path)
+    mp = os.path.join(path, cc.MANIFEST)
+    m = json.load(open(mp))
+    m["format"] = "something-else"
+    json.dump(m, open(mp, "w"))
+    with pytest.raises(cc.CompileCacheStale):
+        cc.verify_entry(path)
+
+
+def test_cache_tool_cli(tmp_path, capsys):
+    spool = tmp_path / "spool"
+    root = spool / "compile-cache"
+    _fake_entry(root, key="a" * 40)
+    _fake_entry(root, key="b" * 40)
+    assert cache_tool.main([str(root)]) == 0
+    out = capsys.readouterr().out
+    assert "2 entries" in out and "update_scan" in out and "chunk=2" in out
+    assert cache_tool.main([str(root), "--verify"]) == 0
+    assert "2/2 entries verify" in capsys.readouterr().out
+    # corrupt one -> verify fails loudly
+    with open(root / ("a" * 40) / cc.EXEC_FILE, "r+b") as f:
+        f.truncate(1)
+    assert cache_tool.main([str(root), "--verify"]) == 1
+    assert "CORRUPT" in capsys.readouterr().out
+    # spool-wide prune sweeps the cache dir inside the tree
+    assert cache_tool.main(["--prune", "--all", str(spool)]) == 0
+    assert cc.list_entries(str(root)) == []
+    # empty dir lists as such
+    assert cache_tool.main([str(tmp_path / "nope")]) == 1
+
+
+# ---------------------------------------------------------------------------
+# cache semantics on a cheap jitted scan (sub-second compiles)
+# ---------------------------------------------------------------------------
+
+def _toy():
+    """A miniature of the engine scans: static scale + chunk, donated
+    carry, scan body -- cheap enough to compile in well under a
+    second, so every fallback path is exercised without paying
+    update_scan's compile each time."""
+    import jax
+
+    @partial(jax.jit, static_argnums=(0, 2), donate_argnums=(1,))
+    def toy(scale, x, steps, y):
+        def body(c, _):
+            c = c * scale + y
+            return c, c.sum()
+        return jax.lax.scan(body, x, None, length=steps)
+    return toy
+
+
+def _toy_args():
+    import jax.numpy as jnp
+    x = jnp.arange(8, dtype=jnp.float32)
+    y = jnp.full((8,), 0.5, jnp.float32)
+    return (3, x, 4, y)
+
+
+def _call_toy(toy, events):
+    out, sums = cc.call(toy, "toy", _toy_args(), cfg=None,
+                        log=lambda **kw: events.append(kw))
+    return np.asarray(out), np.asarray(sums)
+
+
+def test_miss_store_load_bit_exact_and_counters(cache_root):
+    events = []
+    toy = _toy()
+    ref_out, ref_sums = _call_toy(toy, events)
+    assert cc.cache_miss_count() == 1 and cc.cache_load_count() == 0
+    assert [e["action"] for e in events] == ["compile", "store"]
+    assert cc.list_entries(cache_root)
+    # memo hit: no new counters, same bits
+    out, sums = _call_toy(toy, events)
+    assert cc.counters()["misses"] == 1 and cc.cache_load_count() == 0
+    np.testing.assert_array_equal(out, ref_out)
+    # simulated fresh process: the disk load path
+    cc.reset_for_tests()
+    events.clear()
+    out, sums = _call_toy(_toy(), events)
+    assert cc.cache_load_count() == 1 and cc.cache_miss_count() == 0
+    assert cc.counters()["compile_ms"] == 0.0
+    assert [e["action"] for e in events] == ["load"]
+    np.testing.assert_array_equal(out, ref_out)
+    np.testing.assert_array_equal(sums, ref_sums)
+    # prom families carry the activity
+    fams = dict((f[0], f[3]) for f in cc.prom_families())
+    assert fams["avida_compile_cache_hits_total"] == 1
+    assert fams["avida_compile_cache_misses_total"] == 0
+
+
+def _entry_of(cache_root):
+    entries = cc.list_entries(cache_root)
+    assert len(entries) == 1
+    return entries[0]
+
+
+def _corruption_case(cache_root, mutate, expect_action, expect_err):
+    """Populate -> mutate the entry -> fresh process -> the call falls
+    back to a fresh trace BIT-EXACTLY, journals the reason, and heals
+    the entry (the overwrite makes the next load clean)."""
+    events = []
+    ref_out, ref_sums = _call_toy(_toy(), events)
+    mutate(_entry_of(cache_root))
+    cc.reset_for_tests()
+    events.clear()
+    out, sums = _call_toy(_toy(), events)
+    np.testing.assert_array_equal(out, ref_out)
+    np.testing.assert_array_equal(sums, ref_sums)
+    assert cc.cache_error_count() == 1 and cc.cache_miss_count() == 1
+    acts = [e["action"] for e in events]
+    assert acts == [expect_action, "compile", "store"], acts
+    assert expect_err in events[0]["error"]
+    # healed: the very next fresh process loads cleanly
+    cc.reset_for_tests()
+    events.clear()
+    out, _ = _call_toy(_toy(), events)
+    assert [e["action"] for e in events] == ["load"]
+    np.testing.assert_array_equal(out, ref_out)
+
+
+def test_truncated_entry_falls_back(cache_root):
+    def mutate(path):
+        with open(os.path.join(path, cc.EXEC_FILE), "r+b") as f:
+            f.truncate(16)
+    _corruption_case(cache_root, mutate, "corrupt", "truncated")
+
+
+def test_crc_mismatch_falls_back(cache_root):
+    def mutate(path):
+        with open(os.path.join(path, cc.EXEC_FILE), "r+b") as f:
+            f.seek(32)
+            f.write(b"\x5a")
+    _corruption_case(cache_root, mutate, "corrupt", "CRC mismatch")
+
+
+def _edit_manifest(path, **fields):
+    mp = os.path.join(path, cc.MANIFEST)
+    with open(mp) as f:
+        m = json.load(f)
+    m.update(fields)
+    with open(mp, "w") as f:
+        json.dump(m, f)
+
+
+def test_stale_code_digest_falls_back(cache_root):
+    _corruption_case(cache_root,
+                     lambda p: _edit_manifest(p, code="deadbeef"),
+                     "stale", "code digest")
+
+
+def test_stale_jax_version_falls_back(cache_root):
+    _corruption_case(cache_root,
+                     lambda p: _edit_manifest(p, jax="9.9.9"),
+                     "stale", "jax version")
+
+
+def test_unwritable_root_still_runs(tmp_path, monkeypatch):
+    """A cache root blocked by a FILE: the store fails with a journaled
+    store_failed, the run proceeds on the freshly compiled program."""
+    blocked = tmp_path / "blocked"
+    blocked.write_text("not a dir")
+    monkeypatch.setenv("TPU_COMPILE_CACHE", "1")
+    monkeypatch.setenv("TPU_COMPILE_CACHE_DIR", str(blocked))
+    cc.reset_for_tests()
+    events = []
+    out, _ = _call_toy(_toy(), events)
+    acts = [e["action"] for e in events]
+    assert acts == ["compile", "store_failed"]
+    assert out.shape == (8,)
+    cc.reset_for_tests()
+
+
+def test_disabled_is_plain_jit_path(monkeypatch):
+    monkeypatch.setenv("TPU_COMPILE_CACHE", "0")
+    cc.reset_for_tests()
+    events = []
+    out, sums = _call_toy(_toy(), events)
+    assert events == [] and cc.counters()["misses"] == 0
+    assert out.shape == (8,)
+
+
+# ---------------------------------------------------------------------------
+# engine integration: World trajectories across miss / load / off
+# ---------------------------------------------------------------------------
+
+_WORLD_SETS = [("WORLD_X", 6), ("WORLD_Y", 6), ("TPU_MAX_MEMORY", 128),
+               ("RANDOM_SEED", 11), ("TPU_MAX_STRETCH", 2),
+               ("TPU_SYSTEMATICS", 0), ("TPU_CKPT_AUDIT", 0),
+               ("AVE_TIME_SLICE", 30), ("TPU_MAX_STEPS_PER_UPDATE", 30)]
+
+
+def _run_world(tmp_path, name, extra=()):
+    from avida_tpu.world import World
+    w = World(overrides=_WORLD_SETS + list(extra),
+              data_dir=str(tmp_path / name))
+    w.run(max_updates=4)
+    return {f: np.asarray(getattr(w.state, f)).copy()
+            for f in ("alive", "tape", "genome", "merit", "insts_executed")}
+
+
+def _assert_states(a, b):
+    for f in a:
+        np.testing.assert_array_equal(a[f], b[f], err_msg=f"field {f}")
+
+
+def test_world_bit_exact_miss_load_off_xla(cache_root, tmp_path,
+                                           monkeypatch):
+    """The engine-level contract on the XLA path: populate (miss),
+    reload in a simulated fresh process (cache_load_count probe: loaded
+    programs, zero fresh compiles), and the kill-switch path -- all
+    three trajectories bit-identical."""
+    miss = _run_world(tmp_path, "miss")
+    assert cc.cache_miss_count() >= 1 and cc.cache_load_count() == 0
+    cc.reset_for_tests()
+    load = _run_world(tmp_path, "load")
+    assert cc.cache_load_count() >= 1
+    assert cc.counters()["compile_ms"] == 0.0, \
+        "warm process paid a fresh compile"
+    _assert_states(miss, load)
+    monkeypatch.setenv("TPU_COMPILE_CACHE", "0")
+    cc.reset_for_tests()
+    off = _run_world(tmp_path, "off")
+    assert cc.counters() == {"hits": 0, "misses": 0, "errors": 0,
+                             "load_ms": 0.0, "compile_ms": 0.0,
+                             "store_ms": 0.0}
+    _assert_states(miss, off)
+
+
+@pytest.mark.slow
+def test_world_bit_exact_miss_load_packed_interpret(cache_root, tmp_path):
+    """The packed/Pallas(interpret) leg of the acceptance bar: the
+    deserialized executable of the packed-resident chunk program
+    computes the identical trajectory."""
+    from avida_tpu.ops import packed_chunk
+    from avida_tpu.world import World
+    extra = [("TPU_USE_PALLAS", 1)]
+    wprobe = World(overrides=_WORLD_SETS + extra,
+                   data_dir=str(tmp_path / "probe"))
+    wprobe.process_events()
+    assert packed_chunk.active(wprobe.params, wprobe.state), \
+        "config must take the packed-resident path for this leg"
+    miss = _run_world(tmp_path, "pmiss", extra=extra)
+    assert cc.cache_miss_count() >= 1
+    cc.reset_for_tests()
+    load = _run_world(tmp_path, "pload", extra=extra)
+    assert cc.cache_load_count() >= 1
+    assert cc.counters()["compile_ms"] == 0.0
+    _assert_states(miss, load)
+
+
+@pytest.mark.slow
+def test_serve_warmup_loads_zero_trace_programs(cache_root, tmp_path):
+    """The fleet-wide warmup satellite: child A of a (signature, W)
+    class compiles+stores its chunk programs; child B (fresh process,
+    same class -- simulated by resetting the process memo) constructs
+    every program with ZERO new multiworld_scan traces --
+    scan_trace_count() flat, cache_load_count() == program count."""
+    from avida_tpu.parallel.multiworld import ServeBatch, scan_trace_count
+    from avida_tpu.world import World
+
+    def factory_for(base):
+        def factory(entry):
+            ov = [(k, v) for k, v in _WORLD_SETS if k != "RANDOM_SEED"]
+            ov += [("RANDOM_SEED", int(entry["seed"]))]
+            return World(overrides=ov, data_dir=entry["data_dir"])
+        return factory
+
+    def warm(base) -> int:
+        ctl = tmp_path / base / "control.json"
+        os.makedirs(ctl.parent, exist_ok=True)
+        with open(ctl, "w") as f:
+            json.dump({"width": 2, "members": []}, f)
+        sb = ServeBatch(2, str(ctl), str(tmp_path / base / "root"),
+                        world_factory=factory_for(base))
+        sb._stack()
+        for k in (1, 2):
+            sb._scan(k)
+        sb._sync_worlds()
+        return 2
+
+    t0 = scan_trace_count()
+    n = warm("childA")
+    assert scan_trace_count() == t0 + n          # cold: every shape traced
+    assert cc.cache_miss_count() == n
+    cc.reset_for_tests()                         # "fresh process" B
+    t1 = scan_trace_count()
+    warm("childB")
+    assert scan_trace_count() == t1, "warm child traced a program"
+    assert cc.cache_load_count() == n
+    assert cc.cache_miss_count() == 0
+
+
+# ---------------------------------------------------------------------------
+# slow: the chaos drill -- SIGKILL + resume with the cache ON
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_sigkill_resume_with_cache_bit_exact(tmp_path):
+    """THE landmine drill: a supervised child is SIGKILLed past its last
+    auto-save and restarted with --resume; the restarted boot
+    deserializes the first boot's executables into donated buffers --
+    the exact access pattern that produced glibc heap corruption under
+    JAX_COMPILATION_CACHE_DIR (PR 6) -- and the final state is
+    byte-identical to an uninterrupted cache-OFF reference."""
+    from avida_tpu.service.supervisor import Supervisor, SupervisorConfig
+    from avida_tpu.utils import checkpoint as ckpt_mod
+
+    sets = [(k, str(v)) for k, v in _WORLD_SETS if k != "RANDOM_SEED"]
+    sets += [("TPU_CKPT_EVERY", "4"), ("TPU_CKPT_FINAL", "1")]
+
+    def argv(data, ck):
+        out = ["-s", "11", "-u", "10", "-d", data,
+               "-set", "TPU_CKPT_DIR", ck]
+        for n, v in sets:
+            out += ["-set", n, v]
+        return out
+
+    def env(cache_on):
+        e = dict(os.environ)
+        e["JAX_PLATFORMS"] = "cpu"
+        e.pop("JAX_COMPILATION_CACHE_DIR", None)
+        e["TPU_COMPILE_CACHE"] = "1" if cache_on else "0"
+        e["TPU_COMPILE_CACHE_DIR"] = str(tmp_path / "cc")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        e["PYTHONPATH"] = repo + (
+            os.pathsep + e["PYTHONPATH"] if e.get("PYTHONPATH") else "")
+        return e
+
+    def final_gen(ck):
+        gens = ckpt_mod.list_generations(ck)
+        assert gens, f"no generations under {ck}"
+        manifest, arrays, _ = ckpt_mod.read_generation(gens[-1])
+        return manifest, arrays
+
+    # uninterrupted reference, cache OFF (the pre-cache engine verbatim)
+    rdata, rck = str(tmp_path / "ref_d"), str(tmp_path / "ref_ck")
+    proc = subprocess.run(
+        [sys.executable, "-m", "avida_tpu"] + argv(rdata, rck),
+        env=env(False), capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rman, rarr = final_gen(rck)
+
+    # the drill: cache ON, SIGKILL at update 5 (past the update-4 save)
+    data, ck = str(tmp_path / "d"), str(tmp_path / "ck")
+    sup = Supervisor(argv(data, ck), fault_plan=["sigkill@update=5"],
+                     cfg=SupervisorConfig(watchdog_sec=300.0, poll_sec=0.25,
+                                          grace_sec=900.0, max_retries=6,
+                                          backoff_base=0.05,
+                                          backoff_cap=0.2,
+                                          healthy_sec=1e9, seed=3),
+                     env=env(True))
+    rc = sup.run()
+    assert rc == 0 and sup.boots == 2
+    log = open(os.path.join(data, "supervised.log")).read()
+    # boot 1 compiled + stored its chunk programs; boot 2 (the resumed
+    # boot -- the one feeding deserialized executables donated buffers)
+    # LOADED every program and traced none: after the resume marker
+    # there are loads and no compiles
+    assert log.count("action=store") >= 1
+    boot2 = log[log.rindex("checkpoint_restored"):]
+    assert "action=load" in boot2, "resumed boot did not hit the cache"
+    assert "action=compile" not in boot2, \
+        "resumed boot paid a fresh compile despite a warm cache"
+    man, arr = final_gen(ck)
+    assert man["update"] == rman["update"] == 10
+    assert set(arr) == set(rarr)
+    for name in sorted(arr):
+        np.testing.assert_array_equal(arr[name], rarr[name],
+                                      err_msg=f"array {name}")
+
+
+# ---------------------------------------------------------------------------
+# fleet wiring: the spool-level shared cache env
+# ---------------------------------------------------------------------------
+
+def test_fleet_child_env_injects_spool_cache(tmp_path):
+    """Every fleet child inherits TPU_COMPILE_CACHE_DIR=SPOOL/compile-cache
+    unless the operator or the spec routed it -- sibling class children
+    share one store (the cold-spawn satellite)."""
+    from avida_tpu.service.fleet import FleetOrchestrator
+    spool = str(tmp_path / "spool")
+    fo = FleetOrchestrator(spool, env={})
+    env = fo._child_env({})
+    assert env["TPU_COMPILE_CACHE_DIR"] \
+        == os.path.join(os.path.realpath(spool), "compile-cache")
+    # spec env wins
+    env = fo._child_env({"env": {"TPU_COMPILE_CACHE_DIR": "/elsewhere"}})
+    assert env["TPU_COMPILE_CACHE_DIR"] == "/elsewhere"
+    # operator base env wins too
+    fo2 = FleetOrchestrator(spool, env={"TPU_COMPILE_CACHE_DIR": "/op"})
+    assert fo2._child_env({})["TPU_COMPILE_CACHE_DIR"] == "/op"
