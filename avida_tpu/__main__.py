@@ -106,6 +106,8 @@ TPU-build extras (no reference equivalent):
 Failure-classified exit codes (consumed by the supervisor):
   65  a state-invariant audit violation escaped the run
   66  --resume found checkpoints but no valid generation
+  67  a scrub (shadow re-execution) caught silent data corruption
+      (StateDivergenceError; the integrity plane, TPU_SCRUB_EVERY)
 """
 
 from __future__ import annotations
@@ -119,11 +121,12 @@ import time
 def _worlds_main(args, overrides) -> int:
     """--worlds: the multi-world batched run (parallel/multiworld.py)."""
     from avida_tpu.parallel.multiworld import MultiWorld
-    from avida_tpu.service import EXIT_AUDIT, EXIT_CKPT
+    from avida_tpu.service import EXIT_AUDIT, EXIT_CKPT, EXIT_SDC
     from avida_tpu.utils.audit import StateInvariantError
     from avida_tpu.utils.checkpoint import (CheckpointError,
                                             CheckpointMismatchError,
                                             restore_candidates)
+    from avida_tpu.utils.integrity import StateDivergenceError
 
     spec = args.worlds
     try:
@@ -204,6 +207,12 @@ def _worlds_main(args, overrides) -> int:
     t0 = time.time()
     try:
         mw.run(max_updates=args.updates)
+    except StateDivergenceError as e:
+        # silent corruption caught by the integrity plane's scrub:
+        # classified exit so the supervisor quarantines the suspect
+        # generations and rolls back to a digest-verified one
+        print(f"[avida-tpu] {e}", file=sys.stderr)
+        return EXIT_SDC
     except StateInvariantError as e:
         print(f"[avida-tpu] {e}", file=sys.stderr)
         return EXIT_AUDIT
@@ -231,8 +240,9 @@ def _serve_main(args, overrides) -> int:
     import json
 
     from avida_tpu.parallel.multiworld import ServeBatch
-    from avida_tpu.service import EXIT_AUDIT
+    from avida_tpu.service import EXIT_AUDIT, EXIT_SDC
     from avida_tpu.utils.audit import StateInvariantError
+    from avida_tpu.utils.integrity import StateDivergenceError
 
     control = args.serve_worlds
     try:
@@ -256,6 +266,12 @@ def _serve_main(args, overrides) -> int:
     t0 = time.time()
     try:
         sb.serve()
+    except StateDivergenceError as e:
+        # batch-wide divergence (a GHOST slot changed): every tenant is
+        # suspect, so exit classified -- per-tenant corruption never
+        # lands here (the serve loop demotes the tenant alone)
+        print(f"[avida-tpu] {e}", file=sys.stderr)
+        return EXIT_SDC
     except StateInvariantError as e:
         print(f"[avida-tpu] {e}", file=sys.stderr)
         return EXIT_AUDIT
@@ -365,10 +381,11 @@ def main(argv=None):
         az.run_file(path)
         return 0
 
-    from avida_tpu.service import EXIT_AUDIT, EXIT_CKPT
+    from avida_tpu.service import EXIT_AUDIT, EXIT_CKPT, EXIT_SDC
     from avida_tpu.utils.audit import StateInvariantError
     from avida_tpu.utils.checkpoint import (CheckpointError,
                                             CheckpointMismatchError)
+    from avida_tpu.utils.integrity import StateDivergenceError
 
     if args.resume is not None:
         # restart-loop friendly: a preemptible job launches with ONE fixed
@@ -403,6 +420,12 @@ def main(argv=None):
     t0 = time.time()
     try:
         world.run(max_updates=args.updates)
+    except StateDivergenceError as e:
+        # silent corruption caught by the integrity plane's scrub: the
+        # classified exit carries the last-verified-update marker the
+        # supervisor's sdc rollback reads from this very line
+        print(f"[avida-tpu] {e}", file=sys.stderr)
+        return EXIT_SDC
     except StateInvariantError as e:
         # corruption caught by the auditor: exit with the classified
         # code so the supervisor rolls back instead of blindly retrying

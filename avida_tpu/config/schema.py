@@ -296,6 +296,25 @@ class AvidaConfig:
     # updates inside World.run (0 = only at checkpoint save/load).  A
     # violation raises StateInvariantError naming the broken invariant.
     TPU_AUDIT_EVERY: int = 0
+    # Silent-corruption integrity plane (ops/digest.py +
+    # utils/integrity.py; README "Integrity plane").  TPU_STATE_DIGEST=1
+    # computes an order-stable u32 tree digest of the full
+    # PopulationState at every update-chunk boundary -- into the
+    # checkpoint manifest (`state_digest`, re-verified by --resume /
+    # ckpt_tool --verify), the metrics.prom heartbeat
+    # (avida_state_digest) and DATA_DIR/integrity.jsonl.  Default 0:
+    # nothing is built or traced, zero cost; either way the update
+    # program itself is byte-identical (the digest is a SEPARATE jit,
+    # the audit_state isolation rule).
+    TPU_STATE_DIGEST: int = 0
+    # Sampled shadow re-execution (scrubbing): every K-th update chunk
+    # is re-executed from the retained pre-chunk state and the two
+    # digests compared -- on this deterministic engine any mismatch is
+    # silent data corruption (StateDivergenceError, child exit 67, the
+    # supervisor's `sdc` rollback).  K=1 is full lockstep redundancy
+    # (~2x chunk cost); larger K samples 1/K of chunks.  Default 0 =
+    # off.  Implies manifest digests at checkpoint saves.
+    TPU_SCRUB_EVERY: int = 0
     # Device-side flight recorder (observability/tracer.py): 1 = record
     # structured events (births/deaths, first task triggers, scheduler
     # stalls, state anomalies) into fixed-capacity ring buffers INSIDE

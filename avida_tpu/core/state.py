@@ -125,6 +125,12 @@ class WorldParams(struct.PyTreeNode):
     # update_step jaxpr is unchanged (same static-gate discipline as
     # trace_cap; chaos tests only, never set in production)
     fault_nan: tuple = struct.field(pytree_node=False, default=())
+    # `bitflip:` kind -- the modeled silent-data-corruption event:
+    # (leaf_name, cell, bit, update), () = off with the jaxpr unchanged.
+    # The flip stays finite/in-bounds (invisible to audit_state); only
+    # the integrity plane's shadow re-execution catches it, because the
+    # shadow replay strips this gate (World._shadow_params)
+    fault_bitflip: tuple = struct.field(pytree_node=False, default=())
     # intra-organism threads (cAvidaConfig.h:558-564)
     max_cpu_threads: int = struct.field(pytree_node=False, default=1)
     thread_slicing_method: int = struct.field(pytree_node=False, default=0)
@@ -242,6 +248,13 @@ def _fault_nan_param(cfg) -> tuple:
     configuration."""
     from avida_tpu.utils.faultinject import nan_param
     return nan_param(cfg)
+
+
+def _fault_bitflip_param(cfg) -> tuple:
+    """Static flag for the `bitflip:` TPU_FAULT kind (the in-bounds SDC
+    model).  () in every production configuration."""
+    from avida_tpu.utils.faultinject import bitflip_param
+    return bitflip_param(cfg)
 
 
 def make_world_params(cfg, instset, environment) -> WorldParams:
@@ -384,6 +397,7 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         if int(cfg.get("TPU_TRACE", 0)) else 0,
         trace_stall_util=float(cfg.get("TPU_TRACE_STALL_UTIL", 0.25)),
         fault_nan=_fault_nan_param(cfg),
+        fault_bitflip=_fault_bitflip_param(cfg),
         generation_inc_method=cfg.GENERATION_INC_METHOD,
         num_reactions=len(environment.reactions),
         task_logic_mask=tt(env_tables["task_logic_mask"]),

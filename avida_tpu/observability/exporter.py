@@ -28,7 +28,7 @@ import time
 
 import numpy as np
 
-from avida_tpu.utils import compilecache
+from avida_tpu.utils import compilecache, integrity
 
 METRICS_FILE = "metrics.prom"
 MULTIWORLD_METRICS_FILE = "multiworld.prom"
@@ -51,6 +51,12 @@ _HELP = {
                                "flight-recorder events by code name"),
     "avida_heartbeat_timestamp_seconds": ("gauge",
                                           "unix time of the last export"),
+    "avida_state_digest": ("gauge",
+                           "order-stable u32 state digest at the last "
+                           "digested chunk boundary (ops/digest.py)"),
+    "avida_state_digest_update": ("gauge",
+                                  "update the exported state digest "
+                                  "describes"),
 }
 
 
@@ -78,6 +84,13 @@ def render_metrics(world) -> str:
         "avida_preempted": int(bool(world.preempted or world._preempt)),
         "avida_heartbeat_timestamp_seconds": round(time.time(), 3),
     }
+    digest = getattr(world, "state_digest", None)
+    if digest is not None:
+        # integrity plane armed (ops/digest.py): the last resolved
+        # chunk-boundary digest + the update it describes.  Absent when
+        # digesting is off, so those files stay byte-compatible.
+        values["avida_state_digest"] = digest[1]
+        values["avida_state_digest_update"] = digest[0]
     trace = None
     if tracer is not None:
         trace = (int(tracer.events_total), int(tracer.dropped_total),
@@ -122,6 +135,7 @@ def _render(values: dict, trace) -> str:
              {f'code="{code}"': count
               for code, count in trace[2].items()}))
     families += compilecache.prom_families()
+    families += integrity.prom_families()
     return render_families(families)
 
 
@@ -192,6 +206,23 @@ def format_status(metrics: dict, now: float | None = None) -> str:
             f"ms), "
             f"{int(metrics.get('avida_compile_cache_errors_total', 0))} "
             f"fallbacks")
+    if "avida_state_digest" in metrics \
+            or "avida_integrity_scrubs_total" in metrics:
+        # integrity plane (ops/digest.py): the last boundary digest and
+        # the scrub tally -- a nonzero mismatch count here means the
+        # run ALREADY hit silent corruption and was rolled back
+        parts = []
+        if "avida_state_digest" in metrics:
+            parts.append(
+                f"digest {int(metrics['avida_state_digest']) & 0xFFFFFFFF:#010x}"
+                f" @u{int(metrics.get('avida_state_digest_update', 0))}")
+        parts.append(
+            f"{int(metrics.get('avida_integrity_scrubs_total', 0))} "
+            f"scrubs")
+        parts.append(
+            f"{int(metrics.get('avida_integrity_mismatches_total', 0))} "
+            f"mismatches")
+        lines.append("integrity   " + ", ".join(parts))
     if metrics.get("avida_preempted"):
         lines.append("preempted   yes (resume with --resume)")
     return "\n".join(lines)
@@ -351,6 +382,9 @@ class MetricsExporter:
             "trace": ((int(tracer.events_total), int(tracer.dropped_total),
                        dict(tracer.code_totals))
                       if tracer is not None else None),
+            # last RESOLVED digest (the integrity plane's own one-chunk
+            # deferral): already a host value, no readback here
+            "digest": getattr(w, "state_digest", None),
         }
 
     @staticmethod
@@ -368,6 +402,9 @@ class MetricsExporter:
             "avida_preempted": snap["preempted"],
             "avida_heartbeat_timestamp_seconds": round(time.time(), 3),
         }
+        if snap.get("digest") is not None:
+            values["avida_state_digest"] = snap["digest"][1]
+            values["avida_state_digest_update"] = snap["digest"][0]
         return _render(values, snap["trace"])
 
 
@@ -437,6 +474,9 @@ class MultiWorldExporter:
             "trips": getattr(mw, "_trips", None),
             "leader_trips": getattr(mw, "_leader_trips", None),
             "trips_updates": int(getattr(mw, "_trips_updates", 0)),
+            # (update, [W] values) -- already host-resolved by the
+            # integrity plane's own deferral; None when digesting is off
+            "digests": getattr(mw, "state_digests", None),
         }
 
     def _publish(self, snap: dict, durable: bool):
@@ -479,6 +519,14 @@ class MultiWorldExporter:
                        for n, v in zip(snap["names"], per[name])})
                      for name in self._PER_WORLD]
             fams += self._occupancy_families(snap)
+            if snap.get("digests") is not None:
+                du, dvals = snap["digests"]
+                fams.append(
+                    ("avida_state_digest", *_HELP["avida_state_digest"],
+                     {f'world="{n}"': v
+                      for n, v in zip(snap["names"], dvals)}))
+                fams.append(("avida_state_digest_update",
+                             *_HELP["avida_state_digest_update"], du))
             fams.append(("avida_heartbeat_timestamp_seconds",
                          *_HELP["avida_heartbeat_timestamp_seconds"],
                          round(time.time(), 3)))
@@ -606,7 +654,7 @@ class ServeExporter:
              "multiworld_scan program variants traced by this process "
              "(flat after warmup = the compile cache is doing its job)",
              scan_trace_count()),
-        ] + compilecache.prom_families()
+        ] + compilecache.prom_families() + integrity.prom_families()
         per_fams = [(name, *_HELP[name],
                      {f'world="{n}"': r[name] for n, r in rows.items()})
                     for name in self._PER_WORLD if rows]

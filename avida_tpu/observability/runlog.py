@@ -100,7 +100,8 @@ def trim_update_records(path: str, max_update: int):
         os.replace(tmp, path)
 
 
-def append_record(path: str, rec: dict, max_bytes: int | None = None):
+def append_record(path: str, rec: dict, max_bytes: int | None = None,
+                  durable: bool = True):
     """Crash-safe single-record append for OUT-OF-PROCESS writers (the
     run supervisor's {"record": "supervisor"} events, the fleet
     orchestrator's {"record": "fleet"} journal): open, append one line,
@@ -128,7 +129,12 @@ def append_record(path: str, rec: dict, max_bytes: int | None = None):
     with open(path, "a") as f:
         f.write(line)
         f.flush()
-        os.fsync(f.fileno())
+        if durable:
+            # durable=False is the hot-loop flavor (the integrity
+            # plane's per-chunk digest records): skip the per-record
+            # fsync -- a crash can only tear the final line, which
+            # every runlog reader already tolerates
+            os.fsync(f.fileno())
 
 
 def read_records(path: str) -> list:

@@ -99,6 +99,12 @@ class StagedUpdate:
         if self.fault:
             from avida_tpu.utils.faultinject import nan_phase
             self._fault = jax.jit(lambda st, u: nan_phase(params, st, u))
+        # ... and the in-bounds SDC model (`bitflip:` kind), same rule
+        self.fault_flip = bool(getattr(params, "fault_bitflip", ()))
+        if self.fault_flip:
+            from avida_tpu.utils.faultinject import bitflip_phase
+            self._fault_flip = jax.jit(
+                lambda st, u: bitflip_phase(params, st, u))
         self._bank = jax.jit(
             lambda st, budgets, e0: bank_phase(params, st, budgets, e0))
         self._birth = jax.jit(
@@ -136,6 +142,8 @@ class StagedUpdate:
                     update_no)
         if self.fault:
             st = tl.run("fault", self._fault, st, update_no)
+        if self.fault_flip:
+            st = tl.run("fault", self._fault_flip, st, update_no)
         if self.trace:
             st = tl.run("trace", self._trace_post, st, tsnap, update_no)
         return st, executed, dispatch, granted, alive_before

@@ -1,0 +1,110 @@
+"""Device-side state digest: the jitted half of the integrity plane.
+
+`state_digest(st)` reduces an entire PopulationState to ONE u32 on
+device -- an order-stable tree digest (position-salted u32 mix-and-fold
+per leaf, sorted-name combine across leaves) that agrees bit-for-bit
+with the numpy reference in utils/integrity.py.  World.run computes it
+at update-chunk boundaries when TPU_STATE_DIGEST / TPU_SCRUB_EVERY are
+armed; the value lands in the checkpoint manifest (`state_digest`), the
+metrics.prom heartbeat (`avida_state_digest`) and a per-chunk
+{"record": "integrity"} runlog line, and the sampled shadow
+re-execution (scrubbing) compares live vs replayed digests to catch
+silent data corruption (README "Integrity plane").
+
+Isolation rule (the audit_state precedent, utils/audit.py): this is a
+SEPARATE jit from ops/update.update_step.  With the integrity plane off
+nothing here is ever traced, and with it on the update program itself is
+still byte-identical -- scripts/check_jaxpr.py's digest is unchanged
+either way (gated in tests/test_integrity.py).  The digest program
+donates nothing: digesting a state leaves it usable.
+
+Why the digest can be trusted across engines: the XLA, per-update
+Pallas and packed-resident paths produce bit-identical states (the
+repo's standing equivalence proofs), and the digest is a pure function
+of state bytes -- so one digest spelling serves every path, and a
+mismatch between a live chunk and its deterministic replay is evidence
+of corruption, never of engine choice.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from avida_tpu.core.state import state_field_names
+from avida_tpu.utils.integrity import (C_FOLD, C_IDX, C_MIX, FNV_OFFSET,
+                                       FNV_PRIME, name_salt)
+
+
+def _leaf_words(x: jax.Array) -> jax.Array:
+    """u32 word stream of one leaf -- the traced mirror of
+    utils/integrity.leaf_words (bools as 0/1, one-byte dtypes
+    zero-extended, four-byte dtypes bit-cast; row-major order)."""
+    if x.dtype == jnp.bool_:
+        return x.astype(jnp.uint32).reshape(-1)
+    if x.dtype.itemsize == 1:
+        return jax.lax.bitcast_convert_type(
+            x, jnp.uint8).astype(jnp.uint32).reshape(-1)
+    if x.dtype.itemsize == 4:
+        return jax.lax.bitcast_convert_type(x, jnp.uint32).reshape(-1)
+    raise ValueError(
+        f"state digest supports 1- and 4-byte leaves only (got {x.dtype})")
+
+
+def _fold_words(w: jax.Array) -> jax.Array:
+    """u32[n] -> u32 scalar; mirror of utils/integrity.fold_words."""
+    n = w.shape[0]
+    if n:
+        idx = jax.lax.iota(jnp.uint32, n)
+        h = (w ^ (idx * jnp.uint32(C_IDX))) * jnp.uint32(C_MIX)
+        h = h ^ (h >> jnp.uint32(15))
+        x = jax.lax.reduce(h, jnp.uint32(0),
+                           lambda a, b: jax.lax.bitwise_xor(a, b), (0,))
+    else:
+        x = jnp.uint32(0)
+    d = (x ^ jnp.uint32((n * C_IDX) & 0xFFFFFFFF)) * jnp.uint32(C_FOLD)
+    return d ^ (d >> jnp.uint32(13))
+
+
+def _digest_impl(st) -> jax.Array:
+    """The full tree digest (u32 scalar).  Leaves fold in SORTED field
+    name order with a per-name crc32 salt -- the combine arithmetic is
+    traced on scalars, the salts are trace-time constants, so the
+    compiled program is a handful of fused reduces over the state."""
+    d = jnp.uint32(FNV_OFFSET)
+    for name in sorted(state_field_names()):
+        leaf = getattr(st, name)
+        if leaf is None:
+            continue        # disabled flight-recorder ring: no on-disk
+            #                 representation either, so host agrees
+        ld = _fold_words(_leaf_words(leaf))
+        d = (d ^ (ld ^ jnp.uint32(name_salt(name)))) * jnp.uint32(FNV_PRIME)
+        d = d ^ (d >> jnp.uint32(17))
+    return d
+
+
+_jit_solo = None
+_jit_batched = None
+
+
+def state_digest(st) -> jax.Array:
+    """u32 device scalar digest of one PopulationState (separate jit;
+    nothing donated).  `int(...)` on the result is the host readback --
+    defer it one chunk on the hot path (the exporter deferral pattern)
+    so digesting never fences the dispatch pipeline."""
+    global _jit_solo
+    if _jit_solo is None:
+        _jit_solo = jax.jit(_digest_impl)
+    return _jit_solo(st)
+
+
+def state_digest_batched(bst) -> jax.Array:
+    """u32[W] per-world digests of a world-stacked batch state (the
+    MultiWorld/ServeBatch flavor): vmap of the solo digest, so batch
+    member w's digest equals the digest its solo run would compute on
+    the identical state -- the cross-driver comparison the serve
+    rollback relies on."""
+    global _jit_batched
+    if _jit_batched is None:
+        _jit_batched = jax.jit(jax.vmap(_digest_impl))
+    return _jit_batched(bst)

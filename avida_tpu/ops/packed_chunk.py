@@ -100,8 +100,9 @@ def ineligible_reason(params, nb_ring: bool = False) -> str | None:
     if params.num_global_res or params.num_spatial_res \
             or params.num_deme_res:
         return "resource pools (resource_phase reads canonical planes)"
-    if getattr(params, "fault_nan", ()):
-        return "device-side fault injection armed (TPU_FAULT nan:)"
+    if getattr(params, "fault_nan", ()) \
+            or getattr(params, "fault_bitflip", ()):
+        return "device-side fault injection armed (TPU_FAULT nan:/bitflip:)"
     if nb_ring:
         return ("systematics newborn ring in use (TPU_SYSTEMATICS=1; "
                 "newborn-record gathers stay canonical)")

@@ -431,6 +431,14 @@ def update_step(params, st, key, neighbors, update_no):
         from avida_tpu.utils.faultinject import nan_phase
         st = nan_phase(params, st, update_no)
 
+    if getattr(params, "fault_bitflip", ()):
+        # the modeled SDC event (utils/faultinject.py `bitflip:` kind):
+        # an in-bounds single-bit flip no auditor can see -- same static
+        # gate discipline; the integrity plane's shadow replay runs with
+        # this gate stripped, so scrubbing detects the divergence
+        from avida_tpu.utils.faultinject import bitflip_phase
+        st = bitflip_phase(params, st, update_no)
+
     if params.trace_cap:
         st = trace_post_phase(params, st, tsnap, update_no)
 
@@ -671,6 +679,11 @@ def _batched_update_step(params, bst, keys, neighbors, update_no):
         from avida_tpu.utils.faultinject import nan_phase
         bst = jax.vmap(
             lambda st, un: nan_phase(params, st, un))(bst, update_no)
+
+    if getattr(params, "fault_bitflip", ()):
+        from avida_tpu.utils.faultinject import bitflip_phase
+        bst = jax.vmap(
+            lambda st, un: bitflip_phase(params, st, un))(bst, update_no)
 
     if params.trace_cap:
         bst = jax.vmap(

@@ -215,6 +215,18 @@ class MultiWorld:
         #                                set (and runlog-reported) by run()
         self._boundary_hook = None     # test seam (chaos drills): called
         #                                after every chunk boundary
+        # silent-corruption integrity plane (ops/digest.py; the solo
+        # World knobs, batched: per-world [W] digests at every chunk
+        # boundary, sampled whole-batch shadow re-execution).  Batch
+        # members cannot arm fault injection (refused above), so the
+        # shadow replay runs the identical compiled program.
+        from avida_tpu.utils import integrity as _integrity
+        self._digest_on = _integrity.digest_enabled(self.cfg)
+        self._scrub_every = _integrity.scrub_every(self.cfg)
+        self._chunk_no = 0
+        self._digest_pending = None    # (update, device u32[W]) deferred
+        self.state_digests = None      # (update, [W] values) last resolved
+        self._last_verified_update = self.update
         self.names = [f"w{k:03d}" for k in range(len(self.worlds))]
         self.exporter = None
         if int(self.cfg.get("TPU_METRICS", 0)):
@@ -352,6 +364,13 @@ class MultiWorld:
         straggler-lag gauges: trips[w, u] is world w's OWN trip count
         at update u, while the batch ran max over worlds."""
         from avida_tpu.utils import compilecache
+        pre = None
+        if self._scrub_every > 0:
+            self._chunk_no += 1
+            if self._chunk_no % self._scrub_every == 0:
+                # pre-chunk copies: multiworld_scan donates the batched
+                # buffers, so live and shadow each consume their own
+                pre = (jax.tree.map(jnp.copy, self.bstate), self.update)
         self.bstate, (executed, births, deaths, dts, ave_gens, n_alive,
                       trips) = \
             compilecache.call(
@@ -376,6 +395,95 @@ class MultiWorld:
         self.update += k
         for w in self.worlds:
             w.update = self.update
+        if self._digest_on or pre is not None:
+            self._integrity_boundary(k, pre)
+
+    # ---- silent-corruption integrity plane (batched flavor) ----
+
+    def _engine_label(self) -> str:
+        from avida_tpu.ops.update import use_pallas_path
+        if not use_pallas_path(self.params):
+            return "xla-fold"
+        return ("pallas-packed-stacked" if self.engine == "packed-stacked"
+                else "pallas-stacked")
+
+    def _resolve_digests(self, pending):
+        import time as _time
+        from avida_tpu.utils import integrity
+        u, dev = pending
+        t0 = _time.monotonic()
+        vals = [int(x) for x in np.asarray(dev)]
+        integrity.note_digest_ms((_time.monotonic() - t0) * 1e3)
+        self.state_digests = (u, vals)
+        integrity.append_integrity_record(
+            self.data_dir, "digest", update=u,
+            digests={n: f"{v:#010x}"
+                     for n, v in zip(self.names, vals)})
+
+    def _flush_digest(self):
+        prev, self._digest_pending = self._digest_pending, None
+        if prev is not None:
+            self._resolve_digests(prev)
+
+    def _integrity_boundary(self, k: int, pre):
+        """The solo World._integrity_boundary, vectorized: one batched
+        digest ([W] per-world values -- each equals the digest its solo
+        run would compute, by the bit-exactness contract), and when the
+        chunk was sampled a whole-batch shadow replay whose mismatching
+        worlds are NAMED in the raised error."""
+        import time as _time
+
+        from avida_tpu.ops.digest import state_digest_batched
+        from avida_tpu.utils import integrity
+        u1 = self.update
+        t0 = _time.monotonic()
+        d_live = state_digest_batched(self.bstate)
+        integrity.note_digest_ms((_time.monotonic() - t0) * 1e3)
+        self._flush_digest()
+        if pre is None:
+            self._digest_pending = (u1, d_live)
+            return
+        from avida_tpu.utils import compilecache
+        pre_b, u0 = pre
+        integrity.note_scrub()
+        shadow_b, _outs = compilecache.call(
+            multiworld_scan, "multiworld_scan",
+            (self.params, pre_b, k, self._run_keys,
+             self.neighbors, jnp.int32(u0)),
+            cfg=self.cfg, log=self._compile_cache_log)
+        t0 = _time.monotonic()
+        d_shadow = state_digest_batched(shadow_b)
+        live = np.asarray(d_live)
+        shad = np.asarray(d_shadow)
+        integrity.note_digest_ms((_time.monotonic() - t0) * 1e3)
+        bad = [self.names[i] for i in range(len(self.worlds))
+               if int(live[i]) != int(shad[i])]
+        if bad:
+            integrity.note_mismatch()
+            engine = self._engine_label()
+            integrity.append_integrity_record(
+                self.data_dir, "scrub", update=u1, chunk_updates=k,
+                ok=False, worlds=bad, engine=engine,
+                last_verified_update=self._last_verified_update)
+            from avida_tpu.observability.runlog import emit_event
+            from avida_tpu.utils.integrity import StateDivergenceError
+            emit_event(self.worlds[0], "state_divergence", update=u1,
+                       worlds=",".join(bad))
+            raise StateDivergenceError(
+                f"silent state divergence in updates [{u0}, {u1}) of "
+                f"world(s) {', '.join(bad)}: live digests != shadow "
+                f"replay (engine {engine}, "
+                f"last_verified_update={self._last_verified_update})")
+        self._last_verified_update = u1
+        vals = [int(x) for x in live]
+        if self._digest_on:
+            self.state_digests = (u1, vals)
+            integrity.append_integrity_record(
+                self.data_dir, "digest", update=u1,
+                digests={n: f"{v:#010x}"
+                         for n, v in zip(self.names, vals)})
+        integrity.append_integrity_record(
+            self.data_dir, "scrub", update=u1, chunk_updates=k, ok=True)
 
     def _compile_cache_log(self, **fields):
         """compile_cache journal shim for the batch's cached program
@@ -512,6 +620,10 @@ class MultiWorld:
                 last_err = e
                 continue
             self.update = u
+            # every member's restored generation passed the manifest
+            # digest check -- the scrub verification horizon restarts
+            # here (the solo World.resume rule)
+            self._last_verified_update = u
             return u
         raise last_err or ckpt_mod.CheckpointError("batch resume failed")
 
@@ -603,6 +715,7 @@ class MultiWorld:
                 if self._boundary_hook is not None:
                     self._boundary_hook(self)
             self._sync_worlds()
+            self._flush_digest()
             self.preempted = self._preempt
             for w in self.worlds:
                 w._preempt = self._preempt
@@ -780,6 +893,19 @@ class ServeBatch:
         self._boundary_hook = None      # test seam: after each
         #                                 checkpoint-boundary reconcile
         self._sysm_on = bool(int(self.cfg.get("TPU_SYSTEMATICS", 1)))
+        # silent-corruption integrity plane, serve flavor: per-world
+        # digests + sampled whole-batch shadow replay, but a mismatching
+        # TENANT is demoted ALONE (suspect generations quarantined, slot
+        # back to ghost, outcome "sdc" for the pool to requeue) while
+        # classmates keep serving -- only a diverging GHOST slot (which
+        # runs a zero-trip identity and cannot legitimately change)
+        # escalates to a batch-wide StateDivergenceError
+        from avida_tpu.utils import integrity as _integrity
+        self._digest_on = _integrity.digest_enabled(self.cfg)
+        self._scrub_every = _integrity.scrub_every(self.cfg)
+        self._chunk_no = 0
+        self._verified = [0] * self.width   # per-slot verified horizon
+        self.state_digests = None           # (boundary, {name: value})
         # the batchability-class signature the pool stamped into the
         # control file (absent on hand-written controls): stored into
         # compile-cache entry manifests so cache_tool can attribute an
@@ -894,6 +1020,10 @@ class ServeBatch:
         self.slots[i] = w
         self.names[i] = name
         self.max_updates[i] = cap
+        # the admitted state is digest-verified (resume re-checks the
+        # manifest digest) or freshly injected -- either way the scrub
+        # verification horizon for this slot starts here
+        self._verified[i] = w.update
         self.finished.pop(name, None)
         self.admissions += 1
         self._log(f"admit {name} -> slot {i} at update {w.update}"
@@ -960,6 +1090,7 @@ class ServeBatch:
         self.slots[i] = None
         self.names[i] = None
         self.max_updates[i] = None
+        self._verified[i] = 0
         self.retirements += 1
         self._log(f"retire {name} ({state}) at update {w.update}")
 
@@ -1045,6 +1176,11 @@ class ServeBatch:
         u0 = jnp.asarray([0 if w is None else w.update
                           for w in self.slots], jnp.int32)
         from avida_tpu.utils import compilecache
+        pre = None
+        if self._scrub_every > 0:
+            self._chunk_no += 1
+            if self._chunk_no % self._scrub_every == 0:
+                pre = (jax.tree.map(jnp.copy, self.bstate), u0)
         self.bstate, (executed, births, deaths, dts, ave_gens, n_alive,
                       trips) = \
             compilecache.call(
@@ -1065,6 +1201,10 @@ class ServeBatch:
         for i, w in self._live():
             w._pending_exec.append(executed[i])
             w.update += k
+        if self._digest_on or pre is not None:
+            # BEFORE the newborn drain: the shadow replay reproduces the
+            # raw post-scan state (the drain zeroes nb_count afterwards)
+            self._integrity_boundary(k, pre)
         if self._sysm_on:
             self._drain_newborns(k)
 
@@ -1087,6 +1227,102 @@ class ServeBatch:
             w._feed_systematics(snap)
         self.bstate = self.bstate.replace(
             nb_count=jnp.zeros((self.width,), jnp.int32))
+
+    # ---- silent-corruption integrity plane (serve flavor) ----
+
+    def _integrity_boundary(self, k: int, pre):
+        """Per-chunk digests + sampled shadow replay for a serving
+        batch.  The synchronous flavor (the serve loop syncs at every
+        checkpoint boundary anyway): digests resolve immediately into
+        serve.json/state_digests.  A mismatching live tenant rolls back
+        ALONE -- suspect generations (saved past its verified horizon)
+        quarantined, slot freed to ghost, outcome "sdc" in `finished`
+        for the pool to journal + requeue -- while classmates keep
+        serving.  A mismatching GHOST slot means the batch itself (or
+        the engine) corrupted: batch-wide StateDivergenceError, child
+        exit 67."""
+        import time as _time
+
+        from avida_tpu.ops.digest import state_digest_batched
+        from avida_tpu.utils import integrity
+        from avida_tpu.utils.integrity import StateDivergenceError
+        t0 = _time.monotonic()
+        d_live = state_digest_batched(self.bstate)
+        if pre is None:
+            vals = [int(x) for x in np.asarray(d_live)]
+            integrity.note_digest_ms((_time.monotonic() - t0) * 1e3)
+            self._record_digests(vals)
+            return
+        live = np.asarray(d_live)
+        integrity.note_digest_ms((_time.monotonic() - t0) * 1e3)
+        from avida_tpu.utils import compilecache
+        pre_b, u0 = pre
+        integrity.note_scrub()
+        shadow_b, _outs = compilecache.call(
+            multiworld_scan, "multiworld_scan",
+            (self.params, pre_b, k, self._run_keys,
+             self.neighbors, u0),
+            cfg=self.cfg, log=self._compile_cache_log,
+            sig=self._serve_sig)
+        t0 = _time.monotonic()
+        shad = np.asarray(state_digest_batched(shadow_b))
+        integrity.note_digest_ms((_time.monotonic() - t0) * 1e3)
+        bad = [i for i in range(self.width)
+               if int(live[i]) != int(shad[i])]
+        if not bad:
+            for i, w in self._live():
+                self._verified[i] = w.update
+            self._record_digests([int(x) for x in live])
+            integrity.append_integrity_record(
+                self.data_dir, "scrub", boundary=self.boundaries,
+                chunk_updates=k, ok=True)
+            return
+        ghosts_bad = [i for i in bad if self.slots[i] is None]
+        if ghosts_bad:
+            integrity.note_mismatch()
+            raise StateDivergenceError(
+                f"silent state divergence in GHOST slot(s) {ghosts_bad} "
+                f"of a serving batch -- a zero-trip identity changed, "
+                f"the whole batch is suspect (width {self.width}, "
+                f"last chunk {k} updates)")
+        self._sync_worlds()
+        for i in bad:
+            w = self.slots[i]
+            name = self.names[i]
+            integrity.note_mismatch()
+            quarantined = []
+            if w._ckpt_base():
+                from avida_tpu.utils.checkpoint import quarantine_after
+                quarantined = quarantine_after(w._ckpt_base(),
+                                               self._verified[i])
+            integrity.append_integrity_record(
+                self.data_dir, "scrub", ok=False, world=name,
+                update=int(w.update), chunk_updates=k,
+                last_verified_update=self._verified[i],
+                quarantined=len(quarantined))
+            self._log(
+                f"SDC: {name} diverged from its shadow replay in its "
+                f"updates [{int(w.update) - k}, {int(w.update)}); "
+                f"quarantined {len(quarantined)} suspect generation(s) "
+                f"past update {self._verified[i]}; demoting -- "
+                f"classmates keep serving")
+            verified = self._verified[i]
+            self._retire(i, "sdc", save=False)
+            self.finished[name]["last_verified_update"] = verified
+            self.finished[name]["quarantined"] = len(quarantined)
+        for i, w in self._live():
+            self._verified[i] = w.update
+        self._stack()
+
+    def _record_digests(self, vals: list):
+        from avida_tpu.utils import integrity
+        named = {self.names[i]: f"{vals[i]:#010x}"
+                 for i, _ in self._live()}
+        self.state_digests = (self.boundaries, vals)
+        if self._digest_on and named:
+            integrity.append_integrity_record(
+                self.data_dir, "digest", boundary=self.boundaries,
+                digests=named)
 
     # ---- status + metrics ----
 

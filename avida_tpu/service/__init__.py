@@ -16,6 +16,8 @@ classify failures without parsing tracebacks):
 # exit 1 and from signal deaths (negative returncodes)
 EXIT_AUDIT = 65      # StateInvariantError escaped World.run (EX_DATAERR)
 EXIT_CKPT = 66       # no valid checkpoint generation on resume (EX_NOINPUT)
+EXIT_SDC = 67        # StateDivergenceError: a scrub (shadow replay)
+#                      caught silent data corruption (utils/integrity.py)
 
 FAILURE_CLASSES = ("crash", "hang", "audit_violation", "corrupt_ckpt",
-                   "preempt")
+                   "sdc", "preempt")

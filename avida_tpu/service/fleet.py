@@ -1164,6 +1164,17 @@ class FleetOrchestrator:
                 if self.breaker.note_failure(cls, now):
                     self._open_breaker(cls, job)
 
+    def note_external_failure(self, cls: str, job: Job):
+        """Count one classified failure detected OUTSIDE a job
+        supervisor -- a serve child's in-process `sdc` demotion
+        (parallel/multiworld.ServeBatch) reports through its status
+        file, not an exit code -- into the fleet aggregates and the
+        circuit breaker, so an SDC storm (a sick device corrupting one
+        tenant after another) pauses admissions like any crash storm."""
+        self.failures[cls] = self.failures.get(cls, 0) + 1
+        if self.breaker.note_failure(cls, self._clock()):
+            self._open_breaker(cls, job)
+
     def _open_breaker(self, cls: str, job: Job):
         self.journal("breaker_open", failure_class=cls,
                      k=self.breaker.k,
@@ -1483,6 +1494,17 @@ def format_fleet_status(spool: str, now: float | None = None) -> str:
                 f" load "
                 f"{runm.get('avida_compile_cache_load_ms_total', 0.0):.0f}"
                 f"ms")
+        if runm is not None and (
+                "avida_integrity_scrubs_total" in runm
+                or "avida_state_digest" in runm):
+            # integrity-plane column (utils/integrity.py families in
+            # the child's heartbeat): scrubs / detected mismatches --
+            # a nonzero second number means this job has already been
+            # rolled back past silent corruption at least once
+            extra += (
+                "  integrity "
+                f"{int(runm.get('avida_integrity_scrubs_total', 0))}s/"
+                f"{int(runm.get('avida_integrity_mismatches_total', 0))}x")
         ana_prom = os.path.join(spool, name, "data", "analytics.prom")
         if os.path.exists(ana_prom):
             # per-tenant census column (analyze/pipeline.py live mode):

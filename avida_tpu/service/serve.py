@@ -63,6 +63,10 @@ NONSTATIC_VARS = frozenset((
     # program's semantics nor the trajectory (utils/compilecache.py) --
     # cache knobs must not split a batchability class
     "TPU_COMPILE_CACHE", "TPU_COMPILE_CACHE_DIR",
+    # the integrity plane (digests + sampled shadow replay) is host-side
+    # batch-level instrumentation: trajectories are bit-identical with
+    # it on or off, so its knobs must not split a class either
+    "TPU_STATE_DIGEST", "TPU_SCRUB_EVERY",
 ))
 
 # spec env vars that are per-job operational knobs, not program inputs
@@ -616,6 +620,34 @@ class ServePool:
                 fleet.journal("done", job=mname,
                               update=rec.get("update"),
                               serve_leader=cls.leader.name)
+                try:
+                    cls.write_control()   # the ack: child forgets it
+                except OSError:
+                    cls.dirty = True
+            elif st == "sdc":
+                # silent-corruption demotion (the integrity plane): the
+                # serve child detected a scrub digest mismatch for this
+                # tenant, quarantined its suspect generations and freed
+                # the slot -- classmates kept serving.  Requeue the
+                # member so the next placement readmits it (warm class
+                # first), resuming from the newest digest-verified
+                # generation: the tenant rolls back ALONE.  The sig is
+                # kept -- same class, same warm child.
+                job.state = "queued"
+                job.batch_leader = None
+                job.sup = None
+                job._batch_progress = None   # rolled back: stale
+                cls.members.pop(mname, None)
+                fleet.journal("sdc", job=mname,
+                              update=rec.get("update"),
+                              last_verified_update=rec.get(
+                                  "last_verified_update"),
+                              quarantined=rec.get("quarantined"),
+                              serve_leader=cls.leader.name)
+                fleet.journal("requeued", job=mname, reason="serve_sdc")
+                # the breaker counts sdc like any crash class: a sick
+                # device demoting tenant after tenant pauses admissions
+                fleet.note_external_failure("sdc", cls.leader)
                 try:
                     cls.write_control()   # the ack: child forgets it
                 except OSError:

@@ -64,7 +64,8 @@ import time
 from avida_tpu.observability.exporter import (METRICS_FILE, read_metrics,
                                               render_families, write_metrics)
 from avida_tpu.observability.runlog import append_record
-from avida_tpu.service import EXIT_AUDIT, EXIT_CKPT, FAILURE_CLASSES
+from avida_tpu.service import (EXIT_AUDIT, EXIT_CKPT, EXIT_SDC,
+                               FAILURE_CLASSES)
 from avida_tpu.service.backoff import RetryPolicy
 from avida_tpu.utils.checkpoint import list_generations
 
@@ -91,6 +92,8 @@ def classify(exit_code: int, *, watchdog_killed: bool = False,
         return "audit_violation"
     if exit_code == EXIT_CKPT:
         return "corrupt_ckpt"
+    if exit_code == EXIT_SDC:
+        return "sdc"
     return "crash"
 
 
@@ -171,12 +174,17 @@ class Outcome:
     """One boot's result: classification + the evidence behind it."""
 
     def __init__(self, cls: str, exit_code, *, pallas: bool = False,
-                 corrupt_seen: bool = False, update=None):
+                 corrupt_seen: bool = False, update=None,
+                 verified_update=None):
         self.cls = cls
         self.exit_code = exit_code
         self.pallas = pallas
         self.corrupt_seen = corrupt_seen
         self.update = update
+        # newest scrub-verified update the child reported in its
+        # divergence error (None when the tail carried no marker):
+        # the sdc rollback's quarantine horizon
+        self.verified_update = verified_update
 
 
 # postmortem context: failure-class exit records carry this much of the
@@ -541,10 +549,21 @@ class Supervisor:
             re.findall(r"checkpoint_corrupt: path=(\S+)", tail))
         new_corrupt = corrupt_paths - self._corrupt_counted
         self._corrupt_counted |= new_corrupt
+        verified = None
+        if cls == "sdc":
+            # the divergence error names the newest scrub-verified
+            # update -- everything saved past it is suspect
+            m = re.search(r"last_verified_update=(\d+)", tail)
+            verified = int(m.group(1)) if m else None
         out = Outcome(cls, rc,
-                      pallas=(cls == "crash" and pallas_suspect(tail)),
+                      # an sdc whose divergence error names a Pallas
+                      # engine is kernel-implicated like a Pallas crash:
+                      # it earns the same one-shot XLA degradation
+                      pallas=(cls in ("crash", "sdc")
+                              and pallas_suspect(tail)),
                       corrupt_seen=bool(new_corrupt),
-                      update=metrics.get("avida_update"))
+                      update=metrics.get("avida_update"),
+                      verified_update=verified)
         if new_corrupt:
             # the child survived via CRC fallback -- record the class
             # even though this boot may otherwise have succeeded
@@ -592,6 +611,8 @@ class Supervisor:
             return
         if out.cls == "audit_violation":
             self._rollback()
+        if out.cls == "sdc":
+            self._sdc_rollback(out.verified_update)
         if out.pallas and not self._xla_fallback:
             # graceful degradation: one free retry on the XLA
             # path with a LOUD warning -- slower, but alive
@@ -695,6 +716,82 @@ class Supervisor:
         self.rollbacks += 1
         self.record("rollback", quarantined=newest,
                     resumed_from=os.path.basename(gens[-2]))
+
+    def _ckpt_dirs(self) -> list:
+        """The checkpoint dirs this child writes: the configured dir
+        itself when it holds generations, else any immediate per-world
+        subdirs that do (a --worlds batched child keeps one dir per
+        member under the root TPU_CKPT_DIR)."""
+        if list_generations(self.ckpt_dir):
+            return [self.ckpt_dir]
+        try:
+            subs = sorted(os.path.join(self.ckpt_dir, d)
+                          for d in os.listdir(self.ckpt_dir)
+                          if os.path.isdir(os.path.join(self.ckpt_dir, d)))
+        except OSError:
+            return [self.ckpt_dir]
+        return [d for d in subs if list_generations(d)] or [self.ckpt_dir]
+
+    def _sdc_rollback(self, verified_update):
+        """Silent-data-corruption recovery (child exit EXIT_SDC): the
+        scrub caught a divergence, so state saved since the last
+        verified update may embed the corruption -- WITH a
+        self-consistent manifest digest (the digest was computed from
+        the already-corrupt state), which is why recency alone cannot
+        be trusted.  Two passes per checkpoint dir:
+
+          1. quarantine every generation saved PAST the child's
+             reported verified horizon (suspect by timing);
+          2. digest-verify what remains newest-first (recompute from
+             the .npy leaves vs the manifest's state_digest --
+             utils/integrity.py, numpy only, no jax) and quarantine
+             mismatches until a verified generation is newest.
+
+        With no horizon marker in the child's tail, fall back to the
+        audit-violation policy: quarantine the newest generation."""
+        from avida_tpu.utils import integrity
+        if verified_update is None:
+            self._rollback()
+            return
+        quarantined = []
+        for base in self._ckpt_dirs():
+            from avida_tpu.utils.checkpoint import quarantine_after
+            quarantined += quarantine_after(base, verified_update)
+            for gen in reversed(list_generations(base)):
+                if len(list_generations(base)) < 2:
+                    break       # never strand the run without a resume
+                try:
+                    stored, recomputed = integrity.generation_digest(gen)
+                except (OSError, ValueError, KeyError):
+                    continue    # torn/verifying is the CRC path's job
+                if stored is None or stored == recomputed:
+                    break       # newest surviving generation verifies
+                dst = os.path.join(
+                    base, f".bad-{os.path.basename(gen)}."
+                          f"{int(self._clock())}")
+                try:
+                    os.rename(gen, dst)
+                    quarantined.append(gen)
+                    self.record("sdc_digest_quarantine", path=gen,
+                                stored=f"{stored:#010x}",
+                                recomputed=f"{recomputed:#010x}")
+                except OSError:
+                    break
+        if quarantined:
+            self.rollbacks += 1
+            self.record("sdc_rollback",
+                        verified_update=verified_update,
+                        quarantined=[os.path.basename(p)
+                                     for p in quarantined],
+                        resumable={base: [os.path.basename(g) for g in
+                                          list_generations(base)[-1:]]
+                                   for base in self._ckpt_dirs()})
+        else:
+            self.record("sdc_rollback_noop",
+                        verified_update=verified_update,
+                        detail="no generation postdates the verified "
+                               "horizon; resume replays from the "
+                               "newest retained generation")
 
     # ---- the supervision loop ----
 

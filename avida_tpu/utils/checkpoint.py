@@ -116,12 +116,14 @@ def list_generations(base_dir: str) -> list:
 
 def write_generation(base_dir: str, update: int, arrays: dict,
                      host: dict, files: dict | None = None,
-                     keep: int = 2) -> str:
+                     keep: int = 2, extra: dict | None = None) -> str:
     """Write one checkpoint generation atomically; returns its path.
 
     arrays: name -> np.ndarray (saved as <name>.npy, CRC'd)
     host:   JSON-able scalar block (stored inside the manifest)
     files:  name -> bytes sidecar blobs (CRC'd like arrays)
+    extra:  additional top-level manifest keys (the integrity plane's
+            `state_digest`; utils/integrity.py)
 
     The generation directory only appears (rename) after every byte is
     written and fsync'd; a crash at any earlier point leaves a `.tmp-*`
@@ -143,6 +145,7 @@ def write_generation(base_dir: str, update: int, arrays: dict,
         "arrays": {},
         "files": {},
         "host": host,
+        **(extra or {}),
     }
     for name, arr in arrays.items():
         arr = np.asarray(arr)
@@ -270,6 +273,33 @@ def restore_candidates(base_dir: str) -> list:
                         for d in os.listdir(base_dir)
                         if d.startswith(".old-")), reverse=True)
     return gens
+
+
+def quarantine_after(base_dir: str, update: int) -> list:
+    """Silent-corruption recovery helper: move every generation saved
+    PAST `update` aside to `.bad-*` (invisible to restore_candidates,
+    swept later by `ckpt_tool --prune`), so the next resume rolls back
+    to the newest generation at or before the last verified update.
+    Always leaves at least one generation published -- when every
+    generation postdates the verified horizon the OLDEST survives
+    (deterministic replay from it is at least self-consistent, and a
+    run with zero resumable generations would wedge in exit 66).
+    Returns the quarantined paths, newest first."""
+    gens = list_generations(base_dir)
+    out = []
+    for g in reversed(gens):
+        if generation_update(g) <= int(update):
+            break
+        if len(gens) - len(out) <= 1:
+            break
+        dst = os.path.join(
+            base_dir, f".bad-{os.path.basename(g)}.{int(time.time())}")
+        try:
+            os.rename(g, dst)
+            out.append(g)
+        except OSError:
+            break
+    return out
 
 
 def latest_valid(base_dir: str, on_skip=None) -> tuple:
@@ -410,8 +440,20 @@ def save_checkpoint(base_dir: str, world) -> str:
         files["systematics.json"] = json.dumps(
             world.systematics.to_snapshot()).encode()
     keep = int(world.cfg.get("TPU_CKPT_KEEP", 2))
+    extra = None
+    if getattr(world, "_digest_on", False) \
+            or getattr(world, "_scrub_every", 0):
+        # integrity plane armed: the manifest carries the order-stable
+        # state digest (utils/integrity.py), recomputed here from the
+        # very host arrays being written -- by construction equal to
+        # the device digest of the live state (ops/digest.py), which
+        # is what lets --resume, ckpt_tool --verify and the
+        # supervisor's sdc rollback re-verify generations without jax
+        from avida_tpu.utils import integrity
+        extra = {"state_digest": integrity.digest_arrays(
+            integrity.state_arrays_of(arrays))}
     return write_generation(base_dir, world.update, arrays, host,
-                            files=files, keep=keep)
+                            files=files, keep=keep, extra=extra)
 
 
 def _build_state(world, arrays: dict):
@@ -540,6 +582,26 @@ def restore_checkpoint(base_dir: str, world, at_update: int | None = None
             last_err = e
             on_skip(path, e)
             continue
+        stored = manifest.get("state_digest")
+        if stored is not None:
+            # integrity plane: re-verify the restored state's digest
+            # against the manifest BEFORE running.  CRC catches bytes
+            # that rotted; this catches the loader-corruption class
+            # (the PR-13 donation-aliasing landmine's family) where the
+            # bytes verify but the decoded state would not -- treated
+            # exactly like a CRC failure: skip the generation, fall
+            # back, journal with its own reason
+            from avida_tpu.utils import integrity
+            got = integrity.digest_arrays(integrity.state_arrays_of(arrays))
+            if got != int(stored):
+                last_err = CheckpointError(
+                    f"{path}: state digest mismatch (recomputed "
+                    f"{got:#010x} != manifest {int(stored):#010x})")
+                emit_event(world, "checkpoint_digest_mismatch", path=path,
+                           recomputed=f"{got:#010x}",
+                           manifest=f"{int(stored):#010x}",
+                           detail="falling back past the generation")
+                continue
         try:
             _apply(world, manifest, arrays, files)
         except CheckpointMismatchError:

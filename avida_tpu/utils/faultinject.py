@@ -35,11 +35,28 @@ Kinds (default arg key in brackets):
     torn-manifest    after a checkpoint save, truncate the generation's
                      manifest.json at a seeded fraction (a manifest
                      torn mid-write)
+    corrupt-digest   after a checkpoint save, flip the manifest's
+                     stored `state_digest` (payload CRCs untouched):
+                     the loader-corruption class -- bytes verify, the
+                     decoded state would not.  Caught by the resume
+                     digest verification and `ckpt_tool --verify`
+                     (DIGEST MISMATCH), never by CRC
     nan [leaf]       device-side: set `st.<leaf>[cell]` (default leaf
                      `merit`, default cell the injection cell) to NaN
                      at `@update=N` inside the jitted update.  Requires
                      an `@update` trigger; caught by the state auditor
                      and the flight recorder's anomaly events
+    bitflip [leaf]   device-side: XOR one bit (default bit 0, the low
+                     mantissa bit -- finite, in-bounds, invisible to
+                     every auditor invariant) of `st.<leaf>[cell]` at
+                     `@update=N` inside the jitted update, modeling a
+                     real SDC event.  `bitflip:merit,cell=5,bit=3
+                     @update=40`.  Requires `@update`; caught ONLY by
+                     the integrity plane's sampled shadow re-execution
+                     (TPU_SCRUB_EVERY), because the shadow replay runs
+                     the PRISTINE program -- an injected device fault
+                     models a transient hardware event, which by
+                     definition fires in the live execution only
 
 Triggers: `@update=N` fires at the first chunk boundary whose update
 counter is >= N (save kinds: the first save at update >= N); `@chunk=K`
@@ -61,11 +78,16 @@ import signal
 import time
 import zlib
 
-KINDS = ("crash", "sigkill", "hang", "corrupt-ckpt", "torn-manifest", "nan")
-_DEFAULT_KEY = {"corrupt-ckpt": "leaf", "nan": "leaf", "hang": "sec"}
+KINDS = ("crash", "sigkill", "hang", "corrupt-ckpt", "torn-manifest",
+         "corrupt-digest", "nan", "bitflip")
+_DEFAULT_KEY = {"corrupt-ckpt": "leaf", "nan": "leaf", "bitflip": "leaf",
+                "hang": "sec"}
 _BOUNDARY_KINDS = ("crash", "sigkill", "hang")
-_SAVE_KINDS = ("corrupt-ckpt", "torn-manifest")
+_SAVE_KINDS = ("corrupt-ckpt", "torn-manifest", "corrupt-digest")
 NAN_LEAVES = ("merit", "fitness")
+# the in-bounds SDC kind targets float32 leaves so a low-mantissa flip
+# stays finite/non-negative and sails past every audit_state invariant
+BITFLIP_LEAVES = ("merit", "fitness")
 
 
 class FaultInjected(RuntimeError):
@@ -125,14 +147,19 @@ def _parse_one(text: str) -> Fault:
             f"fault {text!r}: save-time kinds ({', '.join(_SAVE_KINDS)}) "
             f"fire on checkpoint publishes, which have no chunk index -- "
             f"use @update=N or no trigger (first save)")
-    if kind == "nan":
+    if kind in ("nan", "bitflip"):
         if trigger is None or trigger[0] != "update":
-            raise ValueError(f"fault {text!r}: nan requires @update=N "
+            raise ValueError(f"fault {text!r}: {kind} requires @update=N "
                              f"(it is injected inside the jitted update)")
+        leaves = NAN_LEAVES if kind == "nan" else BITFLIP_LEAVES
         leaf = args.get("leaf", "merit")
-        if leaf not in NAN_LEAVES:
-            raise ValueError(f"fault {text!r}: nan leaf must be one of "
-                             f"{NAN_LEAVES} (got {leaf!r})")
+        if leaf not in leaves:
+            raise ValueError(f"fault {text!r}: {kind} leaf must be one of "
+                             f"{leaves} (got {leaf!r})")
+    if kind == "bitflip":
+        bit = int(args.get("bit", 0))
+        if not 0 <= bit < 32:
+            raise ValueError(f"fault {text!r}: bit must be in [0, 32)")
     if kind == "hang" and "sec" in args:
         float(args["sec"])              # validate now, not at fire time
     return Fault(kind, args, trigger, text)
@@ -197,6 +224,48 @@ def nan_phase(params, st, update_no):
                                          poisoned, arr)})
 
 
+def bitflip_param(cfg) -> tuple:
+    """The static WorldParams.fault_bitflip tuple (leaf, cell, bit,
+    update) for a `bitflip:` fault in the active spec, or () -- in which
+    case update_step traces the identical program (the fault_nan
+    discipline; scripts/check_jaxpr.py digest)."""
+    spec = active_spec(cfg)
+    if not spec:
+        return ()
+    for f in parse_spec(spec):
+        if f.kind != "bitflip":
+            continue
+        leaf = f.args.get("leaf", "merit")
+        num_cells = int(cfg.WORLD_X) * int(cfg.WORLD_Y)
+        cell = int(f.args.get("cell", num_cells // 2))
+        if not 0 <= cell < num_cells:
+            raise ValueError(
+                f"bitflip fault cell {cell} outside [0, {num_cells})")
+        return (leaf, cell, int(f.args.get("bit", 0)), int(f.trigger[1]))
+    return ()
+
+
+def bitflip_phase(params, st, update_no):
+    """Device-side single-bit flip (the modeled SDC event): XOR one bit
+    of one float leaf entry at the trigger update, inside the jitted
+    update behind the static params.fault_bitflip gate.  The default
+    bit 0 (low mantissa) keeps the value finite and in-bounds -- the
+    corruption class NO audit_state invariant can see, which is exactly
+    what the integrity plane's scrub exists to catch.  The shadow
+    re-execution strips this gate (World._shadow_params): a transient
+    hardware fault fires in the live execution only."""
+    import jax
+    import jax.numpy as jnp
+    leaf, cell, bit, at_update = params.fault_bitflip
+    arr = getattr(st, leaf)
+    word = jax.lax.bitcast_convert_type(arr[cell], jnp.uint32) \
+        ^ jnp.uint32(1 << bit)
+    flipped = arr.at[cell].set(
+        jax.lax.bitcast_convert_type(word, arr.dtype))
+    return st.replace(**{leaf: jnp.where(jnp.equal(update_no, at_update),
+                                         flipped, arr)})
+
+
 # ---------------------------------------------------------------------------
 # host-side corruption helpers (also used directly by tests)
 # ---------------------------------------------------------------------------
@@ -232,6 +301,34 @@ def tear_manifest(gen_path: str, rng: random.Random | None = None) -> int:
     keep = int(size * rng.uniform(0.15, 0.85))
     os.truncate(mpath, keep)
     return keep
+
+
+def corrupt_digest(gen_path: str, rng: random.Random | None = None) -> int:
+    """Flip one seeded bit of the manifest's stored `state_digest`
+    (written when the integrity plane is armed; a digest-off manifest
+    gets a seeded bogus value) while every payload CRC stays intact --
+    the at-rest model of the LOADER-corruption class: the bytes verify,
+    the state they decode to would not.  Returns the new stored value.
+    Caught by the resume digest verification (restore falls back past
+    the generation with a `checkpoint_digest_mismatch` journal line)
+    and by `ckpt_tool --verify` (DIGEST MISMATCH), never by CRC."""
+    import json
+    rng = rng or random.Random(0)
+    mpath = os.path.join(gen_path, "manifest.json")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    old = manifest.get("state_digest")
+    if old is None:
+        new = rng.randrange(1, 1 << 32)
+    else:
+        new = int(old) ^ (1 << rng.randrange(32))
+        if new == int(old):             # unreachable, but stay corrupt
+            new = int(old) ^ 1
+    manifest["state_digest"] = new
+    with open(mpath, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.write("\n")
+    return new
 
 
 # ---------------------------------------------------------------------------
@@ -274,6 +371,11 @@ class FaultPlan:
                 pos = corrupt_leaf(gen_path, leaf, f.rng)
                 emit_event(world, "fault_injected", kind="corrupt-ckpt",
                            spec=f.text, path=gen_path, leaf=leaf, offset=pos)
+            elif f.kind == "corrupt-digest":
+                val = corrupt_digest(gen_path, f.rng)
+                emit_event(world, "fault_injected", kind="corrupt-digest",
+                           spec=f.text, path=gen_path,
+                           stored_digest=f"{val:#010x}")
             else:
                 keep = tear_manifest(gen_path, f.rng)
                 emit_event(world, "fault_injected", kind="torn-manifest",

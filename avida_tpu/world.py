@@ -104,9 +104,16 @@ class World:
         if config_dir is not None:
             cfg = load_avida_cfg(os.path.join(config_dir, "avida.cfg"), overrides)
         elif cfg is None:
+            from avida_tpu.config.schema import _parse_scalar
             cfg = AvidaConfig()
             for name, value in (overrides or []):
-                cfg.set(name, value)
+                # same scalar coercion as the config-dir path
+                # (load_avida_cfg): a CLI `-set TPU_SYSTEMATICS 0`
+                # must store int 0, not the TRUTHY string "0" --
+                # extras-var gates that test truthiness (systematics,
+                # nb_cap) silently ignored string-zero overrides on
+                # the bare-config path before this
+                cfg.set(name, _parse_scalar(str(value)))
         self.cfg = cfg
         self.config_dir = config_dir
         self.data_dir = data_dir or cfg.DATA_DIR
@@ -263,10 +270,41 @@ class World:
 
         # deterministic fault injection (utils/faultinject.py): None in
         # every production run -- with TPU_FAULT unset no hook fires and
-        # the update program is untouched (the `nan:` kind rides
-        # params.fault_nan behind the same static gate as the tracer)
+        # the update program is untouched (the `nan:`/`bitflip:` kinds
+        # ride params.fault_nan/fault_bitflip behind the same static
+        # gate as the tracer)
         from avida_tpu.utils.faultinject import plan_from_config
         self.faults = plan_from_config(cfg)
+
+        # silent-corruption integrity plane (ops/digest.py +
+        # utils/integrity.py; README "Integrity plane").  Both knobs
+        # default OFF: no digest program is built, no state copy is
+        # retained, zero cost -- and either way the update program is
+        # untouched (the digest is a SEPARATE jit, the audit_state
+        # isolation rule).  TPU_STATE_DIGEST=1 computes an order-stable
+        # u32 tree digest of the state at every chunk boundary (into
+        # the checkpoint manifest, the heartbeat and integrity.jsonl);
+        # TPU_SCRUB_EVERY=K re-executes every K-th chunk from the
+        # retained pre-chunk state and compares digests -- determinism
+        # makes any mismatch corruption, not noise
+        from avida_tpu.utils import integrity
+        self._digest_on = integrity.digest_enabled(cfg)
+        self._scrub_every = integrity.scrub_every(cfg)
+        self._chunk_no = 0              # process-lifetime chunk counter
+        self._digest_pending = None     # (update, device u32) deferred
+        self.state_digest = None        # (update, value) last resolved
+        self._last_verified_update = 0  # newest scrub-verified update
+        if (self._digest_on or self._scrub_every) \
+                and self.telemetry is not None:
+            # telemetry forces per-update phase-fenced dispatch through
+            # StagedUpdate -- there is no scanned chunk to digest or
+            # shadow-replay, so the plane would be a silent no-op; be
+            # loud instead of quietly unprotected
+            import sys as _sys
+            print("[avida-tpu] warning: TPU_STATE_DIGEST/TPU_SCRUB_EVERY "
+                  "are no-ops under TPU_TELEMETRY (the integrity plane "
+                  "rides the scanned chunk path); run telemetry OR "
+                  "scrubbing, not both", file=_sys.stderr)
 
         # offspring reversion/sterilization via the batched Test CPU
         # (cHardwareBase::Divide_TestFitnessMeasures cc:866); fitness
@@ -965,6 +1003,14 @@ class World:
         (tests/test_native_checkpoint.py, tests/test_tracer.py)."""
         assert self.state is not None, "no population injected"
         from avida_tpu.utils import compilecache
+        pre = None
+        if self._scrub_every > 0:
+            self._chunk_no += 1
+            if self._chunk_no % self._scrub_every == 0:
+                # retain the pre-chunk state for the shadow replay:
+                # device-owned COPIES, because update_scan donates its
+                # input buffers (both executions consume their own)
+                pre = (jax.tree.map(jnp.copy, self.state), self.update)
         self.state, (executed, births, deaths, dts, ave_gens, n_alive) = \
             compilecache.call(
                 update_scan, "update_scan",
@@ -979,7 +1025,124 @@ class World:
         self._deaths_this = deaths[-1]
         self._prev_alive = n_alive[-1]
         self._total_births = self._total_births + births.sum()
+        if self._digest_on or pre is not None:
+            self._integrity_boundary(k, pre)
         return executed
+
+    # ---- silent-corruption integrity plane (README "Integrity plane") --
+
+    def _shadow_params(self):
+        """Params for the shadow replay: the PRISTINE program.  Injected
+        device-side faults (nan/bitflip) model a transient hardware
+        event, which by definition fires in the live execution only --
+        the reference re-execution must not replay it.  In production
+        (no faults armed) this IS self.params, so the shadow runs the
+        already-compiled live program."""
+        p = self.params
+        if p.fault_nan or getattr(p, "fault_bitflip", ()):
+            return p.replace(fault_nan=(), fault_bitflip=())
+        return p
+
+    def _engine_name(self) -> str:
+        """Which chunk engine the scan just ran -- named in divergence
+        errors so the supervisor's kernel-implication heuristic
+        (pallas_suspect) can apply the one-shot XLA degradation."""
+        from avida_tpu.ops import packed_chunk
+        from avida_tpu.ops.update import use_pallas_path
+        if not use_pallas_path(self.params):
+            return "xla"
+        return ("pallas-packed"
+                if packed_chunk.active(self.params, self.state)
+                else "pallas")
+
+    def _integrity_record(self, event: str, **fields):
+        from avida_tpu.utils import integrity
+        integrity.append_integrity_record(
+            self.data_dir, event,
+            max_bytes=int(self.cfg.get("TPU_RUNLOG_MAX_BYTES", 16 << 20)),
+            **fields)
+
+    def _resolve_digest(self, pending):
+        """Host-resolve one deferred digest scalar (its chunk finished
+        at least one boundary ago, so the readback is free) into the
+        heartbeat value + the per-chunk runlog record."""
+        import time as _time
+        u, dev = pending
+        t0 = _time.monotonic()
+        val = int(np.asarray(dev))
+        from avida_tpu.utils import integrity
+        integrity.note_digest_ms((_time.monotonic() - t0) * 1e3)
+        self.state_digest = (u, val)
+        self._integrity_record("digest", update=u, digest=f"{val:#010x}")
+
+    def _flush_digest(self):
+        """Resolve any deferred digest NOW (host sync points: checkpoint
+        save, run exit) so the heartbeat/runlog never lose the last
+        boundary's value."""
+        prev, self._digest_pending = self._digest_pending, None
+        if prev is not None:
+            self._resolve_digest(prev)
+
+    def _integrity_boundary(self, k: int, pre):
+        """Per-chunk integrity work, immediately after the scan returned
+        and BEFORE any host-side mutation of the state: compute the live
+        digest (deferred readback on the hot path), and when this chunk
+        was sampled for scrubbing (`pre` holds the retained pre-chunk
+        state) re-execute it and compare digests -- any mismatch on this
+        deterministic engine is silent corruption, raised as
+        StateDivergenceError (child exit 67, the supervisor's `sdc`
+        class)."""
+        import time as _time
+
+        from avida_tpu.ops.digest import state_digest
+        from avida_tpu.utils import integrity
+        u1 = self.update + k
+        t0 = _time.monotonic()
+        d_live = state_digest(self.state)
+        integrity.note_digest_ms((_time.monotonic() - t0) * 1e3)
+        self._flush_digest()
+        if pre is None:
+            # digest-only boundary: queue for the deferred readback
+            self._digest_pending = (u1, d_live)
+            return
+        # scrub: shadow re-execution of the chunk just run (a host sync
+        # point -- amortized by the TPU_SCRUB_EVERY cadence)
+        from avida_tpu.utils import compilecache
+        pre_st, u0 = pre
+        integrity.note_scrub()
+        shadow_st, _ = compilecache.call(
+            update_scan, "update_scan",
+            (self._shadow_params(), pre_st, k, self._run_key,
+             self.neighbors, jnp.int32(u0)),
+            cfg=self.cfg, log=self._compile_cache_log)
+        t0 = _time.monotonic()
+        d_shadow = state_digest(shadow_st)
+        live, shad = int(np.asarray(d_live)), int(np.asarray(d_shadow))
+        integrity.note_digest_ms((_time.monotonic() - t0) * 1e3)
+        if live != shad:
+            integrity.note_mismatch()
+            engine = self._engine_name()
+            self._integrity_record(
+                "scrub", update=u1, chunk_updates=k, ok=False,
+                live=f"{live:#010x}", shadow=f"{shad:#010x}",
+                engine=engine,
+                last_verified_update=self._last_verified_update)
+            from avida_tpu.observability.runlog import emit_event
+            emit_event(self, "state_divergence", update=u1,
+                       live=f"{live:#010x}", shadow=f"{shad:#010x}")
+            from avida_tpu.utils.integrity import StateDivergenceError
+            raise StateDivergenceError(
+                f"silent state divergence in updates [{u0}, {u1}): live "
+                f"digest {live:#010x} != shadow replay {shad:#010x} "
+                f"(engine {engine}, "
+                f"last_verified_update={self._last_verified_update})")
+        self._last_verified_update = u1
+        if self._digest_on:
+            self.state_digest = (u1, live)
+            self._integrity_record("digest", update=u1,
+                                   digest=f"{live:#010x}")
+        self._integrity_record("scrub", update=u1, chunk_updates=k,
+                               ok=True, digest=f"{live:#010x}")
 
     def _chunkable(self) -> bool:
         """May event-free stretches run as one scanned device program?
@@ -1236,6 +1399,7 @@ class World:
         # cursor is 0 and a resume never replays stale events
         self._flush_newborn_drain()
         self._flush_trace()
+        self._flush_digest()
         if audit:
             from avida_tpu.utils.audit import check_invariants
             check_invariants(self.params, self.state,
@@ -1297,6 +1461,10 @@ class World:
             from avida_tpu.utils.audit import check_invariants
             check_invariants(self.params, self.state,
                              where=f"checkpoint restore (update {update})")
+        # the restored generation passed the manifest digest check
+        # (restore_checkpoint verifies it whenever the manifest carries
+        # one), so scrubbing's verification horizon restarts here
+        self._last_verified_update = update
         return update
 
     def run(self, max_updates: int | None = None):
@@ -1409,6 +1577,7 @@ class World:
             # be mid-mutation), but the finally below still closes writers
             self._flush_newborn_drain()
             self._flush_trace()
+            self._flush_digest()
             if self._preempt and ckpt_base and self.state is not None:
                 self.save_checkpoint(ckpt_base)
             elif ckpt_base and self.state is not None \

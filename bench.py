@@ -239,6 +239,9 @@ def main():
                                           updates=64 if on_tpu else 16))
     if os.environ.get("BENCH_SUPERVISE", "0") == "1":
         line.update(supervisor_restart_fields())
+    if os.environ.get("BENCH_SCRUB", "0") == "1":
+        line.update(scrub_overhead_fields(world if on_tpu else 60,
+                                          updates=64 if on_tpu else 32))
     if os.environ.get("BENCH_ANALYZE", "0") == "1":
         line.update(analytics_fields())
     if os.environ.get("BENCH_WORLDS", "0") not in ("", "0"):
@@ -854,6 +857,94 @@ def ckpt_audit_overhead(params, st):
         shutil.rmtree(tmp, ignore_errors=True)
     return {"ckpt_save_ms": round(ckpt_ms, 2),
             "audit_ms": round(audit_ms, 2)}
+
+
+def scrub_overhead_fields(world, updates=32, seed=100):
+    """BENCH_SCRUB=1: the integrity plane's tax in the perf trajectory
+    (README "Integrity plane").  The SAME world config is run
+    end-to-end through World.run three ways -- plain, with per-chunk
+    state digests (TPU_STATE_DIGEST=1), and with full lockstep
+    scrubbing (TPU_SCRUB_EVERY=1: every chunk shadow-re-executed and
+    digest-compared) -- each timed after a warm run of the identical
+    config, so compile time stays out of the comparison
+    (caching-immune: every timed pass evolves its own fresh world
+    through the same updates).  Emits:
+
+      digest_ms               one fenced whole-state digest on the
+                              evolved final state (compiled cost)
+      chunk_ms                plain per-chunk wall at this chunk size
+                              (min over reps: single-core host noise
+                              runs to ~30% on whole-run walls, so the
+                              per-config minimum is the honest floor)
+      digest_overhead_pct     digest_ms as a share of chunk_ms -- the
+                              <5%-of-chunk-wall acceptance gauge,
+                              attributed DIRECTLY (one fenced digest /
+                              one chunk) rather than via end-to-end
+                              wall deltas, which on this host are
+                              noise-bound an order of magnitude above
+                              the signal
+      digest_wall_delta_pct   the end-to-end wall delta anyway
+                              (digest-on run vs plain, min-of-reps) --
+                              reported for honesty, read with the
+                              noise caveat above
+      scrub_overhead_pct      wall overhead of TPU_SCRUB_EVERY=1 vs
+                              plain (~100% by construction -- every
+                              chunk runs twice; the amortized cost at
+                              cadence K is this / K)
+
+    Measured after -- and without perturbing -- the headline numbers."""
+    import shutil
+    import tempfile
+
+    from avida_tpu.ops.digest import state_digest
+    from avida_tpu.world import World
+
+    chunk = 8
+
+    def run_one(extra):
+        ov = [("WORLD_X", world), ("WORLD_Y", world),
+              ("RANDOM_SEED", seed), ("TPU_SYSTEMATICS", 0),
+              ("TPU_MAX_STRETCH", chunk)] + extra
+        w = World(overrides=ov, data_dir=tempfile.mkdtemp(prefix="bench-scrub-"))
+        try:
+            t0 = time.perf_counter()
+            w.run(max_updates=updates)
+            wall = time.perf_counter() - t0
+        finally:
+            shutil.rmtree(w.data_dir, ignore_errors=True)
+        return wall, w
+
+    configs = ([], [("TPU_STATE_DIGEST", 1)],
+               [("TPU_STATE_DIGEST", 1), ("TPU_SCRUB_EVERY", 1)])
+    for extra in configs:
+        run_one(extra)                               # compile warmup
+    reps = int(os.environ.get("BENCH_SCRUB_REPS", "2"))
+    walls = []
+    wp = None
+    for extra in configs:
+        best = float("inf")
+        for _ in range(reps):
+            wall, w = run_one(extra)
+            best = min(best, wall)
+            if not extra:
+                wp = w
+        walls.append(best)
+    plain, digest, scrub = walls
+
+    jax.block_until_ready(state_digest(wp.state))    # compiled already
+    t0 = time.perf_counter()
+    jax.block_until_ready(state_digest(wp.state))
+    digest_ms = (time.perf_counter() - t0) * 1e3
+
+    chunks = max(updates // chunk, 1)
+    chunk_ms = plain / chunks * 1e3
+    return {
+        "digest_ms": round(digest_ms, 3),
+        "chunk_ms": round(chunk_ms, 2),
+        "digest_overhead_pct": round(digest_ms / chunk_ms * 100, 3),
+        "digest_wall_delta_pct": round((digest - plain) / plain * 100, 2),
+        "scrub_overhead_pct": round((scrub - plain) / plain * 100, 2),
+    }
 
 
 def trace_overhead_fields(world, updates=64, seed=100):

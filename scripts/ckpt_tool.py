@@ -71,19 +71,37 @@ def _dir_bytes(path: str) -> int:
 
 
 def verify_status(path: str) -> tuple:
-    """(ok, status_line) for one generation -- distinguishes a torn
-    manifest from payload corruption."""
+    """(ok, status_line, manifest) for one generation.  Three distinct
+    failure vocabularies, because they mean three different things on
+    an ops floor: TORN MANIFEST = the save died mid-write, CORRUPT =
+    payload bytes rotted at rest (CRC), DIGEST MISMATCH = every byte
+    verifies but the state they encode no longer folds to the digest
+    the run computed on device -- the loader/at-rest silent-corruption
+    class the integrity plane exists for (utils/integrity.py; the
+    digest is present when the run had TPU_STATE_DIGEST or
+    TPU_SCRUB_EVERY armed)."""
     _repo_path()
     from avida_tpu.utils.checkpoint import (CheckpointError,
                                             CheckpointManifestError,
                                             verify_generation)
     try:
         manifest = verify_generation(path)
-        return True, "OK (verified)", manifest
     except CheckpointManifestError as e:
         return False, f"TORN MANIFEST -- {e}", None
     except (CheckpointError, OSError) as e:
         return False, f"CORRUPT -- {e}", None
+    if manifest.get("state_digest") is not None:
+        from avida_tpu.utils.integrity import generation_digest
+        try:
+            stored, recomputed = generation_digest(path)
+        except (OSError, ValueError, KeyError) as e:
+            return False, f"DIGEST UNREADABLE -- {e}", None
+        if stored != recomputed:
+            return False, (f"DIGEST MISMATCH -- recomputed "
+                           f"{recomputed:#010x} != manifest "
+                           f"{stored:#010x}"), None
+        return True, "OK (verified, digest ok)", manifest
+    return True, "OK (verified)", manifest
 
 
 def prune(base: str, keep: int) -> list:
@@ -231,21 +249,23 @@ def main(argv=None) -> int:
         saved = time.strftime("%Y-%m-%d %H:%M:%S",
                               time.localtime(manifest.get("saved_at", 0)))
         detail = ""
+        if do_detail and manifest.get("state_digest") is not None:
+            detail += f", digest {int(manifest['state_digest']):#010x}"
         if do_detail:
             from avida_tpu.analyze.pipeline import checkpoint_detail
             try:
                 d = checkpoint_detail(path)
             except Exception as e:      # triage stays best-effort: a
                 d = None                # bad sidecar must not kill list
-                detail = f", detail unavailable ({e})"
+                detail += f", detail unavailable ({e})"
             if d is not None:
                 dom = ("-" if d["dominant_gid"] is None else
                        f"gid {d['dominant_gid']} x{d['dominant_units']} "
                        f"depth {d['dominant_depth']}")
                 mask = d["tasks_mask"]
-                detail = (f", live {d['live']}, dominant {dom}, tasks "
-                          + ("-" if mask is None else
-                             f"{mask:#x} ({bin(mask).count('1')})"))
+                detail += (f", live {d['live']}, dominant {dom}, tasks "
+                           + ("-" if mask is None else
+                              f"{mask:#x} ({bin(mask).count('1')})"))
         print(f"{name}: update {manifest.get('update')}, saved {saved}, "
               f"{len(manifest.get('arrays', {}))} arrays, "
               f"{_dir_bytes(path) / 1e6:.2f} MB, {status}{detail}")

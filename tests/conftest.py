@@ -20,6 +20,15 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ["TPU_COMPILE_CACHE"] = os.environ.get(
     "TPU_COMPILE_CACHE_FOR_TESTS", "0")
 
+# Hermeticity, same rule for the integrity plane: a developer shell with
+# TPU_STATE_DIGEST/TPU_SCRUB_EVERY exported must not make every World in
+# the suite pay digest/shadow-replay work (and shift timings or emit
+# integrity.jsonl files into test dirs).  Dedicated tests
+# (tests/test_integrity.py) opt back in via explicit overrides, which
+# beat these env defaults.
+os.environ["TPU_STATE_DIGEST"] = "0"
+os.environ["TPU_SCRUB_EVERY"] = "0"
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

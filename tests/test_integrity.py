@@ -1,0 +1,700 @@
+"""Integrity-plane suite: device digests, sampled shadow re-execution,
+and SDC-aware recovery (ops/digest.py, utils/integrity.py, the World /
+MultiWorld / ServeBatch scrub hooks, the supervisor `sdc` class).
+
+Layout mirrors the chaos suite: the digest units, the off-path gates
+and the in-process detection proofs are tier-1; the real-subprocess
+scrub-rollback chaos drills (XLA and Pallas) and the batched/serve legs
+are `slow`.  conftest.py pins TPU_STATE_DIGEST/TPU_SCRUB_EVERY env to 0
+suite-wide; these tests opt back in via explicit config overrides
+(which beat the env half of the knobs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from avida_tpu.utils import integrity
+from avida_tpu.utils.integrity import StateDivergenceError
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+import check_jaxpr  # noqa: E402
+
+SEED = 11
+UPDATES = 24
+
+# one shared world config for every in-process test in this module, so
+# the update_scan / digest programs compile once per pytest process
+_SETS = [
+    ("WORLD_X", 6), ("WORLD_Y", 6), ("TPU_MAX_MEMORY", 128),
+    ("RANDOM_SEED", SEED), ("TPU_SYSTEMATICS", 0),
+    ("COPY_MUT_PROB", 0.0075), ("TPU_USE_PALLAS", 2),
+    ("TPU_MAX_STRETCH", 4),
+]
+
+
+def _world(tmp, extra=()):
+    from avida_tpu.world import World
+    return World(overrides=_SETS + list(extra), data_dir=str(tmp))
+
+
+def _small_state(trace_cap=0):
+    import jax
+    from avida_tpu.config import AvidaConfig
+    from avida_tpu.config.environment import default_logic9_environment
+    from avida_tpu.config.instset import default_instset
+    from avida_tpu.core.state import make_world_params, zeros_population
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 6
+    cfg.WORLD_Y = 6
+    cfg.TPU_MAX_MEMORY = 64
+    if trace_cap:
+        cfg.set("TPU_TRACE", 1)
+        cfg.set("TPU_TRACE_CAP", trace_cap)
+    p = make_world_params(cfg, default_instset(),
+                          default_logic9_environment())
+    st = zeros_population(p.num_cells, p.max_memory, p.num_reactions,
+                          nb_cap=p.nb_cap, trace_cap=p.trace_cap)
+    import jax.numpy as jnp
+    key = jax.random.key(7)
+    st = st.replace(
+        merit=jax.random.uniform(key, st.merit.shape) * 100,
+        tape=jax.random.randint(jax.random.fold_in(key, 1),
+                                st.tape.shape, 0, 255).astype(jnp.uint8),
+        alive=jax.random.bernoulli(jax.random.fold_in(key, 2),
+                                   0.5, st.alive.shape))
+    return p, st
+
+
+# ---------------------------------------------------------------------------
+# digest units: host/device agreement, order stability, batched [W]
+# ---------------------------------------------------------------------------
+
+def test_digest_host_device_agreement():
+    """The jitted device digest and the numpy host digest fold to the
+    SAME u32 -- the property that lets host-only processes (--resume,
+    ckpt_tool, the supervisor's sdc rollback) re-verify what the device
+    computed.  Repeatable within a process, and None-valued leaves (the
+    disabled flight-recorder ring) are skipped on both sides."""
+    from avida_tpu.core.state import state_field_names
+    from avida_tpu.ops.digest import state_digest
+    p, st = _small_state()
+    dev = int(state_digest(st))
+    arrays = {n: np.asarray(getattr(st, n)) for n in state_field_names()
+              if getattr(st, n) is not None}
+    assert dev == integrity.digest_arrays(arrays)
+    assert int(state_digest(st)) == dev          # deterministic
+    # the ring-armed state digests differently (more leaves) but still
+    # agrees host/device
+    p2, st2 = _small_state(trace_cap=64)
+    dev2 = int(state_digest(st2))
+    arrays2 = {n: np.asarray(getattr(st2, n)) for n in state_field_names()
+               if getattr(st2, n) is not None}
+    assert dev2 == integrity.digest_arrays(arrays2)
+
+
+def test_digest_order_stability():
+    """Position-salted fold: swapping two elements, changing one bit,
+    or renaming a leaf each change the digest -- a reordered or
+    misattributed state can never alias a healthy one."""
+    from avida_tpu.ops.digest import state_digest
+    p, st = _small_state()
+    base = int(state_digest(st))
+    swapped = st.replace(
+        merit=st.merit.at[0].set(st.merit[1]).at[1].set(st.merit[0]))
+    assert int(state_digest(swapped)) != base
+    import jax
+    import jax.numpy as jnp
+    word = jax.lax.bitcast_convert_type(st.merit[3], jnp.uint32) \
+        ^ jnp.uint32(1)
+    flipped = st.replace(merit=st.merit.at[3].set(
+        jax.lax.bitcast_convert_type(word, st.merit.dtype)))
+    assert int(state_digest(flipped)) != base
+    # host side: the leaf NAME salts the fold
+    a = np.arange(8, dtype=np.int32)
+    assert integrity.digest_arrays({"x": a}) \
+        != integrity.digest_arrays({"y": a})
+    # length-sensitivity: a truncated leaf cannot alias
+    assert integrity.fold_words(np.arange(8, dtype=np.uint32)) \
+        != integrity.fold_words(np.arange(9, dtype=np.uint32))
+
+
+def test_digest_batched_matches_solo():
+    """state_digest_batched([W] stack) == per-world solo digests: the
+    cross-driver comparison the serve rollback relies on."""
+    import jax
+    import jax.numpy as jnp
+    from avida_tpu.ops.digest import state_digest, state_digest_batched
+    p, st = _small_state()
+    st2 = st.replace(merit=st.merit * 2 + 1)
+    bst = jax.tree.map(lambda a, b: jnp.stack([a, b]), st, st2)
+    batched = [int(x) for x in np.asarray(state_digest_batched(bst))]
+    assert batched == [int(state_digest(st)), int(state_digest(st2))]
+
+
+# ---------------------------------------------------------------------------
+# off-path gates: jaxpr untouched, zero-cost defaults, bit-identity
+# ---------------------------------------------------------------------------
+
+def test_integrity_knobs_leave_update_step_jaxpr_unchanged():
+    """The digest/scrub live OUTSIDE the traced update program (the
+    audit_state isolation rule): WorldParams is identical with the
+    knobs on or off, so the solo update_step jaxpr digest is unchanged
+    in both directions -- and the recorded snapshot still matches."""
+    from avida_tpu.config import AvidaConfig
+    from avida_tpu.config.environment import default_logic9_environment
+    from avida_tpu.config.instset import default_instset
+    from avida_tpu.core.state import make_world_params
+
+    def params_with(knobs):
+        cfg = AvidaConfig()
+        cfg.WORLD_X = 6
+        cfg.WORLD_Y = 6
+        cfg.TPU_MAX_MEMORY = 64
+        for n, v in knobs:
+            cfg.set(n, v)
+        return make_world_params(cfg, default_instset(),
+                                 default_logic9_environment())
+
+    off = params_with([])
+    on = params_with([("TPU_STATE_DIGEST", 1), ("TPU_SCRUB_EVERY", 1)])
+    assert on == off
+    ok, msg = check_jaxpr.check()
+    assert ok, msg
+
+
+def test_bitflip_grammar_and_param_plumbing():
+    """`bitflip:` parses (requires @update, leaf whitelist, bit range),
+    reaches WorldParams.fault_bitflip, and -- like every host-side
+    kind -- `corrupt-digest` never touches params."""
+    from avida_tpu.config import AvidaConfig
+    from avida_tpu.core.state import _fault_bitflip_param
+    from avida_tpu.utils.faultinject import parse_spec
+
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 6
+    cfg.WORLD_Y = 6
+    assert _fault_bitflip_param(cfg) == ()
+    cfg.set("TPU_FAULT", "bitflip:merit,cell=5,bit=3@update=40")
+    assert _fault_bitflip_param(cfg) == ("merit", 5, 3, 40)
+    cfg2 = AvidaConfig()
+    cfg2.WORLD_X = 6
+    cfg2.WORLD_Y = 6
+    cfg2.set("TPU_FAULT", "corrupt-digest@update=8")
+    assert _fault_bitflip_param(cfg2) == ()
+
+    with pytest.raises(ValueError, match="requires @update"):
+        parse_spec("bitflip:merit")
+    with pytest.raises(ValueError, match="leaf must be one of"):
+        parse_spec("bitflip:genome@update=3")
+    with pytest.raises(ValueError, match="bit must be"):
+        parse_spec("bitflip:merit,bit=40@update=3")
+    with pytest.raises(ValueError, match="save-time kinds"):
+        parse_spec("corrupt-digest@chunk=3")
+
+
+def test_prom_families_empty_when_untouched():
+    """The avida_integrity_* families render only once the plane ran --
+    integrity-off processes publish byte-identical metrics files."""
+    saved = integrity.counters()
+    integrity.reset_for_tests()
+    try:
+        assert integrity.prom_families() == []
+        integrity.note_scrub()
+        fams = {f[0]: f[3] for f in integrity.prom_families()}
+        assert fams["avida_integrity_scrubs_total"] == 1
+        assert fams["avida_integrity_mismatches_total"] == 0
+    finally:
+        integrity.reset_for_tests()
+        for k, v in saved.items():
+            integrity._counters[k] = v
+
+
+def test_digest_on_trajectory_bit_identical(tmp_path):
+    """TPU_STATE_DIGEST + TPU_SCRUB_EVERY change nothing about the
+    evolved trajectory: same seed, same updates, final state
+    bit-identical to a digest-off run -- and the heartbeat-facing
+    state_digest value matches an independent device digest of that
+    final state."""
+    from avida_tpu.core.state import state_field_names
+    from avida_tpu.ops.digest import state_digest
+
+    w_off = _world(tmp_path / "off")
+    w_off.run(max_updates=UPDATES)
+    w_on = _world(tmp_path / "on", extra=[("TPU_STATE_DIGEST", 1),
+                                          ("TPU_SCRUB_EVERY", 2)])
+    w_on.run(max_updates=UPDATES)
+    for name in state_field_names():
+        a, b = getattr(w_off.state, name), getattr(w_on.state, name)
+        if a is None:
+            assert b is None
+            continue
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f"leaf {name}")
+    assert w_on.state_digest is not None
+    u, val = w_on.state_digest
+    assert u == UPDATES
+    assert val == int(state_digest(w_on.state))
+    assert w_on._last_verified_update == UPDATES
+    # the per-chunk runlog records landed
+    recs = [json.loads(line) for line in
+            open(tmp_path / "on" / "integrity.jsonl")]
+    assert any(r["event"] == "digest" for r in recs)
+    assert any(r["event"] == "scrub" and r["ok"] for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# detection: injected bitflip caught by the sampled shadow re-execution
+# ---------------------------------------------------------------------------
+
+def _run_bitflip(tmp, extra=(), at=13):
+    w = _world(tmp, extra=[("TPU_STATE_DIGEST", 1), ("TPU_SCRUB_EVERY", 1),
+                           ("TPU_FAULT", f"bitflip:merit,cell=3@update={at}")
+                           ] + list(extra))
+    with pytest.raises(StateDivergenceError) as exc:
+        w.run(max_updates=UPDATES)
+    return w, str(exc.value)
+
+
+def test_bitflip_detected_xla(tmp_path):
+    """A one-bit, in-bounds, finite flip -- invisible to audit_state by
+    construction -- is caught by the scrub in the chunk where it fired,
+    and the error carries the recovery markers the supervisor parses
+    (last_verified_update, the engine name)."""
+    saved = integrity.counters()
+    integrity.reset_for_tests()
+    try:
+        w, msg = _run_bitflip(tmp_path)
+        assert "last_verified_update=12" in msg
+        assert "engine xla" in msg
+        assert "[12, 16)" in msg
+        assert integrity.counters()["mismatches"] == 1
+        # the flip really was audit-invisible: the corrupted state
+        # passes every invariant
+        from avida_tpu.utils.audit import check_invariants
+        check_invariants(w.params, w.state)
+        # the shadow replay runs the PRISTINE program
+        assert w.params.fault_bitflip == ("merit", 3, 0, 13)
+        assert w._shadow_params().fault_bitflip == ()
+    finally:
+        integrity.reset_for_tests()
+        for k, v in saved.items():
+            integrity._counters[k] = v
+
+
+@pytest.mark.slow
+def test_bitflip_detected_interpret_pallas(tmp_path):
+    """The same detection on the Pallas path (interpret mode on CPU;
+    fault injection forces the per-update kernel engine -- packed
+    residency is ineligible under an armed device fault, like nan).
+    The divergence error names a pallas engine, which is what earns
+    the supervisor's one-shot XLA degradation."""
+    from avida_tpu.ops import packed_chunk
+    w, msg = _run_bitflip(tmp_path, extra=[("TPU_USE_PALLAS", 1)])
+    assert "engine pallas" in msg
+    assert packed_chunk.ineligible_reason(w.params, False) is not None
+
+
+# ---------------------------------------------------------------------------
+# resume digest verification + ckpt_tool sweep
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def ck_run(tmp_path):
+    """A digest-on checkpointed run: generations at updates 8/16/24,
+    each manifest carrying state_digest."""
+    w = _world(tmp_path / "data",
+               extra=[("TPU_STATE_DIGEST", 1),
+                      ("TPU_CKPT_DIR", str(tmp_path / "ck")),
+                      ("TPU_CKPT_EVERY", 8), ("TPU_CKPT_KEEP", 8),
+                      ("TPU_CKPT_FINAL", 1)])
+    w.run(max_updates=UPDATES)
+    return tmp_path, w
+
+
+def test_resume_digest_verify_falls_back(ck_run, tmp_path):
+    """--resume recomputes the restored state's digest against the
+    manifest BEFORE running: a generation whose bytes verify (CRC ok)
+    but whose stored digest does not match falls back past, exactly
+    like a CRC failure, journaled with its own reason."""
+    base, w = ck_run
+    from avida_tpu.utils import checkpoint as ckpt_mod
+    from avida_tpu.utils.faultinject import corrupt_digest
+    gens = ckpt_mod.list_generations(str(base / "ck"))
+    assert len(gens) == 3
+    m = json.load(open(os.path.join(gens[-1], "manifest.json")))
+    assert "state_digest" in m
+    # sanity: every generation verifies before the tamper
+    stored, recomputed = integrity.generation_digest(gens[-1])
+    assert stored == recomputed
+    corrupt_digest(gens[-1])
+    # CRC still passes -- only the digest catches this class
+    ckpt_mod.verify_generation(gens[-1])
+    w2 = _world(tmp_path / "data2",
+                extra=[("TPU_STATE_DIGEST", 1),
+                       ("TPU_CKPT_DIR", str(base / "ck"))])
+    at = w2.resume()
+    assert at == 16                     # fell back past update 24
+    assert w2._last_verified_update == 16
+
+
+def test_ckpt_tool_digest_sweep(ck_run):
+    """ckpt_tool --verify reports DIGEST MISMATCH distinctly from CRC
+    CORRUPT / TORN MANIFEST, and --list --detail prints the stored
+    digest."""
+    base, w = ck_run
+    import ckpt_tool
+    from avida_tpu.utils import checkpoint as ckpt_mod
+    from avida_tpu.utils.faultinject import (corrupt_digest, corrupt_leaf,
+                                             tear_manifest)
+    gens = ckpt_mod.list_generations(str(base / "ck"))
+    ok, status, _ = ckpt_tool.verify_status(gens[0])
+    assert ok and "digest ok" in status
+    corrupt_digest(gens[0])
+    ok, status, _ = ckpt_tool.verify_status(gens[0])
+    assert not ok and status.startswith("DIGEST MISMATCH")
+    corrupt_leaf(gens[1])
+    ok, status, _ = ckpt_tool.verify_status(gens[1])
+    assert not ok and status.startswith("CORRUPT")
+    tear_manifest(gens[2])
+    ok, status, _ = ckpt_tool.verify_status(gens[2])
+    assert not ok and status.startswith("TORN MANIFEST")
+
+
+# ---------------------------------------------------------------------------
+# supervisor: sdc classification + digest-verified rollback (fake clock)
+# ---------------------------------------------------------------------------
+
+def test_classify_sdc():
+    from avida_tpu.service import EXIT_SDC, FAILURE_CLASSES
+    from avida_tpu.service.supervisor import classify
+    assert EXIT_SDC == 67
+    assert "sdc" in FAILURE_CLASSES
+    assert classify(EXIT_SDC) == "sdc"
+    assert classify(0) == "success"
+    assert classify(EXIT_SDC, watchdog_killed=True) == "hang"
+
+
+def _fake_sup(tmp_path, clock=lambda: 1000.0):
+    from avida_tpu.service.supervisor import Supervisor, SupervisorConfig
+    data = tmp_path / "data"
+    os.makedirs(data, exist_ok=True)
+    return Supervisor(
+        ["-d", str(data), "-set", "TPU_CKPT_DIR", str(tmp_path / "ck")],
+        cfg=SupervisorConfig(), env={}, clock=clock, sleep=lambda s: None)
+
+
+def _fake_gen(base, update, value, tamper=False):
+    from avida_tpu.utils import checkpoint as ckpt_mod
+    arrays = {"state.x": np.full(4, value, np.int32)}
+    digest = integrity.digest_arrays(integrity.state_arrays_of(arrays))
+    if tamper:
+        digest ^= 0x10
+    ckpt_mod.write_generation(str(base), update, arrays, host={},
+                              keep=99, extra={"state_digest": digest})
+
+
+def test_sdc_rollback_quarantines_suspects(tmp_path):
+    """The sdc recovery ladder, no processes: generations PAST the
+    child's verified horizon are quarantined, then the survivors are
+    digest-verified newest-first and mismatches quarantined too, so
+    --resume lands on a digest-verified generation."""
+    from avida_tpu.utils import checkpoint as ckpt_mod
+    sup = _fake_sup(tmp_path)
+    ck = tmp_path / "ck"
+    _fake_gen(ck, 8, 1)
+    _fake_gen(ck, 16, 2, tamper=True)   # CRC-valid, digest-corrupt
+    _fake_gen(ck, 24, 3)                # saved past the horizon
+    sup._sdc_rollback(verified_update=16)
+    gens = ckpt_mod.list_generations(str(ck))
+    assert [ckpt_mod.generation_update(g) for g in gens] == [8]
+    bad = [d for d in os.listdir(ck) if d.startswith(".bad-")]
+    assert len(bad) == 2
+    assert sup.rollbacks == 1
+    # no marker in the tail -> the plain newest-generation rollback
+    sup2 = _fake_sup(tmp_path / "two")
+    _fake_gen(tmp_path / "two" / "ck", 8, 1)
+    _fake_gen(tmp_path / "two" / "ck", 16, 2)
+    sup2._sdc_rollback(verified_update=None)
+    gens2 = ckpt_mod.list_generations(str(tmp_path / "two" / "ck"))
+    assert [ckpt_mod.generation_update(g) for g in gens2] == [8]
+
+
+def test_sdc_rollback_never_strands_the_run(tmp_path):
+    """Every generation postdating the horizon: the oldest survives
+    (a wedge into exit 66 would be worse than a self-consistent
+    replay)."""
+    from avida_tpu.utils import checkpoint as ckpt_mod
+    sup = _fake_sup(tmp_path)
+    ck = tmp_path / "ck"
+    _fake_gen(ck, 16, 2)
+    _fake_gen(ck, 24, 3)
+    sup._sdc_rollback(verified_update=8)
+    gens = ckpt_mod.list_generations(str(ck))
+    assert [ckpt_mod.generation_update(g) for g in gens] == [16]
+
+
+def test_quarantine_after_helper(tmp_path):
+    from avida_tpu.utils import checkpoint as ckpt_mod
+    for u in (8, 16, 24):
+        _fake_gen(tmp_path, u, u)
+    removed = ckpt_mod.quarantine_after(str(tmp_path), 8)
+    assert len(removed) == 2
+    assert [ckpt_mod.generation_update(g)
+            for g in ckpt_mod.list_generations(str(tmp_path))] == [8]
+
+
+def test_fleet_breaker_counts_sdc(tmp_path):
+    """An SDC storm trips the fleet circuit breaker like any crash
+    class -- both via supervisor failure diffs (FAILURE_CLASSES grew
+    sdc, so _note_failures picks it up) and via the serve pool's
+    external-failure note."""
+    from avida_tpu.service.fleet import CircuitBreaker
+    br = CircuitBreaker(3, 300.0)
+    assert not br.note_failure("sdc", 0.0)
+    assert not br.note_failure("sdc", 1.0)
+    assert br.note_failure("sdc", 2.0)
+    assert br.is_open(3.0)
+
+
+# ---------------------------------------------------------------------------
+# slow: the end-to-end scrub-rollback chaos drills (real processes)
+# ---------------------------------------------------------------------------
+
+_CHILD_SETS = [(n, str(v)) for n, v in _SETS if n != "RANDOM_SEED"] + [
+    ("TPU_CKPT_EVERY", "8"), ("TPU_CKPT_FINAL", "1"),
+    ("TPU_CKPT_KEEP", "8"), ("TPU_STATE_DIGEST", "1"),
+    ("TPU_SCRUB_EVERY", "2"),
+]
+
+
+def _child_argv(data, ck, extra=()):
+    argv = ["-s", str(SEED), "-u", str(UPDATES), "-d", str(data),
+            "-set", "TPU_CKPT_DIR", str(ck)]
+    for name, value in _CHILD_SETS + list(extra):
+        argv += ["-set", name, str(value)]
+    return argv
+
+
+def _child_env():
+    env = dict(os.environ)
+    env.pop("TPU_FAULT", None)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)   # the PR-6 landmine
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TPU_COMPILE_CACHE"] = "0"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    return env
+
+
+def _drill(tmp_path, ref_arrays, extra=()):
+    """Supervised child with an injected bitflip inside a SCRUBBED
+    chunk (scrub_every=2 x 4-update chunks: [4,8), [12,16), ... are
+    sampled; update 13 lands in [12,16)): detect -> exit 67 -> sdc
+    rollback -> resume clean -> final generation bit-identical to the
+    uninterrupted no-fault reference."""
+    from avida_tpu.service.supervisor import Supervisor, SupervisorConfig
+    from avida_tpu.utils import checkpoint as ckpt_mod
+    data, ck = str(tmp_path / "data"), str(tmp_path / "ck")
+    sup = Supervisor(
+        _child_argv(data, ck, extra=extra),
+        fault_plan=("bitflip:merit,cell=3@update=13",),
+        cfg=SupervisorConfig(watchdog_sec=120.0, poll_sec=0.25,
+                             grace_sec=600.0, max_retries=6,
+                             backoff_base=0.05, backoff_cap=0.2,
+                             healthy_sec=1e9, seed=3),
+        env=_child_env())
+    rc = sup.run()
+    assert rc == 0
+    assert sup.failures["sdc"] == 1
+    recs = [json.loads(line) for line in open(os.path.join(
+        data, "supervisor.jsonl"))]
+    assert any(r.get("event") == "exit" and r.get("class") == "sdc"
+               for r in recs)
+    assert any(r.get("event", "").startswith("sdc_rollback")
+               for r in recs)
+    gens = ckpt_mod.list_generations(ck)
+    manifest, arrays, _ = ckpt_mod.read_generation(gens[-1])
+    assert manifest["update"] == UPDATES
+    assert set(arrays) == set(ref_arrays)
+    for name in sorted(arrays):
+        np.testing.assert_array_equal(arrays[name], ref_arrays[name],
+                                      err_msg=f"array {name}")
+    return sup, recs
+
+
+@pytest.fixture(scope="module")
+def ref_arrays(tmp_path_factory):
+    """Uninterrupted no-fault reference, via the SAME CLI path as the
+    drill children (config parity)."""
+    base = tmp_path_factory.mktemp("integrity_ref")
+    data, ck = str(base / "data"), str(base / "ck")
+    proc = subprocess.run(
+        [sys.executable, "-m", "avida_tpu"] + _child_argv(data, ck),
+        env=_child_env(), capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    from avida_tpu.utils import checkpoint as ckpt_mod
+    gens = ckpt_mod.list_generations(ck)
+    _, arrays, _ = ckpt_mod.read_generation(gens[-1])
+    return arrays
+
+
+@pytest.mark.slow
+def test_scrub_rollback_drill_xla(tmp_path, ref_arrays):
+    sup, recs = _drill(tmp_path, ref_arrays)
+    assert sup.pallas_fallbacks == 0    # xla engine: no degradation
+
+
+@pytest.mark.slow
+def test_scrub_rollback_drill_pallas(tmp_path, ref_arrays):
+    """The kernel-path drill (interpret Pallas on CPU): the divergence
+    error names a pallas engine, so the supervisor applies the one-shot
+    Pallas->XLA degradation on the recovery boot -- and the final state
+    is STILL bit-identical (the engines are bit-exact equals)."""
+    sup, recs = _drill(tmp_path, ref_arrays,
+                       extra=(("TPU_USE_PALLAS", "1"),))
+    assert sup.pallas_fallbacks == 1
+    assert any(r.get("event") == "pallas_fallback" for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# slow: batched + serve flavors
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_multiworld_batched_digests_match_solo(tmp_path):
+    """A W=2 batch with the integrity plane on: per-world digests equal
+    each member's solo digest (same state bits -> same fold), scrub
+    passes, trajectories stay bit-exact vs solo runs."""
+    from avida_tpu.ops.digest import state_digest
+    from avida_tpu.parallel.multiworld import MultiWorld
+    solo = {}
+    for seed in (7, 8):
+        w = _world(tmp_path / f"solo{seed}",
+                   extra=[("RANDOM_SEED", seed)])
+        w.run(max_updates=UPDATES)
+        solo[seed] = int(state_digest(w.state))
+    mw = MultiWorld.from_seeds(
+        [7, 8], overrides=_SETS + [("TPU_STATE_DIGEST", 1),
+                                   ("TPU_SCRUB_EVERY", 2)],
+        data_dir=str(tmp_path / "batch"))
+    mw.run(max_updates=UPDATES)
+    assert mw.state_digests is not None
+    u, vals = mw.state_digests
+    assert u == UPDATES
+    assert vals == [solo[7], solo[8]]
+    assert mw._last_verified_update == UPDATES
+
+
+@pytest.mark.slow
+def test_serve_sdc_demotes_corrupt_tenant_alone(tmp_path):
+    """The serving guarantee: an SDC in ONE tenant's live execution
+    (emulated by corrupting that tenant's slot in the scan output --
+    the shadow replay reproduces the clean result) demotes that tenant
+    alone with its suspect generations quarantined and an `sdc`
+    outcome for the pool, while its classmate keeps serving and
+    finishes bit-exact."""
+    import jax
+    import jax.numpy as jnp
+    from avida_tpu.parallel.multiworld import ServeBatch
+    from avida_tpu.utils import checkpoint as ckpt_mod
+    from avida_tpu.utils import compilecache
+
+    base = tmp_path
+    control = base / "control.json"
+    members = [{"name": f"t{i}", "seed": 7 + i,
+                "data_dir": str(base / f"t{i}" / "data"),
+                "ckpt_dir": str(base / f"t{i}" / "ck"),
+                "max_updates": UPDATES} for i in range(2)]
+    control.write_text(json.dumps(
+        {"width": 2, "members": members}))
+
+    def factory(entry):
+        from avida_tpu.world import World
+        ov = _SETS + [("TPU_STATE_DIGEST", 1), ("TPU_SCRUB_EVERY", 1),
+                      ("RANDOM_SEED", int(entry["seed"]))]
+        if entry.get("ckpt_dir"):
+            ov.append(("TPU_CKPT_DIR", entry["ckpt_dir"]))
+        return World(overrides=[(n, v) for n, v in ov
+                                if n != "RANDOM_SEED"]
+                     + [("RANDOM_SEED", int(entry["seed"]))],
+                     data_dir=entry["data_dir"])
+
+    sb = ServeBatch(2, str(control), str(base / "serve"),
+                    world_factory=factory)
+    assert sb._scrub_every == 1
+    sb._reconcile()
+    assert sb.num_live == 2
+
+    # advance two clean boundaries (scrubbed, passing), with per-tenant
+    # checkpoints so the corrupt tenant has generations to quarantine
+    sb._stack()
+    for _ in range(2):
+        sb._scan(4)
+        sb._sync_worlds()
+        for i, w in sb._live():
+            w.save_checkpoint()
+        sb._stack()
+    assert sb._verified == [8, 8]
+
+    # emulate an SDC in tenant t0's NEXT live chunk: corrupt slot 0 of
+    # the first scan result only -- the shadow replay (second call)
+    # recomputes clean, so the digests diverge exactly like a real
+    # transient flip
+    real_call = compilecache.call
+    armed = {"n": 1}
+
+    def corrupting_call(jit_fn, tag, args, **kw):
+        out = real_call(jit_fn, tag, args, **kw)
+        if tag == "multiworld_scan" and armed["n"]:
+            armed["n"] -= 1
+            bst, outs = out
+            word = jax.lax.bitcast_convert_type(
+                bst.merit[0, 3], jnp.uint32) ^ jnp.uint32(1)
+            bst = bst.replace(merit=bst.merit.at[0, 3].set(
+                jax.lax.bitcast_convert_type(word, bst.merit.dtype)))
+            return bst, outs
+        return out
+
+    saved = integrity.counters()
+    integrity.reset_for_tests()
+    try:
+        # the batched drivers resolve `compilecache.call` through the
+        # module attribute at call time, so patching the module global
+        # intercepts exactly the scan dispatches
+        compilecache.call = corrupting_call
+        sb._scan(4)
+    finally:
+        compilecache.call = real_call
+    assert integrity.counters()["mismatches"] == 1
+    integrity.reset_for_tests()
+    for k, v in saved.items():
+        integrity._counters[k] = v
+
+    # t0 demoted alone, generations past its verified horizon gone
+    assert sb.finished["t0"]["state"] == "sdc"
+    assert sb.finished["t0"]["last_verified_update"] == 8
+    assert sb.num_live == 1
+    assert sb.names.count("t1") == 1
+    gens = ckpt_mod.list_generations(members[0]["ckpt_dir"])
+    assert [ckpt_mod.generation_update(g) for g in gens] == [4, 8]
+
+    # the classmate keeps serving to completion, bit-exact vs solo
+    for _ in range(3):
+        sb._scan(4)
+    sb._sync_worlds()
+    (i1, w1), = sb._live()
+    assert w1.update == UPDATES
+    solo = _world(tmp_path / "solo8", extra=[("RANDOM_SEED", 8)])
+    solo.run(max_updates=UPDATES)
+    np.testing.assert_array_equal(np.asarray(w1.state.merit),
+                                  np.asarray(solo.state.merit))
+    np.testing.assert_array_equal(np.asarray(w1.state.tape),
+                                  np.asarray(solo.state.tape))
