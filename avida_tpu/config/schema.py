@@ -339,6 +339,21 @@ class AvidaConfig:
     # `python -m avida_tpu --status DIR` can watch a live run.  Implied
     # by TPU_TRACE=1.
     TPU_METRICS: int = 0
+    # Telemetry history rings (observability/history.py): every .prom
+    # publish also appends one compact sample row -- wall time, update,
+    # every family value -- to a bounded `.hist.jsonl` ring beside the
+    # snapshot (rotation pair, non-durable appends: the zero-sync
+    # pipeline is never fenced).  The rings feed the alert plane
+    # (observability/alerts.py), the `--status` rate line and
+    # `scripts/metrics_tool.py query`.  Host-side only: trajectories
+    # are bit-identical on or off.  The environment spelling of these
+    # knobs wins over the config file so operators can flip fleets.
+    TPU_METRICS_HIST: int = 1
+    # Sample every K-th publish (1 = heartbeat cadence).
+    TPU_METRICS_HIST_EVERY: int = 1
+    # Ring rotation cap in bytes per file (the live + `.1` pair bounds
+    # disk at twice this).
+    TPU_METRICS_HIST_MAX_BYTES: int = 4 << 20
 
     # In-run analytics (analyze/pipeline.py): 1 = refresh an incremental
     # phenotype census + dominant-lineage replay at checkpoint

@@ -28,6 +28,7 @@ import time
 
 import numpy as np
 
+from avida_tpu.observability import history
 from avida_tpu.utils import compilecache, integrity
 
 METRICS_FILE = "metrics.prom"
@@ -156,23 +157,19 @@ def write_metrics(path: str, text: str, durable: bool = True):
 
 
 def read_metrics(path: str) -> dict:
-    """Parse an exposition file back into {name or name{labels}: float}."""
-    out = {}
+    """Parse an exposition file back into {name or name{labels}: float}
+    (the file flavor of history.parse_exposition -- ONE parser, so ring
+    samples can never disagree with .prom reads)."""
     with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line or line.startswith("#"):
-                continue
-            name, _, value = line.rpartition(" ")
-            try:
-                out[name] = float(value)
-            except ValueError:
-                continue
-    return out
+        return history.parse_exposition(f.read())
 
 
-def format_status(metrics: dict, now: float | None = None) -> str:
-    """Human-readable heartbeat digest of a metrics.prom dict."""
+def format_status(metrics: dict, now: float | None = None,
+                  hist_path: str | None = None) -> str:
+    """Human-readable heartbeat digest of a metrics.prom dict.  With
+    `hist_path` (the metrics history ring beside the snapshot,
+    observability/history.py), a one-line recent-rate summary is
+    appended -- honest "no history" when the ring is absent/short."""
     now = time.time() if now is None else now
     hb = metrics.get("avida_heartbeat_timestamp_seconds")
     age = f"{now - hb:.1f}s ago" if hb else "unknown"
@@ -223,6 +220,9 @@ def format_status(metrics: dict, now: float | None = None) -> str:
             f"{int(metrics.get('avida_integrity_mismatches_total', 0))} "
             f"mismatches")
         lines.append("integrity   " + ", ".join(parts))
+    if hist_path is not None:
+        lines.append("history     "
+                     + history.recent_rate_line(hist_path, now=now))
     if metrics.get("avida_preempted"):
         lines.append("preempted   yes (resume with --resume)")
     return "\n".join(lines)
@@ -312,7 +312,7 @@ def status_main(data_dir: str, max_age: float | None = None) -> int:
               f"TPU_METRICS=1 or TPU_TRACE=1)")
         return 1
     metrics = read_metrics(path)
-    print(format_status(metrics))
+    print(format_status(metrics, hist_path=history.hist_path(path)))
     mw_path = os.path.join(data_dir, MULTIWORLD_METRICS_FILE)
     if os.path.exists(mw_path):
         print(format_multiworld_status(read_metrics(mw_path)))
@@ -324,6 +324,13 @@ def status_main(data_dir: str, max_age: float | None = None) -> int:
         print(f"supervisor  boots {int(sup.get('avida_supervisor_boots_total', 0))}, "
               f"failures {int(fails)}, "
               f"budget {int(sup.get('avida_supervisor_retry_budget', 0))}")
+        # alert column (observability/alerts.py): the supervisor's poll
+        # loop evaluates the rule set over the history rings and
+        # exports firing/fired families on its own .prom file
+        from avida_tpu.observability.alerts import format_alert_status
+        alert_line = format_alert_status(sup)
+        if alert_line is not None:
+            print(alert_line)
     ana_path = os.path.join(data_dir, "analytics.prom")
     if os.path.exists(ana_path):
         print(format_analytics_status(metrics, read_metrics(ana_path)))
@@ -337,6 +344,19 @@ def status_main(data_dir: str, max_age: float | None = None) -> int:
     return 0
 
 
+def _owner_cfg(owner):
+    """The AvidaConfig governing a batch publisher's history knobs: its
+    own cfg when it has one, else the first member world's (every
+    member of a batch shares the static config that matters here)."""
+    cfg = getattr(owner, "cfg", None)
+    if cfg is None:
+        worlds = getattr(owner, "worlds", None) or ()
+        for w in worlds:
+            if w is not None and getattr(w, "cfg", None) is not None:
+                return w.cfg
+    return cfg
+
+
 class MetricsExporter:
     """Owns the metrics.prom path for one World.  `export()` republishes
     synchronously (run exit / preemption -- the values must be final);
@@ -347,9 +367,16 @@ class MetricsExporter:
         self.world = world
         self.path = path or os.path.join(world.data_dir, METRICS_FILE)
         self._pending = None
+        # time-series ring beside the snapshot (observability/history.py):
+        # one compact sample row per publish, TPU_METRICS_HIST* knobs
+        # resolved env-over-config once here
+        self.hist = history.HistorySink(self.path,
+                                        cfg=getattr(world, "cfg", None))
 
     def export(self, world=None):
-        write_metrics(self.path, render_metrics(world or self.world))
+        text = render_metrics(world or self.world)
+        write_metrics(self.path, text)
+        self.hist.publish(text)
 
     def export_deferred(self, world=None):
         """Chunk-boundary publish with the same one-chunk deferral as the
@@ -362,8 +389,9 @@ class MetricsExporter:
         w = world or self.world
         prev, self._pending = self._pending, self._snapshot(w)
         if prev is not None:
-            write_metrics(self.path, self._render_snapshot(prev),
-                          durable=False)
+            text = self._render_snapshot(prev)
+            write_metrics(self.path, text, durable=False)
+            self.hist.publish(text)
 
     @staticmethod
     def _snapshot(w) -> dict:
@@ -438,6 +466,9 @@ class MultiWorldExporter:
         self.path = os.path.join(base, METRICS_FILE)
         self.worlds_path = os.path.join(base, MULTIWORLD_METRICS_FILE)
         self._pending = None
+        cfg = _owner_cfg(mw)
+        self.hist = history.HistorySink(self.path, cfg=cfg)
+        self.worlds_hist = history.HistorySink(self.worlds_path, cfg=cfg)
 
     def export_deferred(self, mw=None):
         m = mw or self.mw
@@ -511,7 +542,9 @@ class MultiWorldExporter:
             "avida_heartbeat_timestamp_seconds": round(time.time(), 3),
         }
         try:
-            write_metrics(self.path, _render(agg, None), durable=durable)
+            text = _render(agg, None)
+            write_metrics(self.path, text, durable=durable)
+            self.hist.publish(text)
             fams = [("avida_multiworld_size", "gauge",
                      "worlds batched into this run", len(snap["names"]))]
             fams += [(name, *_HELP[name],
@@ -530,8 +563,9 @@ class MultiWorldExporter:
             fams.append(("avida_heartbeat_timestamp_seconds",
                          *_HELP["avida_heartbeat_timestamp_seconds"],
                          round(time.time(), 3)))
-            write_metrics(self.worlds_path, render_families(fams),
-                          durable=durable)
+            wtext = render_families(fams)
+            write_metrics(self.worlds_path, wtext, durable=durable)
+            self.worlds_hist.publish(wtext)
         except OSError:
             pass                    # metrics must never kill the batch
 
@@ -601,6 +635,9 @@ class ServeExporter:
         base = path or sb.data_dir
         self.path = os.path.join(base, METRICS_FILE)
         self.worlds_path = os.path.join(base, MULTIWORLD_METRICS_FILE)
+        cfg = _owner_cfg(sb)
+        self.hist = history.HistorySink(self.path, cfg=cfg)
+        self.worlds_hist = history.HistorySink(self.worlds_path, cfg=cfg)
 
     def export(self, sb=None, durable: bool = False):
         from avida_tpu.parallel.multiworld import scan_trace_count
@@ -665,16 +702,17 @@ class ServeExporter:
                 "trips_updates": sb._trips_updates}
         occ = MultiWorldExporter._occupancy_families(snap)
         try:
-            write_metrics(self.path,
-                          render_families(fams + serve_fams),
-                          durable=durable)
+            text = render_families(fams + serve_fams)
+            write_metrics(self.path, text, durable=durable)
+            self.hist.publish(text)
             fams2 = [("avida_multiworld_size", "gauge",
                       "live tenants in this serving batch", sb.num_live)]
             fams2 += per_fams + serve_fams + occ
             fams2.append(("avida_heartbeat_timestamp_seconds",
                           *_HELP["avida_heartbeat_timestamp_seconds"],
                           round(time.time(), 3)))
-            write_metrics(self.worlds_path, render_families(fams2),
-                          durable=durable)
+            wtext = render_families(fams2)
+            write_metrics(self.worlds_path, wtext, durable=durable)
+            self.worlds_hist.publish(wtext)
         except OSError:
             pass                    # metrics must never kill serving

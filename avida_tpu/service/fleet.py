@@ -75,6 +75,8 @@ import subprocess
 import sys
 import time
 
+from avida_tpu.observability import alerts as alerts_mod
+from avida_tpu.observability import history
 from avida_tpu.observability.exporter import (analytics_census_digest,
                                               read_metrics,
                                               render_families,
@@ -279,6 +281,10 @@ class Job:
         self.pid = None                 # newest child pid (journaled)
         self.cancel_requested = False
         self._fail_snapshot: dict = {}
+        # degrade-hint rules currently firing in this job's embedded
+        # supervisor that have already dropped their breadcrumb
+        # (fleet._note_alert_hints; re-armed on resolve)
+        self._alert_hints: set = set()
         # device-lane packing (spec "batch": true): a LEADER job runs
         # one MultiWorld child serving every member; members park in
         # state "batched" with no supervisor of their own
@@ -420,6 +426,31 @@ class FleetOrchestrator:
         self.journal_path = os.path.join(self.spool, JOURNAL_FILE)
         self.metrics_path = os.path.join(self.spool, FLEET_METRICS_FILE)
         os.makedirs(self.spool, exist_ok=True)
+        # fleet-level alert plane (observability/alerts.py): evaluated
+        # over the orchestrator's OWN history ring (fleet.hist.jsonl --
+        # queue depth, breaker state) each poll; per-job rules run in
+        # each job's embedded Supervisor, whose firing set the poll
+        # loop reads in-process (_note_alert_hints).  Rules marked
+        # degrade-hint feed a breadcrumb into the failure tally +
+        # circuit breaker from EITHER layer (admission pause at worst
+        # -- never a kill).
+        self._hist = history.HistorySink(self.metrics_path,
+                                         env=self._base_env)
+        self.alert_eval_sec = float(
+            self._base_env.get("TPU_ALERT_EVAL_SEC", 5.0))
+        self.alerts = None
+        if self.alert_eval_sec > 0:
+            try:
+                self.alerts = alerts_mod.AlertPlane(
+                    alerts_mod.load_rules(self.spool),
+                    journal_path=os.path.join(self.spool,
+                                              alerts_mod.ALERTS_FILE),
+                    max_bytes=self.cfg.journal_max_bytes,
+                    on_transition=self._on_alert)
+            except (OSError, ValueError) as e:
+                print(f"[fleet] alert rules disabled: {e}",
+                      file=sys.stderr)
+        self._alerts_next = 0.0
         self._pending_recovery: dict = {}
         self._recovered = False
         self._shard_cursor = 0
@@ -1129,6 +1160,7 @@ class FleetOrchestrator:
                 self._finish_batch(job)
             return
         self._note_failures(job, now)
+        self._note_alert_hints(job)
         if state not in ("done", "failed"):
             return
         if state == "failed":
@@ -1175,10 +1207,65 @@ class FleetOrchestrator:
         if self.breaker.note_failure(cls, self._clock()):
             self._open_breaker(cls, job)
 
-    def _open_breaker(self, cls: str, job: Job):
+    def _note_alert_hints(self, job: Job):
+        """Degrade-hint breadcrumbs from a job's EMBEDDED supervisor:
+        run-level rules (integrity_mismatch and friends, pinned to the
+        job's own metrics ring) evaluate inside each job's Supervisor,
+        whose AlertPlane the fleet can read in-process -- no file
+        round-trip.  One breadcrumb per firing EDGE per job (the set
+        diff below re-arms a rule once it resolves), into the same
+        failure-tally + circuit-breaker path as _on_alert."""
+        plane = getattr(job.sup, "alerts", None)
+        if plane is None:
+            return
+        firing = set(plane.firing)
+        for name in sorted(firing - job._alert_hints):
+            rule = plane.rules.get(name)
+            if rule is None or rule.action != "degrade-hint":
+                continue
+            self.journal("alert", rule=name, state="firing",
+                         severity=rule.severity, job=job.name)
+            cls = f"alert:{name}"
+            self.failures[cls] = self.failures.get(cls, 0) + 1
+            if self.breaker.note_failure(cls, self._clock()):
+                self._open_breaker(cls, job)
+        job._alert_hints = firing
+
+    def _on_alert(self, rule, state: str, res: dict):
+        """AlertPlane edge hook: every transition journals a fleet
+        event (the alerts.jsonl {"record": "alert"} line is the
+        canonical record; this one correlates it into the fleet
+        timeline), and a FIRING degrade-hint rule drops a breadcrumb
+        into the failure tally + circuit breaker -- the detection
+        plane's only actuator is an admission pause, never a kill."""
+        self.journal("alert", rule=rule.name, state=state,
+                     severity=rule.severity, value=res.get("value"))
+        if state != "firing" or rule.action != "degrade-hint":
+            return
+        cls = f"alert:{rule.name}"
+        self.failures[cls] = self.failures.get(cls, 0) + 1
+        if self.breaker.note_failure(cls, self._clock()):
+            self._open_breaker(cls, None)
+
+    def _eval_alerts(self, now: float):
+        """Evaluate the fleet rule set over fleet.hist.jsonl, at most
+        every alert_eval_sec (TPU_ALERT_EVAL_SEC=0 disables)."""
+        if self.alerts is None or now < self._alerts_next:
+            return
+        self._alerts_next = now + self.alert_eval_sec
+        samples = {"fleet": history.read_samples(
+            history.hist_path(self.metrics_path), tail_bytes=256 << 10)}
+        self.alerts.observe(samples, now)
+
+    def _open_breaker(self, cls: str, job: Job | None):
         self.journal("breaker_open", failure_class=cls,
                      k=self.breaker.k,
-                     window_sec=self.breaker.window_sec, job=job.name)
+                     window_sec=self.breaker.window_sec,
+                     job=job.name if job is not None else "")
+        if job is None or job.sup is None:
+            # alert-breadcrumb storms carry no child outcome to
+            # implicate the kernel path -- pause admissions only
+            return
         out = job.sup.last_outcome
         pallas_storm = (job.sup._xla_fallback
                         or (out is not None and out.pallas))
@@ -1204,6 +1291,7 @@ class FleetOrchestrator:
         closed = self.breaker.maybe_close(now)
         if closed is not None:
             self.journal("breaker_close", failure_class=closed)
+        self._eval_alerts(now)
         if self.serve_pool is not None:
             # settle member outcomes BEFORE admission: a member the
             # child finished must journal `done` before the admit pass
@@ -1252,9 +1340,12 @@ class FleetOrchestrator:
         ]
         if self.serve_pool is not None:
             fams += self.serve_pool.gauges()
+        if self.alerts is not None:
+            fams += self.alerts.families()
         try:
-            write_metrics(self.metrics_path, render_families(fams),
-                          durable=False)
+            text = render_families(fams)
+            write_metrics(self.metrics_path, text, durable=False)
+            self._hist.publish(text)
         except OSError:
             pass
 
@@ -1436,6 +1527,12 @@ def format_fleet_status(spool: str, now: float | None = None) -> str:
             lines.append("breaker     OPEN (admissions paused)")
         if metrics.get("avida_fleet_xla_fallback"):
             lines.append("degraded    fleet-wide XLA fallback active")
+        # fleet-level alert column (observability/alerts.py families
+        # exported by the orchestrator's own poll loop)
+        from avida_tpu.observability.alerts import format_alert_status
+        alert_line = format_alert_status(metrics)
+        if alert_line is not None:
+            lines.append(alert_line)
         lines.append(f"heartbeat   {age}")
     state = spool_job_states(spool)
     leaders = journal_batch_leaders(os.path.join(spool, JOURNAL_FILE))
@@ -1476,6 +1573,12 @@ def format_fleet_status(spool: str, now: float | None = None) -> str:
                             if k.startswith(
                                 "avida_supervisor_failures_total")))
             extra = f"  (boots {boots}, failures {fails})"
+            # per-job alert column: names of rules the job's embedded
+            # supervisor currently reports firing
+            from avida_tpu.observability.alerts import firing_from_metrics
+            firing = firing_from_metrics(sup)["firing"]
+            if firing:
+                extra += "  ALERTS " + ",".join(sorted(firing))
         run_prom = os.path.join(spool, name, "data", "metrics.prom")
         runm = read_metrics(run_prom) if os.path.exists(run_prom) \
             else None

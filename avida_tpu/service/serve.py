@@ -67,6 +67,10 @@ NONSTATIC_VARS = frozenset((
     # batch-level instrumentation: trajectories are bit-identical with
     # it on or off, so its knobs must not split a class either
     "TPU_STATE_DIGEST", "TPU_SCRUB_EVERY",
+    # telemetry history rings (observability/history.py) are host-side
+    # instrumentation too -- sampling cadence cannot split a class
+    "TPU_METRICS_HIST", "TPU_METRICS_HIST_EVERY",
+    "TPU_METRICS_HIST_MAX_BYTES",
 ))
 
 # spec env vars that are per-job operational knobs, not program inputs
@@ -76,6 +80,8 @@ _NONSTATIC_ENV = frozenset((
     "TPU_SUPERVISE_BACKOFF_CAP", "TPU_SUPERVISE_HEALTHY_SEC",
     "TPU_SUPERVISE_SEED", "TPU_PROGRESS_SEC",
     "TPU_COMPILE_CACHE", "TPU_COMPILE_CACHE_DIR",
+    "TPU_METRICS_HIST", "TPU_METRICS_HIST_EVERY",
+    "TPU_METRICS_HIST_MAX_BYTES", "TPU_ALERT_EVAL_SEC",
 ))
 
 

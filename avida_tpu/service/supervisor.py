@@ -61,7 +61,11 @@ import subprocess
 import sys
 import time
 
-from avida_tpu.observability.exporter import (METRICS_FILE, read_metrics,
+from avida_tpu.observability import alerts as alerts_mod
+from avida_tpu.observability import history
+from avida_tpu.observability.exporter import (METRICS_FILE,
+                                              MULTIWORLD_METRICS_FILE,
+                                              read_metrics,
                                               render_families, write_metrics)
 from avida_tpu.observability.runlog import append_record
 from avida_tpu.service import (EXIT_AUDIT, EXIT_CKPT, EXIT_SDC,
@@ -296,6 +300,30 @@ class Supervisor:
         # must not grow supervisor.jsonl without bound
         self.runlog_max_bytes = int(
             self._base_env.get("TPU_RUNLOG_MAX_BYTES", 16 << 20))
+        # alert plane (observability/alerts.py): the poll loop -- which
+        # already reads the child's heartbeat -- additionally evaluates
+        # the declarative rule set over the history rings beside it.
+        # Firing/resolving edges journal to DATA_DIR/alerts.jsonl and
+        # export on supervisor.prom; detection only, the watchdog stays
+        # the sole kill authority.  TPU_ALERT_EVAL_SEC=0 disables.
+        self.alert_eval_sec = float(
+            self._base_env.get("TPU_ALERT_EVAL_SEC", 5.0))
+        self.alerts = None
+        if self.alert_eval_sec > 0:
+            try:
+                self.alerts = alerts_mod.AlertPlane(
+                    alerts_mod.load_rules(self.data_dir),
+                    journal_path=os.path.join(self.data_dir,
+                                              alerts_mod.ALERTS_FILE),
+                    max_bytes=self.runlog_max_bytes)
+            except (OSError, ValueError) as e:
+                # a malformed alerts.json must be loud but must not
+                # take supervision down with it
+                print(f"[supervisor] alert rules disabled: {e}",
+                      file=sys.stderr)
+        self._alerts_next = 0.0
+        self._hist = history.HistorySink(self.metrics_path,
+                                         env=self._base_env)
 
     # ---- plumbing ----
 
@@ -348,11 +376,62 @@ class Supervisor:
              "the previous child's exit code (negative = signal)",
              self.last_exit_code),
         ]
+        if self.alerts is not None:
+            fams += self.alerts.families()
         try:
-            write_metrics(self.metrics_path, render_families(fams),
-                          durable=False)
+            text = render_families(fams)
+            write_metrics(self.metrics_path, text, durable=False)
+            self._hist.publish(text)
         except OSError:
             pass
+
+    def _eval_alerts(self):
+        """Evaluate the alert rules over the child's history rings, at
+        most every alert_eval_sec.  Runs while a child is alive or
+        backing off -- a hung or backing-off child keeps its
+        staleness/stall alerts honest -- but NOT in the idle state
+        (nothing has launched yet; a resume's leftover ring from the
+        previous incarnation is evidence of the past, not of a child
+        that does not exist), and not against a ring that predates the
+        current boot (the compile window of a resumed run would
+        otherwise page `stall` on the old incarnation's final samples;
+        alert state is FROZEN, not resolved, while evaluation is
+        paused, so an alert that fired before a restart stays firing
+        until post-launch samples clear it)."""
+        if self.alerts is None or self.state == "idle":
+            return
+        now = self._clock()
+        if now < self._alerts_next:
+            return
+        self._alerts_next = now + self.alert_eval_sec
+        # rings are handed to the evaluator SEPARATELY (never merged):
+        # on a serve child metrics.prom carries the batch-max counter
+        # while multiworld.prom carries per-tenant rows -- one family,
+        # two meanings (alerts.samples_for)
+        samples = {
+            "metrics": history.read_samples(
+                history.hist_path(os.path.join(self.data_dir,
+                                               METRICS_FILE)),
+                tail_bytes=256 << 10),
+            "multiworld": history.read_samples(
+                history.hist_path(os.path.join(
+                    self.data_dir, MULTIWORLD_METRICS_FILE)),
+                tail_bytes=256 << 10),
+        }
+        if self.state == "running" and self._ctx is not None:
+            newest = max((s.get("time", 0.0) for rows in samples.values()
+                          for s in rows), default=None)
+            if newest is not None and newest < self._ctx.t0:
+                return          # previous incarnation's ring (see above)
+        transitions = self.alerts.observe(samples, now)
+        for name, state, res in transitions:
+            val = res.get("value")
+            print(f"[supervisor] alert {name} {state}"
+                  + (f" (value {val})" if val is not None else ""),
+                  file=sys.stderr)
+        if transitions:
+            self.publish_metrics(child_up=self._proc is not None
+                                 and self._proc.poll() is None)
 
     def _read_heartbeat(self):
         path = os.path.join(self.data_dir, METRICS_FILE)
@@ -641,6 +720,17 @@ class Supervisor:
     def _terminal(self, state: str, rc: int):
         self.state = state
         self.exit_rc = rc
+        # final alert sweep, throttle bypassed: the child's last
+        # durable export is on disk BEFORE its exit is observable, so
+        # evaluating here deterministically resolves a stall/staleness
+        # alert the recovery cleared -- without it, a child that exits
+        # within one alert_eval_sec of resolving leaves the journal
+        # (and avida_alerts_firing) claiming a live alert forever.
+        # Only once a boot actually ran: a supervisor preempted before
+        # its first launch has no child evidence to sweep
+        if self.alerts is not None and self.boots > 0:
+            self._alerts_next = 0.0
+            self._eval_alerts()
 
     # ---- the non-blocking interface (one supervisor among many) ----
 
@@ -654,6 +744,7 @@ class Supervisor:
         loop."""
         if self.state in ("done", "failed"):
             return self.state
+        self._eval_alerts()
         if self.state == "idle":
             if self._stop:
                 # preempted before the first boot: exit NOW -- launching

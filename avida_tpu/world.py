@@ -249,7 +249,12 @@ class World:
             self.tracer = FlightRecorder(self)
 
         # metrics.prom heartbeat (observability/exporter.py): rewritten
-        # atomically at chunk boundaries; implied by the flight recorder
+        # atomically at chunk boundaries; implied by the flight
+        # recorder.  Each publish also appends one sample row to the
+        # metrics.hist.jsonl ring beside it (observability/history.py,
+        # TPU_METRICS_HIST knobs resolved env-over-config by the
+        # exporter's sink) -- the alert plane and `--status` history
+        # line read that ring, never this process
         self.exporter = None
         if int(cfg.get("TPU_METRICS", 0)) or self.tracer is not None:
             from avida_tpu.observability.exporter import MetricsExporter

@@ -4,6 +4,19 @@ Usage:
     python scripts/trace_tool.py to-chrome telemetry.jsonl [-o trace.json]
     python scripts/trace_tool.py from-chrome trace.json    [-o trace.jsonl]
     python scripts/trace_tool.py summary   telemetry.jsonl
+    python scripts/trace_tool.py fleet     SPOOL           [-o trace.json]
+
+`fleet` merges a whole spool's journals -- fleet.jsonl, every job's
+supervisor.jsonl, the alert journals (alerts.jsonl at both layers,
+observability/alerts.py) and each job's metrics history ring
+(observability/history.py) -- into ONE wall-clock-correlated Perfetto
+trace: one process track per job (plus one for the orchestrator),
+spans for admit->terminal and for every supervisor boot, spans for
+firing->resolved alerts, a per-job `avida_update` counter track with
+chunk-boundary spans from the history ring, and instant events for
+injected faults, watchdog kills, rollbacks, SDC exits and breaker
+trips -- so a churn drill or an incident reads as a single correlated
+timeline instead of five journals diffed by hand.
 
 `to-chrome` renders a run's telemetry.jsonl -- the per-update phase
 wall-time records ({"record": "update"}, PR 1's Timeline) and the
@@ -233,15 +246,220 @@ def summary(path: str) -> str:
     return "\n".join(lines)
 
 
+# ---------------------------------------------------------------------------
+# fleet mode: one correlated timeline for a whole spool
+# ---------------------------------------------------------------------------
+
+_FLEET_PID = 1
+_JOB_PID_BASE = 10
+
+# instant-worthy supervisor events and the fleet events that mark a
+# job's lifecycle edges
+_SUP_INSTANTS = ("watchdog_kill", "rollback", "sdc_rollback",
+                 "sdc_digest_quarantine", "pallas_fallback",
+                 "anomaly_detected", "backoff", "budget_reset",
+                 "checkpoint_fallback_observed", "giving_up")
+_TERMINAL_EVENTS = ("done", "failed", "cancelled", "requeued",
+                    "quarantined")
+
+
+def _job_names(spool: str, fleet_recs: list) -> list:
+    names = {rec["job"] for rec in fleet_recs
+             if isinstance(rec.get("job"), str) and rec["job"]}
+    for entry in sorted(os.listdir(spool)) if os.path.isdir(spool) else ():
+        if os.path.isdir(os.path.join(spool, entry, "data")):
+            names.add(entry)
+    return sorted(names)
+
+
+def _span(name, pid, tid, t0, t1, base, **args_):
+    return {"name": name, "ph": "X", "pid": pid, "tid": tid,
+            "ts": (t0 - base) * 1e6,
+            "dur": max((t1 - t0) * 1e6, 1.0), "args": args_}
+
+
+def _instant(name, pid, tid, t, base, **args_):
+    return {"name": name, "ph": "i", "pid": pid, "tid": tid,
+            "ts": (t - base) * 1e6, "s": "t", "args": args_}
+
+
+def _alert_spans(journal_path, pid, tid, base, t_end, events):
+    """firing->resolved alert spans (+ instants on the edges) from an
+    alerts.jsonl rotation pair; an unresolved alert spans to t_end."""
+    from avida_tpu.observability.alerts import read_alert_records
+    open_since = {}
+    for rec in read_alert_records(journal_path):
+        rule, t = rec.get("rule"), float(rec.get("time", 0.0))
+        if rec.get("state") == "firing":
+            open_since[rule] = (t, rec)
+        elif rec.get("state") == "resolved" and rule in open_since:
+            t0, fire_rec = open_since.pop(rule)
+            events.append(_span(f"alert:{rule}", pid, tid, t0, t, base,
+                                severity=fire_rec.get("severity"),
+                                value=fire_rec.get("value")))
+    for rule, (t0, fire_rec) in open_since.items():
+        events.append(_span(f"alert:{rule} (unresolved)", pid, tid, t0,
+                            max(t_end, t0), base,
+                            severity=fire_rec.get("severity"),
+                            value=fire_rec.get("value")))
+
+
+def fleet_trace(spool: str) -> dict:
+    """The merged Chrome/Perfetto trace dict for one spool."""
+    from avida_tpu.observability import history
+    from avida_tpu.observability.runlog import read_records
+
+    fleet_recs = [r for r in
+                  read_records(os.path.join(spool, "fleet.jsonl"))
+                  if r.get("record") == "fleet"]
+    names = _job_names(spool, fleet_recs)
+    # every journal is read up front so base/t_end span ALL layers --
+    # open-ended spans ("live" boots, unresolved alerts) must end at
+    # the global horizon, not at whichever journal happened to be read
+    # before them
+    sup_by_job = {name: [r for r in read_records(os.path.join(
+        spool, name, "data", "supervisor.jsonl"))
+        if r.get("record") == "supervisor"] for name in names}
+    ring_by_job = {name: history.read_samples(history.hist_path(
+        os.path.join(spool, name, "data", "metrics.prom")))
+        for name in names}
+    times = [float(r.get("time", 0.0)) for r in fleet_recs
+             if r.get("time")]
+    for recs in sup_by_job.values():
+        times += [float(r.get("time", 0.0)) for r in recs
+                  if r.get("time")]
+    for samples in ring_by_job.values():
+        times += [float(r.get("time", 0.0)) for r in samples]
+    from avida_tpu.observability.alerts import read_alert_records
+    for p in ([os.path.join(spool, "alerts.jsonl")]
+              + [os.path.join(spool, n, "data", "alerts.jsonl")
+                 for n in names]):
+        times += [float(r.get("time", 0.0))
+                  for r in read_alert_records(p) if r.get("time")]
+    base = min(times) if times else 0.0
+    t_end = max(times) if times else 0.0
+
+    events = [{"name": "process_name", "ph": "M", "pid": _FLEET_PID,
+               "tid": 0, "args": {"name": f"fleet {spool}"}},
+              {"name": "thread_name", "ph": "M", "pid": _FLEET_PID,
+               "tid": 1, "args": {"name": "orchestrator"}},
+              {"name": "thread_name", "ph": "M", "pid": _FLEET_PID,
+               "tid": 2, "args": {"name": "alerts"}}]
+    job_pid = {n: _JOB_PID_BASE + i for i, n in enumerate(names)}
+
+    # ---- fleet orchestrator track ----
+    admit_t, terminal_t = {}, {}
+    for rec in fleet_recs:
+        ev, t = rec.get("event"), float(rec.get("time", 0.0))
+        job = rec.get("job")
+        if ev == "admit" and job:
+            admit_t.setdefault(job, t)
+        if ev in _TERMINAL_EVENTS and job:
+            terminal_t[job] = (t, ev)
+        if ev in ("fleet_start", "fleet_stop", "breaker_open",
+                  "breaker_close", "xla_fallback", "alert", "drain",
+                  "coalesced", "batch_fallback", "degrade_hint",
+                  "serve_class", "serve_reattach"):
+            args_ = {k: v for k, v in rec.items()
+                     if k not in ("record", "time")}
+            events.append(_instant(ev, _FLEET_PID, 1, t, base, **args_))
+    _alert_spans(os.path.join(spool, "alerts.jsonl"), _FLEET_PID, 2,
+                 base, t_end, events)
+
+    # ---- one process per job ----
+    for name in names:
+        pid = job_pid[name]
+        data = os.path.join(spool, name, "data")
+        events += [
+            {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": f"job {name}"}},
+            {"name": "thread_name", "ph": "M", "pid": pid, "tid": 1,
+             "args": {"name": "lifecycle"}},
+            {"name": "thread_name", "ph": "M", "pid": pid, "tid": 2,
+             "args": {"name": "boots"}},
+            {"name": "thread_name", "ph": "M", "pid": pid, "tid": 3,
+             "args": {"name": "alerts"}},
+            {"name": "thread_name", "ph": "M", "pid": pid, "tid": 4,
+             "args": {"name": "chunks"}},
+        ]
+        # admit -> terminal lifecycle span from the fleet journal
+        if name in admit_t:
+            t1, how = terminal_t.get(name, (t_end, "live"))
+            events.append(_span(f"{name} [{how}]", pid, 1,
+                                admit_t[name], max(t1, admit_t[name]),
+                                base, outcome=how))
+        # supervisor boots + instants
+        launch = {}
+        for rec in sup_by_job[name]:
+            ev = rec.get("event")
+            t = float(rec.get("time", 0.0))
+            boot = int(rec.get("boot", 0))
+            if ev == "launch":
+                launch[boot] = (t, rec.get("fault") or "")
+                if rec.get("fault"):
+                    events.append(_instant(
+                        f"fault:{rec['fault']}", pid, 2, t, base,
+                        boot=boot))
+            elif ev == "exit" and boot in launch:
+                t0, fault = launch.pop(boot)
+                events.append(_span(
+                    f"boot {boot} [{rec.get('class')}]", pid, 2, t0, t,
+                    base, exit_class=rec.get("class"),
+                    code=rec.get("code"), update=rec.get("update"),
+                    fault=fault))
+                if rec.get("class") == "sdc":
+                    events.append(_instant("sdc", pid, 2, t, base,
+                                           code=rec.get("code")))
+            elif ev in _SUP_INSTANTS:
+                args_ = {k: v for k, v in rec.items()
+                         if k not in ("record", "time", "stderr_tail")}
+                events.append(_instant(ev, pid, 2, t, base, **args_))
+        for boot, (t0, fault) in launch.items():
+            events.append(_span(f"boot {boot} [live]", pid, 2, t0,
+                                max(t_end, t0), base, fault=fault))
+        # per-job alert spans
+        _alert_spans(os.path.join(data, "alerts.jsonl"), pid, 3, base,
+                     t_end, events)
+        # update-counter track + chunk spans from the history ring
+        samples = ring_by_job[name]
+        prev = None
+        for rec in samples:
+            t = float(rec.get("time", 0.0))
+            u = rec.get("update")
+            if u is None:
+                continue
+            events.append({"name": "avida_update", "ph": "C",
+                           "pid": pid, "tid": 4,
+                           "ts": (t - base) * 1e6,
+                           "args": {"update": u}})
+            if prev is not None and t > prev[0] and u > prev[1]:
+                events.append(_span(f"chunk ->u{u}", pid, 4, prev[0], t,
+                                    base, updates=u - prev[1]))
+            prev = (t, u)
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"spool": spool, "jobs": names,
+                          "base_unix_time": base}}
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("mode", choices=["to-chrome", "from-chrome", "summary"])
+    p.add_argument("mode", choices=["to-chrome", "from-chrome", "summary",
+                                    "fleet"])
     p.add_argument("path")
     p.add_argument("-o", "--out", default=None)
     args = p.parse_args(argv)
 
     if args.mode == "summary":
         print(summary(args.path))
+        return 0
+    if args.mode == "fleet":
+        doc = fleet_trace(args.path)
+        out = args.out or os.path.join(args.path, "fleet.trace.json")
+        with open(out, "w") as f:
+            json.dump(doc, f)
+        print(f"{out}: {len(doc['traceEvents'])} trace events across "
+              f"{len(doc['otherData']['jobs'])} job(s) "
+              f"(open in chrome://tracing or ui.perfetto.dev)")
         return 0
     if args.mode == "to-chrome":
         doc = to_chrome(args.path)

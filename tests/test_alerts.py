@@ -1,0 +1,965 @@
+"""Telemetry history + alert plane (observability/history.py,
+observability/alerts.py) and their wiring through the exporters, the
+supervisor/fleet poll loops and the ops tooling.
+
+Fast tier is host-only where possible (fake clocks, synthetic rings, no
+subprocesses); the two world-compiling tests (bit-identity with history
+on/off, jaxpr gate) share one small compiled program.  The real
+end-to-end hang drill -- TPU_FAULT=hang, stall alert fires and journals
+BEFORE the watchdog kill, resolves after recovery -- is slow-marked.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from avida_tpu.observability import alerts, history
+from avida_tpu.service.supervisor import Supervisor, SupervisorConfig
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+import metrics_tool  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# history rings
+# ---------------------------------------------------------------------------
+
+def test_hist_path_mapping():
+    assert history.hist_path("/d/metrics.prom") == "/d/metrics.hist.jsonl"
+    assert history.hist_path("/d/fleet.prom") == "/d/fleet.hist.jsonl"
+    assert history.hist_path("/d/odd.txt") == "/d/odd.txt.hist.jsonl"
+
+
+def test_parse_exposition_matches_read_metrics_semantics():
+    text = ("# HELP avida_update updates\n# TYPE avida_update counter\n"
+            "avida_update 12\n"
+            'avida_trace_code_total{code="birth"} 3\n'
+            "garbage line without number trailing\n")
+    v = history.parse_exposition(text)
+    assert v["avida_update"] == 12.0
+    assert v['avida_trace_code_total{code="birth"}'] == 3.0
+    assert len(v) == 2
+
+
+def test_append_read_roundtrip_and_update_field(tmp_path):
+    ring = str(tmp_path / "metrics.hist.jsonl")
+    for i in range(5):
+        history.append_sample(ring, {"avida_update": i * 4, "x": 1.5},
+                              now=100.0 + i)
+    samples = history.read_samples(ring)
+    assert [s["update"] for s in samples] == [0, 4, 8, 12, 16]
+    assert [s["time"] for s in samples] == [100.0, 101.0, 102.0, 103.0,
+                                            104.0]
+    assert samples[-1]["v"]["x"] == 1.5
+    # windowing and tail reads see the newest rows
+    assert len(history.read_samples(ring, window_sec=2.5, now=104.0)) == 3
+    tail = history.read_samples(ring, tail_bytes=200)
+    assert tail and tail[-1]["update"] == 16 and len(tail) < 5
+
+
+def test_ring_rotation_mid_append_stays_bounded(tmp_path):
+    ring = str(tmp_path / "metrics.hist.jsonl")
+    cap = 2048
+    for i in range(200):
+        history.append_sample(ring, {"avida_update": i, "pad": 123456.0},
+                              now=1000.0 + i, max_bytes=cap)
+    # the pair is bounded: live file under the cap, exactly one aside
+    assert os.path.getsize(ring) <= cap
+    assert os.path.exists(ring + ".1")
+    assert os.path.getsize(ring + ".1") <= cap
+    samples = history.read_samples(ring)
+    # newest sample survived, ordering holds across the rotation seam
+    assert samples[-1]["update"] == 199
+    upds = [s["update"] for s in samples]
+    assert upds == sorted(upds)
+    # a torn tail (crash mid-append) is skipped, not fatal
+    with open(ring, "a") as f:
+        f.write('{"record": "sample", "time": 99')
+    assert history.read_samples(ring)[-1]["update"] == 199
+
+
+def test_sink_knobs_off_and_every(tmp_path):
+    prom = str(tmp_path / "metrics.prom")
+    text = "avida_update 7\n"
+    off = history.HistorySink(prom, env={"TPU_METRICS_HIST": "0"})
+    off.publish(text)
+    assert not os.path.exists(history.hist_path(prom))       # true no-op
+    every = history.HistorySink(prom, env={"TPU_METRICS_HIST_EVERY": "3"})
+    for _ in range(7):
+        every.publish(text)
+    assert len(history.read_samples(history.hist_path(prom))) == 3
+
+
+def test_sink_env_wins_over_cfg(tmp_path):
+    from avida_tpu.config import AvidaConfig
+    cfg = AvidaConfig()
+    cfg.TPU_METRICS_HIST = 0
+    prom = str(tmp_path / "metrics.prom")
+    assert not history.HistorySink(prom, env={}, cfg=cfg).knobs.enabled
+    assert history.HistorySink(prom, env={"TPU_METRICS_HIST": "1"},
+                               cfg=cfg).knobs.enabled
+
+
+def _mk_samples(values_by_time):
+    return [{"record": "sample", "time": t, "v": v}
+            for t, v in sorted(values_by_time.items())]
+
+
+def test_series_labeled_max_and_filter():
+    samples = _mk_samples({
+        1.0: {'f{world="a"}': 2.0, 'f{world="b"}': 5.0, "g": 1.0}})
+    assert history.series(samples, "f") == [(1.0, 5.0)]
+    assert history.series(samples, "f", labels='world="a"') == [(1.0, 2.0)]
+    assert history.series(samples, "g") == [(1.0, 1.0)]
+
+
+def test_summarize_quantiles_and_rate():
+    samples = _mk_samples({float(t): {"c": float(t * 2)}
+                           for t in range(10, 21)})
+    d = history.summarize(samples, "c", now=20.0)
+    assert d["count"] == 11 and d["min"] == 20.0 and d["max"] == 40.0
+    assert d["p50"] == 30.0
+    assert d["rate_per_sec"] == 2.0
+    assert history.summarize(samples, "absent")["count"] == 0
+
+
+def test_prune_trims_live_and_drops_aside(tmp_path):
+    ring = str(tmp_path / "metrics.hist.jsonl")
+    for i in range(300):
+        history.append_sample(ring, {"avida_update": i}, now=float(i),
+                              max_bytes=4096)
+    res = history.prune(ring, keep_bytes=512)
+    assert res["removed_bytes"] > 0
+    assert not os.path.exists(ring + ".1")
+    assert os.path.getsize(ring) <= 512
+    # the survivors are the NEWEST rows, whole lines only
+    samples = history.read_samples(ring)
+    assert samples and samples[-1]["update"] == 299
+
+
+# ---------------------------------------------------------------------------
+# alert rules: threshold / rate / staleness / for-duration / resolve
+# ---------------------------------------------------------------------------
+
+def test_threshold_rule_fires_and_resolves():
+    r = alerts.Rule("hot", "q", "threshold", 3.0, op=">")
+    low = _mk_samples({100.0: {"q": 1.0}})
+    high = _mk_samples({100.0: {"q": 1.0}, 101.0: {"q": 9.0}})
+    assert not alerts.evaluate_rule(r, low, 101.0)["firing"]
+    res = alerts.evaluate_rule(r, high, 102.0)
+    assert res["firing"] and res["value"] == 9.0
+    # resolve: newest value back under the line
+    back = high + _mk_samples({103.0: {"q": 2.0}})
+    assert not alerts.evaluate_rule(r, back, 104.0)["firing"]
+    # no data at all: never fires
+    assert not alerts.evaluate_rule(r, [], 104.0)["firing"]
+
+
+def test_threshold_for_duration_delays_firing():
+    r = alerts.Rule("hot", "q", "threshold", 3.0, op=">", for_sec=10.0)
+    samples = _mk_samples({100.0: {"q": 1.0}, 105.0: {"q": 9.0}})
+    # condition just started: held only 5s of the required 10
+    assert not alerts.evaluate_rule(r, samples, 110.0)["firing"]
+    # still high at every as-of point across the window -> fires
+    samples += _mk_samples({112.0: {"q": 8.0}})
+    res = alerts.evaluate_rule(r, samples, 116.0)
+    assert res["firing"] and res["since"] == 106.0
+    # a dip inside the window resets the clock
+    dipped = samples + _mk_samples({117.0: {"q": 1.0},
+                                    118.0: {"q": 9.0}})
+    assert not alerts.evaluate_rule(r, dipped, 120.0)["firing"]
+
+
+def test_rate_stall_semantics():
+    r = alerts.Rule("stall", "avida_update", "rate", 0.0, op="<=",
+                    window_sec=60.0)
+    # young ring (does not span the window yet): not evaluable, no fire
+    young = _mk_samples({100.0: {"avida_update": 5.0},
+                         110.0: {"avida_update": 5.0}})
+    assert not alerts.evaluate_rule(r, young, 120.0)["firing"]
+    # flat counter across the window while publishes continue: fires
+    flat = _mk_samples({float(t): {"avida_update": 42.0}
+                        for t in range(100, 200, 10)})
+    assert alerts.evaluate_rule(r, flat, 190.0)["firing"]
+    # publisher STOPPED (hung chunk): newest sample predates the whole
+    # window -- the counter definitionally went flat, still fires
+    assert alerts.evaluate_rule(r, flat, 400.0)["firing"]
+    # advancing counter: resolves
+    moving = flat + _mk_samples({float(t): {"avida_update": 42.0 + t}
+                                 for t in range(200, 280, 10)})
+    assert not alerts.evaluate_rule(r, moving, 270.0)["firing"]
+
+
+def test_staleness_rule_and_empty_ring_honesty():
+    r = alerts.Rule("stale", "avida_heartbeat_timestamp_seconds",
+                    "staleness", 30.0)
+    samples = _mk_samples(
+        {100.0: {"avida_heartbeat_timestamp_seconds": 100.0}})
+    assert not alerts.evaluate_rule(r, samples, 120.0)["firing"]
+    res = alerts.evaluate_rule(r, samples, 140.0)
+    assert res["firing"] and res["value"] == 40.0
+    # an empty ring is no evidence of staleness
+    assert not alerts.evaluate_rule(r, [], 1e9)["firing"]
+
+
+def test_threshold_below_rules_see_the_worst_labeled_series():
+    # one healthy world must not mask seven collapsed ones: below-
+    # threshold rules aggregate labeled rows with min, not max
+    r = alerts.Rule("collapse", "eff", "threshold", 0.2, op="<")
+    samples = _mk_samples({100.0: {'eff{world="a"}': 0.05,
+                                   'eff{world="b"}': 0.9}})
+    res = alerts.evaluate_rule(r, samples, 101.0)
+    assert res["firing"] and res["value"] == 0.05
+    # direction-matched: an above-threshold rule still sees the max
+    r_hi = alerts.Rule("hot", "eff", "threshold", 0.8, op=">")
+    assert alerts.evaluate_rule(r_hi, samples, 101.0)["value"] == 0.9
+
+
+def test_ring_pinned_rules_never_merge_rings():
+    # the serve-batch trap: metrics ring carries the batch-max counter
+    # (advancing), the multiworld ring per-tenant rows where a freshly
+    # admitted tenant rides at update 0 -- merged, the stall rule's
+    # min-collapsed series would sawtooth into a false page
+    metrics = _mk_samples({float(t): {"avida_update": 5000.0 + t}
+                           for t in range(100, 200, 5)})
+    mworld = _mk_samples({float(t): {'avida_update{world="lead"}':
+                                     5000.0 + t,
+                                     'avida_update{world="fresh"}':
+                                     float(t - 150) if t >= 150 else 0.0}
+                          for t in range(100, 200, 5)})
+    stall = next(r for r in alerts.default_rules() if r.name == "stall")
+    assert stall.ring == "metrics"
+    by_ring = {"metrics": metrics, "multiworld": mworld}
+    res = alerts.evaluate([stall], by_ring, 195.0)
+    assert not res["stall"]["firing"]
+    # and a rule pinned to a ring the evaluator does not own is inert
+    qg = next(r for r in alerts.default_rules()
+              if r.name == "queue_growth")
+    assert qg.ring == "fleet"
+    assert not alerts.evaluate([qg], by_ring, 195.0)["queue_growth"][
+        "firing"]
+    # an unpinned custom rule still sees the concatenation
+    anyr = alerts.Rule("any", "avida_update", "threshold", 1.0, op=">")
+    assert alerts.evaluate([anyr], by_ring, 195.0)["any"]["firing"]
+
+
+def test_staleness_for_sec_folds_into_threshold():
+    r = alerts.Rule("stale", "hb", "staleness", 30.0, for_sec=20.0)
+    samples = _mk_samples({100.0: {"hb": 100.0}})
+    # age 40 > 30 but the 20s hold has not elapsed yet
+    assert not alerts.evaluate_rule(r, samples, 140.0)["firing"]
+    res = alerts.evaluate_rule(r, samples, 151.0)     # age 51 > 30+20
+    assert res["firing"] and res["since"] == 150.0
+
+
+def test_rule_validation_rejects_garbage():
+    with pytest.raises(ValueError, match="unknown kind"):
+        alerts.Rule("x", "f", "derivative", 1.0)
+    with pytest.raises(ValueError, match="unknown op"):
+        alerts.Rule("x", "f", "threshold", 1.0, op="~")
+    with pytest.raises(ValueError, match="unknown field"):
+        alerts.Rule.from_dict({"name": "x", "family": "f",
+                               "kind": "threshold", "value": 1,
+                               "threshold": 2})
+    with pytest.raises(ValueError, match="needs 'value'"):
+        alerts.Rule.from_dict({"name": "x", "family": "f",
+                               "kind": "threshold"})
+    # null/garbage numerics and non-object entries must surface as
+    # ValueError -- the one class the supervisor/fleet alert-disable
+    # guards catch (a TypeError here would crash supervision at boot)
+    with pytest.raises(ValueError, match="non-numeric"):
+        alerts.Rule.from_dict({"name": "x", "family": "f",
+                               "kind": "threshold", "value": None})
+    with pytest.raises(ValueError, match="JSON object"):
+        alerts.Rule.from_dict(["not", "a", "rule"])
+
+
+def test_load_rules_defaults_and_overrides(tmp_path):
+    names = {r.name for r in alerts.load_rules()}
+    assert {"heartbeat_stale", "stall", "batch_efficiency_collapse",
+            "queue_growth", "integrity_mismatch",
+            "compile_cache_errors"} <= names
+    with open(tmp_path / "alerts.json", "w") as f:
+        json.dump([
+            {"name": "stall", "family": "avida_update", "kind": "rate",
+             "op": "<=", "value": 0.0, "window_sec": 7.0},
+            {"name": "queue_growth", "family": "avida_fleet_queue_depth",
+             "kind": "rate", "value": 0, "enabled": False},
+            {"name": "custom", "family": "avida_organisms",
+             "kind": "threshold", "op": "<", "value": 2.0},
+        ], f)
+    loaded = {r.name: r for r in alerts.load_rules(str(tmp_path))}
+    assert loaded["stall"].window_sec == 7.0          # replaced by name
+    assert "queue_growth" not in loaded               # disabled
+    assert loaded["custom"].op == "<"                 # extended
+    assert "heartbeat_stale" in loaded                # defaults survive
+    with open(tmp_path / "alerts.json", "w") as f:
+        f.write("{}")
+    with pytest.raises(ValueError, match="JSON list"):
+        alerts.load_rules(str(tmp_path))
+
+
+def test_alert_plane_edges_journal_and_families(tmp_path):
+    journal = str(tmp_path / "alerts.jsonl")
+    rule = alerts.Rule("hot", "q", "threshold", 3.0, op=">",
+                       severity="page")
+    plane = alerts.AlertPlane([rule], journal_path=journal)
+    high = _mk_samples({100.0: {"q": 9.0}})
+    assert plane.observe(high, 101.0) == [
+        ("hot", "firing", {"firing": True, "value": 9.0, "since": 101.0})]
+    # steady state: no new edge, no new journal line
+    assert plane.observe(high, 102.0) == []
+    low = high + _mk_samples({103.0: {"q": 1.0}})
+    trans = plane.observe(low, 104.0)
+    assert [(t[0], t[1]) for t in trans] == [("hot", "resolved")]
+    recs = [json.loads(line) for line in open(journal)]
+    assert [(r["record"], r["state"]) for r in recs] == [
+        ("alert", "firing"), ("alert", "resolved")]
+    assert recs[0]["severity"] == "page" and recs[0]["rule"] == "hot"
+    fams = {name: (kind, value) for name, kind, _, value
+            in plane.families()}
+    assert fams["avida_alerts_firing"][1] == {'rule="hot"': 0}
+    assert fams["avida_alerts_fired_total"][1] == {'rule="hot"': 1}
+    assert alerts.read_alert_records(journal) == recs
+
+
+def test_firing_from_metrics_and_status_line():
+    m = {'avida_alerts_firing{rule="stall"}': 1.0,
+         'avida_alerts_firing{rule="hot"}': 0.0,
+         'avida_alerts_fired_total{rule="stall"}': 3.0,
+         'avida_alerts_fired_total{rule="hot"}': 0.0}
+    d = alerts.firing_from_metrics(m)
+    assert d["firing"] == {"stall": 1} and d["rules"] == ["hot", "stall"]
+    line = alerts.format_alert_status(m)
+    assert "stall FIRING (3x)" in line
+    m['avida_alerts_firing{rule="stall"}'] = 0.0
+    assert "none firing (2 rules, 3 fired so far)" \
+        in alerts.format_alert_status(m)
+    assert alerts.format_alert_status({"avida_update": 1.0}) is None
+
+
+# ---------------------------------------------------------------------------
+# supervisor / fleet integration (fake clock, no subprocesses)
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+class ForeverProc:
+    """A child that never exits (the alert tests only need poll())."""
+    returncode = None
+    pid = 777
+
+    def poll(self):
+        return None
+
+    def kill(self):
+        self.returncode = -9
+
+    def wait(self, timeout=None):
+        return -9
+
+    def terminate(self):
+        self.returncode = 0
+
+    def send_signal(self, sig):
+        pass
+
+
+def _write_ring(data_dir, rows):
+    ring = history.hist_path(os.path.join(data_dir, "metrics.prom"))
+    for t, v in sorted(rows.items()):
+        history.append_sample(ring, v, now=t)
+
+
+def test_supervisor_poll_loop_evaluates_alerts(tmp_path):
+    clk = FakeClock(1000.0)
+    data, ck = str(tmp_path / "data"), str(tmp_path / "ck")
+    os.makedirs(data), os.makedirs(ck)
+    # a ring whose update counter has been flat for 100 fake seconds
+    _write_ring(data, {float(t): {"avida_update": 42.0,
+                                  "avida_heartbeat_timestamp_seconds":
+                                  float(t)}
+                       for t in range(900, 1001, 5)})
+    sup = Supervisor(
+        ["-d", data, "-set", "TPU_CKPT_DIR", ck, "-u", "100"],
+        cfg=SupervisorConfig(watchdog_sec=1e6, poll_sec=0.5,
+                             grace_sec=1e6, max_retries=2,
+                             backoff_base=0.1, backoff_cap=1.0,
+                             healthy_sec=1e9, seed=2),
+        env={}, spawn=lambda argv, env, logf: ForeverProc(),
+        clock=clk, sleep=clk.sleep)
+    assert sup.alerts is not None
+    sup.poll()                    # idle -> launch (no eval pre-launch)
+    assert not sup.alerts.firing
+    sup.poll()                    # running -> evaluate the fresh ring
+    recs = alerts.read_alert_records(os.path.join(data, "alerts.jsonl"))
+    assert ("stall", "firing") in [(r["rule"], r["state"]) for r in recs]
+    from avida_tpu.observability.exporter import read_metrics
+    m = read_metrics(os.path.join(data, "supervisor.prom"))
+    assert m['avida_alerts_firing{rule="stall"}'] == 1
+    assert m['avida_alerts_fired_total{rule="stall"}'] == 1
+    # the counter advances again -> the next evaluation resolves it
+    _write_ring(data, {float(t): {"avida_update": 42.0 + t - 1000.0}
+                       for t in range(1001, 1011)})
+    clk.t = 1010.0
+    sup.poll()
+    recs = alerts.read_alert_records(os.path.join(data, "alerts.jsonl"))
+    assert ("stall", "resolved") in [(r["rule"], r["state"])
+                                     for r in recs]
+    m = read_metrics(os.path.join(data, "supervisor.prom"))
+    assert m['avida_alerts_firing{rule="stall"}'] == 0
+    assert m['avida_alerts_fired_total{rule="stall"}'] == 1
+
+
+def test_supervisor_terminal_sweep_resolves_before_exit(tmp_path):
+    """A child that exits within one alert_eval_sec of recovering must
+    not leave the journal claiming a live alert: _terminal runs one
+    final throttle-bypassed evaluation (the child's last export is on
+    disk before its exit is observable)."""
+    clk = FakeClock(1000.0)
+    data, ck = str(tmp_path / "data"), str(tmp_path / "ck")
+    os.makedirs(data), os.makedirs(ck)
+    _write_ring(data, {float(t): {"avida_update": 42.0}
+                       for t in range(900, 1001, 5)})
+
+    class ExitingProc(ForeverProc):
+        def __init__(self):
+            self.returncode = None
+            self.exit_now = False
+
+        def poll(self):
+            if self.exit_now:
+                self.returncode = 0
+            return self.returncode
+
+    procs = []
+
+    def spawn(argv, env, logf):
+        procs.append(ExitingProc())
+        return procs[-1]
+
+    sup = Supervisor(
+        ["-d", data, "-set", "TPU_CKPT_DIR", ck, "-u", "100"],
+        cfg=SupervisorConfig(watchdog_sec=1e6, poll_sec=0.5,
+                             grace_sec=1e6, max_retries=2,
+                             backoff_base=0.1, backoff_cap=1.0,
+                             healthy_sec=1e9, seed=2),
+        env={}, spawn=spawn, clock=clk, sleep=clk.sleep)
+    sup.poll()                        # launch (no pre-launch eval)
+    sup.poll()                        # running: stall fires on the ring
+    assert "stall" in sup.alerts.firing
+    # recovery lands its samples, then the child exits INSIDE the
+    # throttle window -- the terminal sweep must still resolve
+    _write_ring(data, {float(t): {"avida_update": 42.0 + t - 1000.0}
+                       for t in range(1001, 1011)})
+    clk.t = 1010.0
+    sup._alerts_next = clk.t + 100.0  # force the throttle CLOSED
+    procs[0].exit_now = True
+    assert sup.poll() == "done"       # child exited -> terminal sweep
+    assert "stall" not in sup.alerts.firing
+    recs = alerts.read_alert_records(os.path.join(data, "alerts.jsonl"))
+    assert [(r["rule"], r["state"]) for r in recs] == [
+        ("stall", "firing"), ("stall", "resolved")]
+
+
+def test_supervisor_ignores_previous_incarnations_ring(tmp_path):
+    """A resume over a data dir whose ring ends long before this boot
+    must not page: pre-launch there is nothing to evaluate, and during
+    the new boot's compile window the old incarnation's samples are
+    evidence of the past -- alert state freezes until a post-launch
+    sample lands."""
+    clk = FakeClock(1000.0)
+    data, ck = str(tmp_path / "data"), str(tmp_path / "ck")
+    os.makedirs(data), os.makedirs(ck)
+    _write_ring(data, {float(t): {"avida_update": 42.0,
+                                  "avida_heartbeat_timestamp_seconds":
+                                  float(t)}
+                       for t in range(300, 401, 5)})       # 10 min old
+    sup = Supervisor(
+        ["-d", data, "-set", "TPU_CKPT_DIR", ck, "-u", "100"],
+        cfg=SupervisorConfig(watchdog_sec=1e6, poll_sec=0.5,
+                             grace_sec=1e6, max_retries=2,
+                             backoff_base=0.1, backoff_cap=1.0,
+                             healthy_sec=1e9, seed=2),
+        env={}, spawn=lambda argv, env, logf: ForeverProc(),
+        clock=clk, sleep=clk.sleep)
+    sup.poll()                                  # launch
+    clk.t = 1006.0
+    sup.poll()                                  # compile window
+    assert not sup.alerts.firing
+    assert not os.path.exists(os.path.join(data, "alerts.jsonl"))
+    # the new child publishes advancing samples -> evaluation resumes
+    _write_ring(data, {float(t): {"avida_update": 50.0 + t,
+                                  "avida_heartbeat_timestamp_seconds":
+                                  float(t)}
+                       for t in range(1007, 1013)})
+    clk.t = 1012.0
+    sup.poll()
+    assert not sup.alerts.firing                # advancing: no stall
+
+
+def test_fleet_reads_degrade_hints_from_job_supervisors(tmp_path):
+    """Run-level degrade-hint rules (integrity_mismatch, pinned to the
+    job's metrics ring) evaluate inside each job's embedded
+    Supervisor; the fleet poll loop reads that plane in-process and
+    drops the breadcrumb -- without this the advertised alert->breaker
+    path would be unreachable."""
+    from types import SimpleNamespace
+
+    from avida_tpu.service.fleet import (FleetConfig, FleetOrchestrator,
+                                         Job)
+    spool = str(tmp_path / "spool")
+    clk = FakeClock(3000.0)
+    fl = FleetOrchestrator(spool, cfg=FleetConfig(breaker_k=1,
+                                                  breaker_sec=60.0),
+                           env={}, clock=clk, sleep=clk.sleep)
+    rule = next(r for r in alerts.default_rules()
+                if r.name == "integrity_mismatch")
+    assert rule.action == "degrade-hint"
+    plane = alerts.AlertPlane([rule])
+    plane.firing["integrity_mismatch"] = 2990.0
+    job = Job("sick", spool)
+    job.sup = SimpleNamespace(alerts=plane, last_outcome=None,
+                              _xla_fallback=False)
+    fl._note_alert_hints(job)
+    assert fl.failures["alert:integrity_mismatch"] == 1
+    assert fl.breaker.open_class == "alert:integrity_mismatch"
+    # steady firing: no second breadcrumb until the rule resolves
+    fl._note_alert_hints(job)
+    assert fl.failures["alert:integrity_mismatch"] == 1
+    plane.firing.clear()
+    fl._note_alert_hints(job)                   # resolve re-arms
+    plane.firing["integrity_mismatch"] = 2995.0
+    fl._note_alert_hints(job)
+    assert fl.failures["alert:integrity_mismatch"] == 2
+    from avida_tpu.observability.runlog import read_records
+    events = [(r.get("event"), r.get("rule"), r.get("job"))
+              for r in read_records(fl.journal_path)]
+    assert ("alert", "integrity_mismatch", "sick") in events
+
+
+def test_supervisor_alert_eval_disabled_and_bad_rules(tmp_path, capsys):
+    data, ck = str(tmp_path / "data"), str(tmp_path / "ck")
+    os.makedirs(data), os.makedirs(ck)
+    argv = ["-d", data, "-set", "TPU_CKPT_DIR", ck]
+    sup = Supervisor(argv, env={"TPU_ALERT_EVAL_SEC": "0"},
+                     spawn=lambda *a: ForeverProc())
+    assert sup.alerts is None
+    # a malformed alerts.json is loud but does not kill supervision
+    with open(os.path.join(data, "alerts.json"), "w") as f:
+        f.write("{}")
+    sup = Supervisor(argv, env={}, spawn=lambda *a: ForeverProc())
+    assert sup.alerts is None
+    assert "alert rules disabled" in capsys.readouterr().err
+    # same survival for a structurally-valid list with a null numeric
+    with open(os.path.join(data, "alerts.json"), "w") as f:
+        json.dump([{"name": "x", "family": "f", "kind": "threshold",
+                    "value": None}], f)
+    sup = Supervisor(argv, env={}, spawn=lambda *a: ForeverProc())
+    assert sup.alerts is None
+    assert "alert rules disabled" in capsys.readouterr().err
+
+
+def test_fleet_degrade_hint_breadcrumb_and_breaker(tmp_path):
+    from avida_tpu.service.fleet import FleetConfig, FleetOrchestrator
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool)
+    with open(os.path.join(spool, "alerts.json"), "w") as f:
+        json.dump([{"name": "queue_hot",
+                    "family": "avida_fleet_queue_depth",
+                    "kind": "threshold", "op": ">", "value": 3.0,
+                    "severity": "warn", "action": "degrade-hint"}], f)
+    clk = FakeClock(2000.0)
+    fl = FleetOrchestrator(spool,
+                           cfg=FleetConfig(breaker_k=1,
+                                           breaker_sec=60.0),
+                           env={}, clock=clk, sleep=clk.sleep)
+    ring = history.hist_path(fl.metrics_path)
+    for t in range(1900, 2001, 10):
+        history.append_sample(ring, {"avida_fleet_queue_depth": 9.0},
+                              now=float(t))
+    fl._eval_alerts(clk())
+    # breadcrumb: failure tally + journal + breaker (admission pause --
+    # detection plane, never a kill)
+    assert fl.failures["alert:queue_hot"] == 1
+    assert fl.breaker.open_class == "alert:queue_hot"
+    from avida_tpu.observability.runlog import read_records
+    events = [(r.get("event"), r.get("rule"), r.get("job"))
+              for r in read_records(fl.journal_path)]
+    assert ("alert", "queue_hot", None) in events
+    assert ("breaker_open", None, "") in events
+    recs = alerts.read_alert_records(os.path.join(spool, "alerts.jsonl"))
+    assert [(r["rule"], r["state"]) for r in recs] \
+        == [("queue_hot", "firing")]
+    fl.publish_metrics()                       # families render cleanly
+    from avida_tpu.observability.exporter import read_metrics
+    m = read_metrics(fl.metrics_path)
+    assert m['avida_alerts_firing{rule="queue_hot"}'] == 1
+    assert m['avida_fleet_failures_total{class="alert:queue_hot"}'] == 1
+    # and the fleet.prom publish itself rode into the fleet ring
+    assert any("avida_fleet_breaker_open" in s["v"]
+               for s in history.read_samples(ring))
+    # steady firing: no second breadcrumb on the next evaluation
+    clk.t += 10
+    fl._eval_alerts(clk())
+    assert fl.failures["alert:queue_hot"] == 1
+
+
+def test_format_status_history_line(tmp_path):
+    from avida_tpu.observability.exporter import format_status
+    ring = str(tmp_path / "metrics.hist.jsonl")
+    metrics = {"avida_update": 40, "avida_organisms": 3,
+               "avida_heartbeat_timestamp_seconds": 1000.0}
+    out = format_status(metrics, now=1000.0, hist_path=ring)
+    assert "history     no history" in out
+    for t in range(900, 1001, 10):
+        history.append_sample(ring, {"avida_update": float(t - 900)},
+                              now=float(t))
+    out = format_status(metrics, now=1000.0, hist_path=ring)
+    assert re.search(r"history     upd/s last \d+ beats: "
+                     r"[\d.]+ -> [\d.]+", out)
+    # without a hist_path the line is absent (old callers unchanged)
+    assert "history" not in format_status(metrics, now=1000.0)
+
+
+# ---------------------------------------------------------------------------
+# exporter consistency lint: the .prom plane has grown across 7 PRs
+# ---------------------------------------------------------------------------
+
+# counters that predate the _total convention (PR 5); grandfathered,
+# never to grow
+_COUNTER_NO_TOTAL = {"avida_update", "avida_time"}
+
+_FAMILY_TUPLE_RE = re.compile(
+    r'\(\s*"(avida_[a-z0-9_]+)",\s*"(counter|gauge)"', re.S)
+_FAMILY_HELP_RE = re.compile(
+    r'"(avida_[a-z0-9_]+)":\s*\(\s*"(counter|gauge)"')
+_NAME_RE = re.compile(r"^avida_[a-z0-9]+(_[a-z0-9]+)*$")
+
+
+def _declared_families():
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    files = (glob.glob(os.path.join(repo, "avida_tpu", "**", "*.py"),
+                       recursive=True)
+             + glob.glob(os.path.join(repo, "scripts", "*.py"))
+             + [os.path.join(repo, "bench.py")])
+    kinds: dict = {}
+    for path in files:
+        with open(path) as f:
+            text = f.read()
+        for rx in (_FAMILY_TUPLE_RE, _FAMILY_HELP_RE):
+            for m in rx.finditer(text):
+                kinds.setdefault(m.group(1), {})[m.group(2)] = \
+                    os.path.basename(path)
+    return kinds
+
+
+def test_prom_family_conventions():
+    """Walk every render_families family declaration in the tree and
+    enforce the exposition conventions: avida_ prefix and lowercase
+    snake naming, counters end in _total (the two pre-convention
+    counters are a frozen grandfather set), gauges never claim _total,
+    and no family is declared with two different types by two
+    flavors."""
+    kinds = _declared_families()
+    # the scan itself must keep working as the plane grows: today it
+    # sees ~70 families; a collapse here means the regexes rotted
+    assert len(kinds) >= 60, sorted(kinds)
+    for name, by_kind in sorted(kinds.items()):
+        assert _NAME_RE.match(name), f"non-conforming family name {name}"
+        assert len(by_kind) == 1, (
+            f"family {name} declared with conflicting types {by_kind}")
+        kind = next(iter(by_kind))
+        if kind == "counter" and name not in _COUNTER_NO_TOTAL:
+            assert name.endswith("_total"), (
+                f"counter {name} ({by_kind[kind]}) must end in _total")
+        if kind == "gauge":
+            assert not name.endswith("_total"), (
+                f"gauge {name} ({by_kind[kind]}) must not claim _total")
+    for name in _COUNTER_NO_TOTAL:
+        assert name in kinds, f"grandfathered {name} vanished; prune set"
+
+
+# ---------------------------------------------------------------------------
+# ops tooling: metrics_tool + trace_tool fleet
+# ---------------------------------------------------------------------------
+
+def test_metrics_tool_query_watch_prune(tmp_path, capsys):
+    d = str(tmp_path)
+    ring = os.path.join(d, "metrics.hist.jsonl")
+    import time as _time
+    now = _time.time()
+    for i in range(20):
+        history.append_sample(
+            ring, {"avida_update": float(i * 4),
+                   "avida_heartbeat_timestamp_seconds": now - 20 + i},
+            now=now - 20 + i)
+    assert metrics_tool.main(["query", d, "avida_update"]) == 0
+    out = capsys.readouterr().out
+    assert "count          20" in out and "rate_per_sec" in out
+    csv_path = os.path.join(d, "upd.csv")
+    assert metrics_tool.main(["query", d, "avida_update",
+                              "--csv", csv_path]) == 0
+    capsys.readouterr()
+    assert len(open(csv_path).read().splitlines()) == 21   # header + rows
+    # watch --once: the update counter is advancing, heartbeat fresh ->
+    # nothing fires, exit 0
+    assert metrics_tool.main(["watch", d, "--once"]) == 0
+    assert "stall" in capsys.readouterr().out
+    # a stalled ring (flat counter spanning the 60s window) flips the
+    # exit status to 3 (cron-able)
+    d2 = str(tmp_path / "stalled")
+    os.makedirs(d2)
+    ring2 = os.path.join(d2, "metrics.hist.jsonl")
+    for i in range(15):
+        history.append_sample(ring2, {"avida_update": 80.0},
+                              now=now - 70 + i * 5)
+    assert metrics_tool.main(["watch", d2, "--once"]) == 3
+    capsys.readouterr()
+    assert metrics_tool.main(["rules", d]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert {"name", "family", "kind"} <= set(doc[0])
+    assert metrics_tool.main(["prune", d, "--keep-bytes", "512"]) == 0
+    assert os.path.getsize(ring) <= 512
+    assert metrics_tool.main(["query", d, "no_such_family"]) == 1
+    capsys.readouterr()
+
+
+def test_trace_tool_fleet_merges_layers(tmp_path):
+    import trace_tool
+    spool = str(tmp_path / "spool")
+    data = os.path.join(spool, "job-a", "data")
+    os.makedirs(data)
+    t0 = 5000.0
+
+    def w(path, recs):
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+
+    w(os.path.join(spool, "fleet.jsonl"), [
+        {"record": "fleet", "event": "fleet_start", "time": t0},
+        {"record": "fleet", "event": "admit", "time": t0 + 1,
+         "job": "job-a"},
+        {"record": "fleet", "event": "breaker_open", "time": t0 + 5,
+         "failure_class": "crash", "job": "job-a", "k": 3,
+         "window_sec": 300},
+        {"record": "fleet", "event": "done", "time": t0 + 20,
+         "job": "job-a"},
+    ])
+    w(os.path.join(data, "supervisor.jsonl"), [
+        {"record": "supervisor", "event": "launch", "time": t0 + 2,
+         "boot": 0, "fault": "hang:sec=5@chunk=2"},
+        {"record": "supervisor", "event": "watchdog_kill",
+         "time": t0 + 8, "boot": 0, "reason": "stale heartbeat"},
+        {"record": "supervisor", "event": "exit", "time": t0 + 8.2,
+         "boot": 0, "class": "hang", "code": -9, "update": 4},
+        {"record": "supervisor", "event": "launch", "time": t0 + 9,
+         "boot": 1, "fault": ""},
+        {"record": "supervisor", "event": "exit", "time": t0 + 19,
+         "boot": 1, "class": "success", "code": 0, "update": 20},
+    ])
+    w(os.path.join(data, "alerts.jsonl"), [
+        {"record": "alert", "rule": "stall", "state": "firing",
+         "time": t0 + 6, "severity": "page", "value": 0.0},
+        {"record": "alert", "rule": "stall", "state": "resolved",
+         "time": t0 + 12},
+    ])
+    ring = history.hist_path(os.path.join(data, "metrics.prom"))
+    for i, u in enumerate((2, 4, 12, 20)):
+        history.append_sample(ring, {"avida_update": float(u)},
+                              now=t0 + 3 + i * 4)
+    # a second, still-live job whose only record postdates every fleet
+    # record: its open-ended boot span must reach the GLOBAL horizon
+    # (job-a's newest ring sample at t0+30), not the fleet journal's
+    # last timestamp
+    data_b = os.path.join(spool, "job-b", "data")
+    os.makedirs(data_b)
+    w(os.path.join(data_b, "supervisor.jsonl"), [
+        {"record": "supervisor", "event": "launch", "time": t0 + 25,
+         "boot": 0, "fault": ""},
+    ])
+    history.append_sample(ring, {"avida_update": 22.0}, now=t0 + 30)
+    doc = trace_tool.fleet_trace(spool)
+    evs = doc["traceEvents"]
+    names = [e["name"] for e in evs]
+    # one process per layer, correlated on one clock
+    procs = {e["args"]["name"] for e in evs
+             if e["name"] == "process_name"}
+    assert procs == {f"fleet {spool}", "job job-a", "job job-b"}
+    assert "job-a [done]" in names                        # lifecycle span
+    assert "boot 0 [hang]" in names and "boot 1 [success]" in names
+    assert "alert:stall" in names                         # firing span
+    assert "fault:hang:sec=5@chunk=2" in names            # instant
+    assert "breaker_open" in names
+    spans = {e["name"]: e for e in evs if e["ph"] == "X"}
+    # the alert fired DURING boot 0 and resolved inside boot 1
+    assert spans["boot 0 [hang]"]["ts"] <= spans["alert:stall"]["ts"]
+    # job-b's live boot extends to the global horizon (t0+30), which
+    # only the ring knows about -- not to the fleet journal's end
+    live = spans["boot 0 [live]"]
+    assert live["ts"] + live["dur"] == pytest.approx(30e6)
+    counters = [e for e in evs if e["ph"] == "C"]
+    assert len(counters) == 5                             # the ring rows
+    assert any(e["name"].startswith("chunk ->u") for e in evs)
+    # and the CLI writes a loadable json
+    out = os.path.join(spool, "fleet.trace.json")
+    assert trace_tool.main(["fleet", spool, "-o", out]) == 0
+    assert json.load(open(out))["otherData"]["jobs"] == ["job-a",
+                                                         "job-b"]
+
+
+# ---------------------------------------------------------------------------
+# the engine is untouched: bit-identity + jaxpr gate (compiles one
+# small world program, shared by both runs)
+# ---------------------------------------------------------------------------
+
+_WORLD_OVERRIDES = [
+    ("WORLD_X", 6), ("WORLD_Y", 6), ("TPU_MAX_MEMORY", 128),
+    ("RANDOM_SEED", 19), ("AVE_TIME_SLICE", 30),
+    ("TPU_MAX_STEPS_PER_UPDATE", 30), ("TPU_SYSTEMATICS", 0),
+    ("TPU_MAX_STRETCH", 4), ("TPU_METRICS", 1),
+]
+
+
+def _run_world(data_dir, updates=12):
+    from avida_tpu.world import World
+    w = World(overrides=list(_WORLD_OVERRIDES), data_dir=str(data_dir))
+    w.run(max_updates=updates)
+    return w
+
+
+def test_trajectory_bit_identical_history_on_vs_off(tmp_path, monkeypatch):
+    from avida_tpu.core.state import state_field_names
+    monkeypatch.setenv("TPU_METRICS_HIST", "1")
+    w_on = _run_world(tmp_path / "on")
+    on_ring = history.hist_path(str(tmp_path / "on" / "metrics.prom"))
+    assert history.read_samples(on_ring), "ring missing with hist on"
+    state_on = {n: np.asarray(getattr(w_on.state, n))
+                for n in state_field_names()
+                if getattr(w_on.state, n) is not None}
+    monkeypatch.setenv("TPU_METRICS_HIST", "0")
+    w_off = _run_world(tmp_path / "off")
+    assert not os.path.exists(
+        history.hist_path(str(tmp_path / "off" / "metrics.prom")))
+    assert w_on.update == w_off.update
+    for n in sorted(state_on):
+        np.testing.assert_array_equal(
+            state_on[n], np.asarray(getattr(w_off.state, n)),
+            err_msg=f"state leaf {n} differs with history on vs off")
+    # the snapshots themselves stayed byte-compatible (minus the
+    # wall-clock heartbeat line, which differs by construction)
+    def strip_hb(p):
+        return [line for line in open(p)
+                if "heartbeat_timestamp" not in line]
+    assert strip_hb(tmp_path / "on" / "metrics.prom") \
+        == strip_hb(tmp_path / "off" / "metrics.prom")
+
+
+def test_jaxpr_digest_unchanged_with_history_on(monkeypatch):
+    """The plane is host-side only: with the knobs armed, the solo
+    update_step still traces to the recorded program."""
+    monkeypatch.setenv("TPU_METRICS_HIST", "1")
+    monkeypatch.setenv("TPU_METRICS", "1")
+    monkeypatch.setenv("TPU_ALERT_EVAL_SEC", "1")
+    import check_jaxpr
+    ok, msg = check_jaxpr.check()
+    assert ok, msg
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill: injected hang -> stall alert fires and journals
+# BEFORE the watchdog kill, resolves after recovery (real subprocesses)
+# ---------------------------------------------------------------------------
+
+def _drill_env():
+    env = dict(os.environ)
+    env.pop("TPU_FAULT", None)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)   # PR-6 landmine
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TPU_ALERT_EVAL_SEC"] = "0.5"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    return env
+
+
+@pytest.mark.slow
+def test_supervised_hang_drill_stall_alert_fires_before_watchdog(tmp_path):
+    data, ck = str(tmp_path / "data"), str(tmp_path / "ck")
+    os.makedirs(data)
+    # tighten the stall window so the drill fits CI time: the injected
+    # hang is 45s, the watchdog 14s, the stall window 6s -- the alert
+    # must fire in the gap between hang onset and the SIGKILL
+    with open(os.path.join(data, "alerts.json"), "w") as f:
+        json.dump([{"name": "stall", "family": "avida_update",
+                    "kind": "rate", "op": "<=", "value": 0.0,
+                    "window_sec": 6.0, "severity": "page"},
+                   {"name": "heartbeat_stale",
+                    "family": "avida_heartbeat_timestamp_seconds",
+                    "kind": "staleness", "value": 6.0,
+                    "severity": "page"}], f)
+    argv = ["-s", "11", "-u", "20", "-d", data,
+            "-set", "TPU_CKPT_DIR", ck]
+    for name, value in [("WORLD_X", "8"), ("WORLD_Y", "8"),
+                        ("TPU_MAX_MEMORY", "256"),
+                        ("AVE_TIME_SLICE", "100"),
+                        ("TPU_MAX_STEPS_PER_UPDATE", "100"),
+                        ("TPU_SYSTEMATICS", "0"),
+                        ("TPU_MAX_STRETCH", "2"),
+                        ("TPU_CKPT_EVERY", "4"),
+                        ("TPU_CKPT_FINAL", "1")]:
+        argv += ["-set", name, value]
+    sup = Supervisor(
+        argv, fault_plan=["hang:sec=45@chunk=2"],
+        cfg=SupervisorConfig(watchdog_sec=14.0, poll_sec=0.25,
+                             grace_sec=600.0, max_retries=6,
+                             backoff_base=0.05, backoff_cap=0.2,
+                             healthy_sec=1e9, seed=3),
+        env=_drill_env())
+    rc = sup.run()
+    assert rc == 0
+    assert sup.failures["hang"] == 1 and sup.watchdog_kills == 1
+
+    recs = alerts.read_alert_records(os.path.join(data, "alerts.jsonl"))
+    stall = [(r["state"], r["time"]) for r in recs
+             if r["rule"] == "stall"]
+    assert ("firing" in [s for s, _ in stall]), recs
+    fire_t = min(t for s, t in stall if s == "firing")
+    sup_recs = [json.loads(line) for line in
+                open(os.path.join(data, "supervisor.jsonl"))]
+    kills = [r["time"] for r in sup_recs
+             if r["event"] == "watchdog_kill"]
+    assert kills, sup_recs
+    # the alert plane saw the stall BEFORE the watchdog acted
+    assert fire_t < kills[0], (fire_t, kills)
+    # and recovery resolved it
+    assert ("resolved" in [s for s, _ in stall]), recs
+    resolve_t = max(t for s, t in stall if s == "resolved")
+    assert resolve_t > kills[0]
+    # the firing left durable evidence on the .prom spine + --status
+    from avida_tpu.observability.exporter import read_metrics
+    m = read_metrics(os.path.join(data, "supervisor.prom"))
+    assert m['avida_alerts_fired_total{rule="stall"}'] >= 1
+    assert m['avida_alerts_firing{rule="stall"}'] == 0      # resolved
+    assert "alerts" in alerts.format_alert_status(m)
+    # the run itself completed to its budget
+    final = read_metrics(os.path.join(data, "metrics.prom"))
+    assert final["avida_update"] == 20
