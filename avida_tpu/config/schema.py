@@ -354,6 +354,27 @@ class AvidaConfig:
     # Ring rotation cap in bytes per file (the live + `.1` pair bounds
     # disk at twice this).
     TPU_METRICS_HIST_MAX_BYTES: int = 4 << 20
+    # Device performance attribution plane (observability/profiler.py;
+    # README "Performance attribution").  TPU_PROFILE=1 -- config OR
+    # environment, the TPU_STATE_DIGEST arming pattern -- arms per-chunk
+    # attribution on the scanned-chunk path: unfenced chunk walls every
+    # chunk, a FENCED staged phase probe + per-leaf resident-footprint
+    # accounting on the first chunk and every TPU_PROFILE_EVERY-th
+    # after, per-program XLA cost/memory analysis captured at
+    # compile/cache-load time (utils/compilecache.py).  Lands as
+    # avida_perf_* exposition families, {"record":"perf"} lines in
+    # DATA_DIR/perf.jsonl and a `--status` perf block.  Probes run on
+    # device-owned COPIES of the state: trajectories are bit-identical
+    # on or off; default 0 builds, fences and writes nothing.  NOT the
+    # telemetry jax.profiler knobs (TPU_PROFILE_DIR/TPU_PROFILE_UPDATES
+    # above): this plane rides the chunked path telemetry cannot.
+    TPU_PROFILE: int = 0
+    # Fenced-probe cadence in chunks (0 = first chunk only; env wins,
+    # the history-knob operator convention).
+    TPU_PROFILE_EVERY: int = 16
+    # 1 = the first fenced probe also captures a jax.profiler trace of
+    # its staged phases into DATA_DIR/profiles/ (XProf-loadable).
+    TPU_PROFILE_TRACE: int = 0
 
     # In-run analytics (analyze/pipeline.py): 1 = refresh an incremental
     # phenotype census + dominant-lineage replay at checkpoint

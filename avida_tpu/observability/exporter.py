@@ -28,7 +28,7 @@ import time
 
 import numpy as np
 
-from avida_tpu.observability import history
+from avida_tpu.observability import history, profiler
 from avida_tpu.utils import compilecache, integrity
 
 METRICS_FILE = "metrics.prom"
@@ -137,6 +137,7 @@ def _render(values: dict, trace) -> str:
               for code, count in trace[2].items()}))
     families += compilecache.prom_families()
     families += integrity.prom_families()
+    families += profiler.prom_families()
     return render_families(families)
 
 
@@ -220,6 +221,11 @@ def format_status(metrics: dict, now: float | None = None,
             f"{int(metrics.get('avida_integrity_mismatches_total', 0))} "
             f"mismatches")
         lines.append("integrity   " + ", ".join(parts))
+    perf_line = profiler.format_status_block(metrics)
+    if perf_line is not None:
+        # performance attribution plane (observability/profiler.py):
+        # chunk walls, last probe's phase split, resident footprint
+        lines.append(perf_line)
     if hist_path is not None:
         lines.append("history     "
                      + history.recent_rate_line(hist_path, now=now))
@@ -691,7 +697,8 @@ class ServeExporter:
              "multiworld_scan program variants traced by this process "
              "(flat after warmup = the compile cache is doing its job)",
              scan_trace_count()),
-        ] + compilecache.prom_families() + integrity.prom_families()
+        ] + compilecache.prom_families() + integrity.prom_families() \
+            + profiler.prom_families()
         per_fams = [(name, *_HELP[name],
                      {f'world="{n}"': r[name] for n, r in rows.items()})
                     for name in self._PER_WORLD if rows]

@@ -129,48 +129,63 @@ def measure_multiworld(params, sts, neighbors, keys, updates=8, reps=3):
     return ms, bstate
 
 
-def measure_multiworld_phases(params, sts, neighbors, keys, reps=3):
-    """Fenced per-phase attribution of the BATCHED update on the XLA
-    world-folded path (ops/update.update_scan_batched's per-update
-    engine): `pre` = the vmapped resources+schedule prologue, `cycles` =
-    the ONE world-folded while_loop (the tentpole's hot loop), `post` =
-    the vmapped bank+birth epilogue.  Each stage is jitted separately
-    and fenced, exactly like profile_phases does for the solo update, so
-    bench.py can report the cycle loop's share of the batched update.
+def _batched_pre(params, bst, keys, u):
+    from avida_tpu.ops import update as upd
+    return jax.vmap(
+        lambda st, k: upd._mw_pre_phase(params, st, k, u))(bst, keys)
 
-    Caching-immune: every rep advances the evolved batched state through
-    the full pre->cycles->post chain with a fresh update number.
-    Returns {"pre_ms", "cycles_ms", "post_ms", "cycle_loop_share"}
-    (ms per update for the whole batch; share in [0, 1])."""
-    import time
-    from functools import partial
 
+def _batched_cycles(params, bst, k_steps, granted, max_k):
+    from avida_tpu.ops import update as upd
+    return upd._mw_fold_cycles_xla(params, bst, k_steps, granted, max_k)
+
+
+def _batched_post(params, bst, budgets, e0, kb, ks, neighbors, u):
     from avida_tpu.ops import update as upd
 
-    bst = jax.tree.map(lambda *xs: jnp.stack(xs), *sts)
-    bkeys = jnp.stack(list(keys))
-    u0 = 1 << 21
+    def one(st, b, e, kb1, ks1):
+        st, executed = upd.bank_phase(params, st, b, e)
+        return upd.birth_phase(params, st, kb1, ks1, neighbors, u)
 
-    @partial(jax.jit, static_argnums=0)
-    def pre(params, bst, keys, u):
-        return jax.vmap(
-            lambda st, k: upd._mw_pre_phase(params, st, k, u))(bst, keys)
+    return jax.vmap(one)(bst, budgets, e0, kb, ks)
 
-    @partial(jax.jit, static_argnums=0)
-    def cycles(params, bst, k_steps, granted, max_k):
-        return upd._mw_fold_cycles_xla(params, bst, k_steps, granted,
-                                       max_k)
 
-    @partial(jax.jit, static_argnums=0)
-    def post(params, bst, budgets, e0, kb, ks, neighbors, u):
-        def one(st, b, e, kb1, ks1):
-            st, executed = upd.bank_phase(params, st, b, e)
-            return upd.birth_phase(params, st, kb1, ks1, neighbors, u)
+# module-level jits (params is static): the live profiler's probe
+# (observability/profiler.py, reps=1 at TPU_PROFILE_EVERY cadence)
+# compiles these stage programs ONCE per process, not once per probe
+_batched_pre_jit = None
+_batched_cycles_jit = None
+_batched_post_jit = None
 
-        return jax.vmap(one)(bst, budgets, e0, kb, ks)
 
+def _batched_jits():
+    global _batched_pre_jit, _batched_cycles_jit, _batched_post_jit
+    if _batched_pre_jit is None:
+        from functools import partial
+        _batched_pre_jit = partial(jax.jit, static_argnums=0)(_batched_pre)
+        _batched_cycles_jit = partial(jax.jit,
+                                      static_argnums=0)(_batched_cycles)
+        _batched_post_jit = partial(jax.jit, static_argnums=0)(_batched_post)
+    return _batched_pre_jit, _batched_cycles_jit, _batched_post_jit
+
+
+def measure_batched_phases(params, bst, neighbors, bkeys, reps=3,
+                           u0=1 << 21, warmup=True):
+    """Fenced pre/cycles/post attribution of an ALREADY-STACKED batched
+    state (the live-profiler entry point; measure_multiworld_phases
+    wraps it for bench.py's list-of-states calling convention).  With
+    warmup=False, rep 0 counts -- the profiler probe passes reps=1 on
+    state copies whose stage programs are already warm after the first
+    probe.  Returns {"pre_ms", "cycles_ms", "post_ms",
+    "cycle_loop_share"}."""
+    import time
+
+    pre, cycles, post = _batched_jits()
     t = {"pre": 0.0, "cycles": 0.0, "post": 0.0}
-    for r in range(reps + 1):                 # rep 0 warms the compiles
+    first = 0 if not warmup else None     # warmup: rep 0 warms compiles
+    reps_total = reps + (1 if warmup else 0)
+    counted = 0
+    for r in range(reps_total):
         u = jnp.int32(u0 + r)
         keys_r = jax.vmap(
             lambda rk: jax.random.fold_in(rk, u0 + r))(bkeys)
@@ -188,17 +203,38 @@ def measure_multiworld_phases(params, sts, neighbors, keys, reps=3):
                    neighbors, u)
         jax.block_until_ready(bst)
         t3 = time.perf_counter()
-        if r > 0:
+        if not warmup or r > 0:
             t["pre"] += t1 - t0
             t["cycles"] += t2 - t1
             t["post"] += t3 - t2
+            counted += 1
+    counted = counted or 1
     total = sum(t.values()) or 1e-9
     return {
-        "pre_ms": round(t["pre"] * 1e3 / reps, 3),
-        "cycles_ms": round(t["cycles"] * 1e3 / reps, 3),
-        "post_ms": round(t["post"] * 1e3 / reps, 3),
+        "pre_ms": round(t["pre"] * 1e3 / counted, 3),
+        "cycles_ms": round(t["cycles"] * 1e3 / counted, 3),
+        "post_ms": round(t["post"] * 1e3 / counted, 3),
         "cycle_loop_share": round(t["cycles"] / total, 4),
     }
+
+
+def measure_multiworld_phases(params, sts, neighbors, keys, reps=3):
+    """Fenced per-phase attribution of the BATCHED update on the XLA
+    world-folded path (ops/update.update_scan_batched's per-update
+    engine): `pre` = the vmapped resources+schedule prologue, `cycles` =
+    the ONE world-folded while_loop (the tentpole's hot loop), `post` =
+    the vmapped bank+birth epilogue.  Each stage is jitted separately
+    and fenced, exactly like profile_phases does for the solo update, so
+    bench.py can report the cycle loop's share of the batched update.
+
+    Caching-immune: every rep advances the evolved batched state through
+    the full pre->cycles->post chain with a fresh update number.
+    Returns {"pre_ms", "cycles_ms", "post_ms", "cycle_loop_share"}
+    (ms per update for the whole batch; share in [0, 1])."""
+    bst = jax.tree.map(lambda *xs: jnp.stack(xs), *sts)
+    bkeys = jnp.stack(list(keys))
+    return measure_batched_phases(params, bst, neighbors, bkeys,
+                                  reps=reps)
 
 
 def measure_trace_drain(cap=4096, n_updates=16, reps=5):

@@ -1,0 +1,682 @@
+"""Device performance attribution plane (README "Performance attribution").
+
+Answers the three questions ROADMAP items 1 and 4 are blocked on:
+
+  which PROGRAM?   per-compiled-program XLA cost analysis (flops, bytes
+                   accessed, transcendentals) and memory analysis
+                   (argument / output / temp / generated-code HBM),
+                   captured by utils/compilecache.call at compile time
+                   and carried in the cache-entry manifest so a cached
+                   load reports the SAME numbers as the fresh compile
+                   (keyed by the compile-cache signature);
+  which PHASE?     opt-in (TPU_PROFILE=1) per-chunk attribution: every
+                   chunk's boundary-to-boundary wall is accumulated
+                   unfenced (zero-sync -- the deferred-export pipeline
+                   is never touched), and every TPU_PROFILE_EVERY-th
+                   chunk takes a FENCED probe: a harness-style staged
+                   pre/cycles/post timing run on device-owned COPIES of
+                   the live state, so the evolved trajectory stays
+                   bit-identical with profiling on or off;
+  which BYTES?     resident-state footprint per PopulationState leaf --
+                   padded (`nbytes` ground truth) vs live bytes (scaled
+                   by occupancy and mean genome length: the bit-packing
+                   headroom number), per-world + ghost overhead for
+                   MultiWorld / ServeBatch batches.
+
+Everything lands in the existing observability grammars, never a
+parallel one: `avida_perf_*` families on every exporter flavor (empty
+when off -- the compilecache.prom_families byte-compatibility
+contract), {"record": "perf"} lines in DATA_DIR/perf.jsonl (runlog
+rotation pair), a perf block in `--status`, phase spans in `trace_tool
+fleet`, and `scripts/perf_tool.py report/diff/campaign` on top.
+
+Arming follows the integrity-plane pattern (utils/integrity.py):
+config nonzero OR environment nonzero -- the suite pins the env side
+to 0 (tests/conftest.py) and dedicated tests opt back in through
+config overrides.  NOT the same knobs as the telemetry subsystem's
+TPU_PROFILE_DIR/TPU_PROFILE_UPDATES (jax.profiler capture under
+TPU_TELEMETRY): TPU_PROFILE arms THIS plane on the scanned-chunk
+path, where telemetry cannot go without killing throughput.
+
+Measurement rules inherited from rounds 12-15 (BASELINE.md): probes
+never dispatch repeated identical inputs (each probe runs one staged
+update on a copy of the CURRENT evolved state), and headline numbers
+are direct fenced attributions, not end-to-end wall deltas.
+
+Host-importable without jax: every jax touch is inside a function
+(scripts/perf_tool.py reads this module's file formats from plain
+hosts).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+PERF_FILE = "perf.jsonl"
+PROFILES_DIR = "profiles"
+_PERF_MAX_BYTES = 16 << 20
+
+# ---------------------------------------------------------------------------
+# arming (the integrity.digest_enabled pattern: config OR env)
+# ---------------------------------------------------------------------------
+
+
+def enabled(cfg=None) -> bool:
+    """TPU_PROFILE nonzero in the config OR the environment arms the
+    attribution plane.  Off (default) builds nothing, fences nothing
+    and writes nothing -- exporter files stay byte-identical."""
+    if cfg is not None and int(cfg.get("TPU_PROFILE", 0) or 0):
+        return True
+    return bool(int(os.environ.get("TPU_PROFILE", "0") or 0))
+
+
+def trace_enabled(cfg=None) -> bool:
+    """TPU_PROFILE_TRACE=1: the first fenced probe also captures a
+    jax.profiler trace of its staged phases into DATA_DIR/profiles/."""
+    if cfg is not None and int(cfg.get("TPU_PROFILE_TRACE", 0) or 0):
+        return True
+    return bool(int(os.environ.get("TPU_PROFILE_TRACE", "0") or 0))
+
+
+def probe_every(cfg=None) -> int:
+    """Fenced-probe cadence in chunks (first chunk always probes;
+    0 = first chunk only).  Env wins over config here -- cadence is an
+    operator knob, like the history sampling knobs."""
+    v = os.environ.get("TPU_PROFILE_EVERY", "")
+    if v not in ("", None):
+        try:
+            return int(v)
+        except ValueError:
+            pass
+    if cfg is not None:
+        return int(cfg.get("TPU_PROFILE_EVERY", 16) or 0)
+    return 16
+
+
+# ---------------------------------------------------------------------------
+# module state (one process = one attribution report, like compilecache)
+# ---------------------------------------------------------------------------
+
+_programs: dict = {}            # cache key -> program report
+_chunk = {
+    "chunks": 0,                # chunks dispatched under profiling
+    "updates": 0,               # updates those chunks covered
+    "wall_ms": 0.0,             # boundary-to-boundary wall (unfenced)
+    "wall_chunks": 0,           # intervals accumulated into wall_ms
+    "fenced_ms": 0.0,           # dispatch->ready wall of probed chunks
+    "fenced_chunks": 0,
+    "probes": 0,                # fenced probes taken
+    "probe_ms": 0.0,            # host+device wall spent inside probes
+}
+_phases: dict = {}              # phase name -> ms (last probe)
+_cycle_share = None             # cycle-loop share of the last probe
+_footprint = None               # last state_footprint() result
+
+
+def counters() -> dict:
+    return dict(_chunk)
+
+
+def program_reports() -> dict:
+    """{cache key: program report} captured so far this process."""
+    return {k: dict(v) for k, v in _programs.items()}
+
+
+def reset_for_tests():
+    global _cycle_share, _footprint
+    _programs.clear()
+    _phases.clear()
+    _cycle_share = None
+    _footprint = None
+    for k in _chunk:
+        _chunk[k] = 0 if isinstance(_chunk[k], int) else 0.0
+
+
+# ---------------------------------------------------------------------------
+# per-program XLA cost / memory capture (compilecache.call hooks)
+# ---------------------------------------------------------------------------
+
+# the cost-analysis keys worth carrying (the rest are per-op breakdowns
+# whose spellings vary by jax version)
+_COST_KEYS = ("flops", "bytes accessed", "transcendentals",
+              "optimal_seconds")
+_MEMORY_ATTRS = ("argument_size_in_bytes", "output_size_in_bytes",
+                 "temp_size_in_bytes", "generated_code_size_in_bytes",
+                 "alias_size_in_bytes", "host_temp_size_in_bytes")
+
+
+def program_perf(compiled) -> dict:
+    """{"cost": ..., "memory": ...} from a jax.stages.Compiled --
+    best-effort per backend (either analysis may be unimplemented;
+    absent halves are {})."""
+    out = {"cost": {}, "memory": {}}
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):     # older jax: one per device
+            cost = cost[0] if cost else {}
+        for k in _COST_KEYS:
+            v = cost.get(k)
+            if v is not None:
+                out["cost"][k.replace(" ", "_")] = float(v)
+    except Exception:
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        for attr in _MEMORY_ATTRS:
+            v = getattr(mem, attr, None)
+            if v is not None:
+                out["memory"][attr] = int(v)
+    except Exception:
+        pass
+    return out
+
+
+def note_program(key: str, tag: str, chunk: int, compiled, source: str,
+                 cfg=None, manifest: dict | None = None):
+    """Record one compiled scan program's cost/memory report, keyed by
+    its compile-cache signature.  `source` is "compile" (fresh
+    lower().compile()), "cache_load" (deserialized -- numbers come from
+    the entry manifest's `perf` block when the storing process captured
+    one, so cached and fresh runs report EQUAL numbers), "aot" (cache
+    disabled but profiling armed), or "memo" (an in-process memo hit
+    whose program predates the report -- same executable, same
+    numbers).  No-op unless the plane is armed (and deduped per key),
+    so note-hooks in compilecache.call cost nothing by default."""
+    if not enabled(cfg) or key in _programs:
+        return
+    perf = None
+    if manifest is not None:
+        perf = manifest.get("perf")
+    if perf is None:
+        perf = program_perf(compiled)
+    _programs[key] = {
+        "tag": tag,
+        "chunk": int(chunk),
+        "source": source,
+        "cost": dict(perf.get("cost", {})),
+        "memory": dict(perf.get("memory", {})),
+    }
+
+
+# ---------------------------------------------------------------------------
+# resident-state footprint (per PopulationState leaf)
+# ---------------------------------------------------------------------------
+
+
+def state_footprint(st, names=None, num_ghosts: int = 0) -> dict:
+    """Padded vs live byte accounting of one PopulationState (or a
+    [W]-stacked batch of them).
+
+    Padded bytes per leaf are `nbytes` ground truth (shape x itemsize,
+    no device transfer).  Live bytes scale every cell-axis leaf by the
+    alive fraction, and genome-shaped [.., N, L] leaves additionally by
+    the mean live genome length / L -- the bit-packing headroom number
+    ROADMAP item 4 needs.  Exactly two scalar readbacks (alive count,
+    mean genome length); None leaves (tracer rings off, unused
+    subsystems) are skipped like core/state.state_array_specs.
+
+    Batched states ([W, N, ...]; `names`/`num_ghosts` from the driver)
+    additionally report per-world bytes and the ghost-slot overhead."""
+    import numpy as np
+
+    from avida_tpu.core.state import state_field_names
+
+    alive = np.asarray(st.alive)
+    batched = alive.ndim == 2
+    W = alive.shape[0] if batched else 1
+    N = alive.shape[-1]
+    L = int(st.genome.shape[-1])
+    n_alive = int(alive.sum())
+    alive_frac = n_alive / float(alive.size) if alive.size else 0.0
+    glen = np.asarray(st.genome_len)
+    mean_len = (float((glen * alive).sum()) / n_alive) if n_alive else 0.0
+    len_frac = mean_len / L if L else 0.0
+
+    cell_axis = 1 if batched else 0
+    leaves, total, live_total = {}, 0, 0.0
+    for name in state_field_names():
+        x = getattr(st, name, None)
+        if x is None:
+            continue
+        b = int(x.nbytes)
+        frac = 1.0
+        shape = tuple(x.shape)
+        if len(shape) > cell_axis and shape[cell_axis] == N:
+            frac = alive_frac
+            if shape[-1:] == (L,) and len(shape) == cell_axis + 2:
+                frac *= len_frac
+        lb = b * frac
+        leaves[name] = {"bytes": b, "live_bytes": int(round(lb)),
+                        "shape": list(shape), "dtype": str(x.dtype)}
+        total += b
+        live_total += lb
+
+    out = {
+        "total_bytes": total,
+        "live_bytes": int(round(live_total)),
+        "alive_frac": round(alive_frac, 4),
+        "genome_len_frac": round(len_frac, 4),
+        "leaves": leaves,
+    }
+    if batched:
+        out["worlds"] = W
+        out["per_world_bytes"] = total // W if W else 0
+        out["ghost_slots"] = int(num_ghosts)
+        out["ghost_bytes"] = (total // W) * int(num_ghosts) if W else 0
+        if names:
+            out["world_names"] = list(names)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the per-driver chunk hook (World / MultiWorld / ServeBatch)
+# ---------------------------------------------------------------------------
+
+
+class ChunkProfiler:
+    """Per-driver attribution hooks around the chunk dispatch.
+
+    chunk_begin(k) stamps the dispatch; chunk_end_solo/_batched
+    accumulates the unfenced boundary-to-boundary wall and, on probe
+    chunks (first chunk, then every TPU_PROFILE_EVERY-th), fences the
+    freshly scanned state, runs a staged phase probe on device-owned
+    COPIES of it (trajectory bit-identity: the copies are discarded),
+    refreshes the footprint accounting and appends a {"record":"perf"}
+    line.  The unfenced path costs two perf_counter() calls and a few
+    dict adds per chunk -- the <2%-of-chunk-wall budget is measured by
+    bench.py's BENCH_PROF=1 arm."""
+
+    def __init__(self, data_dir: str, cfg=None, kind: str = "solo"):
+        self.data_dir = data_dir
+        self.kind = kind
+        self.every = probe_every(cfg)
+        self.trace = trace_enabled(cfg)
+        self._chunk_no = 0
+        self._probe = False
+        self._t0 = None
+        self._last_end = None
+        self._staged = None             # solo probe runner, built lazily
+        self._traced = False            # one-shot jax.profiler capture
+
+    # ---- the hot path ----
+
+    def chunk_begin(self, k: int):
+        self._chunk_no += 1
+        self._probe = (self._chunk_no == 1
+                       or (self.every > 0
+                           and self._chunk_no % self.every == 0))
+        self._t0 = time.perf_counter()
+
+    def _chunk_end(self, k: int, state) -> bool:
+        import jax
+
+        now = time.perf_counter()
+        _chunk["chunks"] += 1
+        _chunk["updates"] += int(k)
+        if self._last_end is not None:
+            _chunk["wall_ms"] += (now - self._last_end) * 1e3
+            _chunk["wall_chunks"] += 1
+        probe = self._probe
+        if probe:
+            jax.block_until_ready(state)
+            _chunk["fenced_ms"] += (time.perf_counter() - self._t0) * 1e3
+            _chunk["fenced_chunks"] += 1
+        self._last_end = time.perf_counter()
+        return probe
+
+    def chunk_end_solo(self, world, k: int):
+        """Boundary hook for World._scan_updates (state is
+        world.state, update counter still pre-chunk)."""
+        if not self._chunk_end(k, world.state):
+            return
+        t0 = time.perf_counter()
+        phases = self._run_traced(self._probe_solo, world)
+        fp = state_footprint(world.state)
+        self._finish_probe(phases, fp, int(world.update) + int(k), k)
+        _chunk["probe_ms"] += (time.perf_counter() - t0) * 1e3
+
+    def chunk_end_batched(self, owner, k: int, names=None,
+                          num_ghosts: int = 0, update: int | None = None):
+        """Boundary hook for MultiWorld._scan / ServeBatch._scan
+        (owner.bstate is the [W]-stacked batch, update counters already
+        advanced; ServeBatch passes its leader update explicitly --
+        members advance on their own counters)."""
+        if not self._chunk_end(k, owner.bstate):
+            return
+        t0 = time.perf_counter()
+        phases = self._run_traced(self._probe_batched, owner)
+        fp = state_footprint(owner.bstate, names=names,
+                             num_ghosts=num_ghosts)
+        if update is None:
+            update = int(getattr(owner, "update", 0))
+        self._finish_probe(phases, fp, int(update), k)
+        _chunk["probe_ms"] += (time.perf_counter() - t0) * 1e3
+
+    def final(self, state, update: int, names=None, num_ghosts: int = 0):
+        """Exit-path refresh: the run is already synced, so the closing
+        footprint + perf record are free readbacks (the final-heartbeat
+        discipline)."""
+        if state is None:
+            return
+        try:
+            fp = state_footprint(state, names=names, num_ghosts=num_ghosts)
+        except Exception:
+            return
+        self._finish_probe({}, fp, int(update), 0, final=True)
+
+    # ---- probes (device-owned copies; discarded -- bit-identity) ----
+
+    def _run_traced(self, probe_fn, owner) -> dict:
+        """Run one phase probe, wrapping the FIRST one in a
+        jax.profiler trace when TPU_PROFILE_TRACE is armed.  A probe
+        failure (pallas-path batch, OOM on the copies, backend without
+        the staged programs) degrades to whole-chunk attribution only
+        -- profiling must never take down the run."""
+        import jax
+
+        tracing = self.trace and not self._traced
+        if tracing:
+            self._traced = True
+            try:
+                jax.profiler.start_trace(
+                    os.path.join(self.data_dir, PROFILES_DIR))
+            except Exception:
+                tracing = False
+        try:
+            return probe_fn(owner)
+        except Exception:
+            return {}
+        finally:
+            if tracing:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+
+    def _probe_solo(self, world) -> dict:
+        import jax
+
+        from avida_tpu.observability.staged import StagedUpdate
+        from avida_tpu.observability.timeline import Timeline
+
+        if self._staged is None:
+            self._staged = StagedUpdate(world.params, world.neighbors,
+                                        collect_dispatch=False)
+        st = jax.tree.map(jax.numpy.copy, world.state)
+        u = int(world.update)
+        tl = Timeline()
+        self._staged.run(st, jax.random.fold_in(world._run_key, u), u, tl)
+        return tl.drain()
+
+    def _probe_batched(self, owner) -> dict:
+        from avida_tpu.observability.harness import measure_batched_phases
+        from avida_tpu.ops.update import use_pallas_path
+
+        if use_pallas_path(owner.params):
+            # the staged pre/cycles/post split only exists on the XLA
+            # world-folded path; packed-kernel batches keep whole-chunk
+            # attribution (fenced_ms) + the jax.profiler trace
+            return {}
+        import jax
+
+        bst = jax.tree.map(jax.numpy.copy, owner.bstate)
+        t = measure_batched_phases(owner.params, bst, owner.neighbors,
+                                   owner._run_keys, reps=1)
+        global _cycle_share
+        _cycle_share = t.pop("cycle_loop_share", None)
+        return {k[:-3]: v for k, v in t.items() if k.endswith("_ms")}
+
+    # ---- publication ----
+
+    def _finish_probe(self, phases: dict, fp: dict, update: int, k: int,
+                      final: bool = False):
+        global _footprint
+        if phases:
+            _phases.clear()
+            _phases.update({n: round(float(ms), 4)
+                            for n, ms in phases.items()})
+        _footprint = fp
+        if not final:
+            _chunk["probes"] += 1
+        rec = {
+            "record": "perf",
+            "time": round(time.time(), 3),
+            "kind": self.kind,
+            "update": int(update),
+            "chunk_updates": int(k),
+            "final": bool(final),
+            "chunks": _chunk["chunks"],
+            "chunk_wall_ms": _mean(_chunk["wall_ms"],
+                                   _chunk["wall_chunks"]),
+            "chunk_fenced_ms": _mean(_chunk["fenced_ms"],
+                                     _chunk["fenced_chunks"]),
+            "phases": dict(_phases),
+            "state_bytes": fp.get("total_bytes", 0),
+            "state_live_bytes": fp.get("live_bytes", 0),
+            "alive_frac": fp.get("alive_frac", 0.0),
+            "genome_len_frac": fp.get("genome_len_frac", 0.0),
+            "leaves": {n: lf["bytes"]
+                       for n, lf in fp.get("leaves", {}).items()},
+            "programs": len(_programs),
+        }
+        if _cycle_share is not None:
+            rec["cycle_loop_share"] = round(float(_cycle_share), 4)
+        for extra in ("per_world_bytes", "ghost_slots", "ghost_bytes"):
+            if extra in fp:
+                rec[extra] = fp[extra]
+        append_perf_record(self.data_dir, rec)
+
+
+def _mean(total: float, n: int) -> float:
+    return round(total / n, 3) if n else 0.0
+
+
+def append_perf_record(data_dir: str, rec: dict):
+    """One {"record":"perf"} JSONL line into DATA_DIR/perf.jsonl --
+    the runlog rotation-pair grammar, non-durable appends (probe
+    boundaries must not pay fsync; the integrity.jsonl precedent)."""
+    from avida_tpu.observability.runlog import append_record
+
+    try:
+        append_record(os.path.join(data_dir, PERF_FILE), rec,
+                      max_bytes=_PERF_MAX_BYTES, durable=False)
+    except Exception:
+        pass                    # attribution must never kill the run
+
+
+def read_perf_records(data_dir: str) -> list:
+    from avida_tpu.observability.runlog import read_records
+
+    return [r for r in read_records(os.path.join(data_dir, PERF_FILE))
+            if r.get("record") == "perf"]
+
+
+# ---------------------------------------------------------------------------
+# exposition families (exporter._render / ServeExporter.export hook)
+# ---------------------------------------------------------------------------
+
+
+def _program_label(key: str, rec: dict) -> str:
+    return f'program="{rec["tag"]}:{key[:8]}"'
+
+
+def prom_families() -> list:
+    """The avida_perf_* families, render_families shaped.  Empty when
+    the plane never armed -- profiling-off processes publish
+    byte-identical metrics files (the compilecache.prom_families
+    contract)."""
+    if not (_chunk["chunks"] or _programs):
+        return []
+    fams = [
+        ("avida_perf_chunks_total", "counter",
+         "update chunks dispatched under the attribution plane",
+         _chunk["chunks"]),
+        ("avida_perf_updates_total", "counter",
+         "updates covered by profiled chunks", _chunk["updates"]),
+        ("avida_perf_probes_total", "counter",
+         "fenced phase/footprint probes taken", _chunk["probes"]),
+        ("avida_perf_chunk_wall_ms", "gauge",
+         "mean boundary-to-boundary chunk wall, unfenced (pipeline "
+         "throughput view)", _mean(_chunk["wall_ms"],
+                                   _chunk["wall_chunks"])),
+        ("avida_perf_chunk_fenced_ms", "gauge",
+         "mean dispatch-to-ready wall of probed chunks (device view)",
+         _mean(_chunk["fenced_ms"], _chunk["fenced_chunks"])),
+        ("avida_perf_probe_ms", "gauge",
+         "mean host+device wall of one fenced probe (the plane's "
+         "amortized cost)", _mean(_chunk["probe_ms"], _chunk["probes"])),
+    ]
+    if _phases:
+        fams.append(
+            ("avida_perf_phase_ms", "gauge",
+             "per-phase ms of the last staged probe (pre/cycles/post "
+             "on batches; the staged solo phases otherwise)",
+             {f'phase="{n}"': v for n, v in _phases.items()}))
+    if _cycle_share is not None:
+        fams.append(
+            ("avida_perf_cycle_loop_share", "gauge",
+             "cycle while_loop share of the last probed batched update",
+             round(float(_cycle_share), 4)))
+    if _programs:
+        fams.append(
+            ("avida_perf_programs_total", "counter",
+             "compiled scan programs with captured cost/memory "
+             "analysis", len(_programs)))
+        flops, acc, hbm = {}, {}, {}
+        for key, rec in _programs.items():
+            label = _program_label(key, rec)
+            c, m = rec["cost"], rec["memory"]
+            if "flops" in c:
+                flops[label] = int(c["flops"])
+            if "bytes_accessed" in c:
+                acc[label] = int(c["bytes_accessed"])
+            if m:
+                hbm[label] = int(sum(m.values()))
+        if flops:
+            fams.append(("avida_perf_program_flops", "gauge",
+                         "XLA cost-analysis flops per execution of this "
+                         "compiled program", flops))
+        if acc:
+            fams.append(("avida_perf_program_bytes_accessed", "gauge",
+                         "XLA cost-analysis bytes accessed per execution",
+                         acc))
+        if hbm:
+            fams.append(("avida_perf_program_hbm_bytes", "gauge",
+                         "memory-analysis HBM per program (argument + "
+                         "output + temp + generated code)", hbm))
+    fp = _footprint
+    if fp is not None:
+        fams += [
+            ("avida_perf_state_bytes", "gauge",
+             "resident PopulationState bytes, padded (nbytes ground "
+             "truth)", fp["total_bytes"]),
+            ("avida_perf_state_live_bytes", "gauge",
+             "occupancy- and genome-length-scaled live bytes (the "
+             "bit-packing headroom bound)", fp["live_bytes"]),
+            ("avida_perf_state_leaf_bytes", "gauge",
+             "padded bytes per PopulationState leaf",
+             {f'leaf="{n}"': rec["bytes"]
+              for n, rec in fp["leaves"].items()}),
+        ]
+        if "per_world_bytes" in fp:
+            fams.append(("avida_perf_world_state_bytes", "gauge",
+                         "resident bytes per batched world slot",
+                         fp["per_world_bytes"]))
+        if fp.get("ghost_bytes"):
+            fams.append(("avida_perf_ghost_state_bytes", "gauge",
+                         "resident bytes held by inert ghost slots "
+                         "(the serve padding overhead)",
+                         fp["ghost_bytes"]))
+    return fams
+
+
+def format_status_block(metrics: dict) -> str | None:
+    """The `--status` perf line from a metrics.prom dict (exporter
+    format_status hook) -- None when the plane never published."""
+    if "avida_perf_chunks_total" not in metrics:
+        return None
+    parts = [
+        f"chunk {metrics.get('avida_perf_chunk_wall_ms', 0.0):.1f}ms "
+        f"wall / {metrics.get('avida_perf_chunk_fenced_ms', 0.0):.1f}ms "
+        f"fenced",
+        f"{int(metrics.get('avida_perf_probes_total', 0))} probes",
+    ]
+    phases = {k.split('phase="', 1)[1].rstrip('"}'): v
+              for k, v in metrics.items()
+              if k.startswith('avida_perf_phase_ms{')}
+    if phases:
+        parts.append("phases " + " ".join(
+            f"{n}={v:.1f}" for n, v in phases.items()))
+    if "avida_perf_state_bytes" in metrics:
+        tb = metrics["avida_perf_state_bytes"]
+        lb = metrics.get("avida_perf_state_live_bytes", 0.0)
+        live_pct = (lb / tb * 100.0) if tb else 0.0
+        parts.append(f"state {tb / 2**20:.1f}MiB "
+                     f"({live_pct:.0f}% live)")
+    if "avida_perf_programs_total" in metrics:
+        parts.append(
+            f"{int(metrics['avida_perf_programs_total'])} programs")
+    return "perf        " + ", ".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# bench provenance (the self-describing-artifact half)
+# ---------------------------------------------------------------------------
+
+PROVENANCE_SCHEMA = "avida-bench-v1"
+# the apples-to-apples fields perf_tool diff refuses to cross
+PROVENANCE_STRICT = ("platform", "device_kind", "device_count", "x64",
+                     "code")
+
+
+def bench_provenance(run_time: float | None = None) -> dict:
+    """The provenance block every bench.py JSON line carries: the
+    compile-cache toolchain facts (jax/jaxlib versions, backend,
+    device kind/count, x64, the repo code digest -- ONE spelling,
+    utils/compilecache._toolchain) plus the TPU_*/BENCH_* knob
+    environment and the caller-passed run timestamp."""
+    from avida_tpu.utils.compilecache import _toolchain
+
+    env = {k: v for k, v in sorted(os.environ.items())
+           if k.startswith(("TPU_", "BENCH_")) and v != ""}
+    out = {"schema": PROVENANCE_SCHEMA, **_toolchain(), "env": env}
+    if run_time is not None:
+        out["generated_at"] = round(float(run_time), 3)
+    return out
+
+
+def provenance_mismatches(a: dict, b: dict) -> list:
+    """The strict-field disagreements between two provenance blocks --
+    what makes a diff apples-to-oranges.  Either side absent -> a
+    single loud "no provenance" entry."""
+    if not a or not b:
+        return [("provenance", "absent" if not a else "present",
+                 "absent" if not b else "present")]
+    out = []
+    for f in PROVENANCE_STRICT:
+        if a.get(f) != b.get(f):
+            out.append((f, a.get(f), b.get(f)))
+    return out
+
+
+def load_bench_json(path: str) -> dict:
+    """One bench artifact from `path`: a JSON object, or the LAST
+    object line of a JSONL stream (bench.py --sweep / piped output)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        return json.loads(text)
+    except ValueError:
+        last = None
+        for line in text.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    last = json.loads(line)
+                except ValueError:
+                    continue
+        if last is None:
+            raise
+        return last

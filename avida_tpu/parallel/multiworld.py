@@ -232,6 +232,15 @@ class MultiWorld:
         if int(self.cfg.get("TPU_METRICS", 0)):
             from avida_tpu.observability.exporter import MultiWorldExporter
             self.exporter = MultiWorldExporter(self)
+        # performance attribution plane (observability/profiler.py):
+        # batched flavor -- fenced pre/cycles/post probes on COPIES of
+        # the stacked state (XLA fold path; packed-kernel batches keep
+        # whole-chunk attribution), per-world footprint rows
+        self.profiler = None
+        from avida_tpu.observability import profiler as _profiler
+        if _profiler.enabled(self.cfg):
+            self.profiler = _profiler.ChunkProfiler(
+                self.data_dir, self.cfg, kind="multiworld")
 
     # ---- construction helpers ----
 
@@ -364,6 +373,8 @@ class MultiWorld:
         straggler-lag gauges: trips[w, u] is world w's OWN trip count
         at update u, while the batch ran max over worlds."""
         from avida_tpu.utils import compilecache
+        if self.profiler is not None:
+            self.profiler.chunk_begin(k)
         pre = None
         if self._scrub_every > 0:
             self._chunk_no += 1
@@ -395,6 +406,8 @@ class MultiWorld:
         self.update += k
         for w in self.worlds:
             w.update = self.update
+        if self.profiler is not None:
+            self.profiler.chunk_end_batched(self, k, names=self.names)
         if self._digest_on or pre is not None:
             self._integrity_boundary(k, pre)
 
@@ -730,6 +743,9 @@ class MultiWorld:
                 w.preempted = self._preempt
                 if self._world_exports(w) and w.state is not None:
                     w.exporter.export(w)
+            # (no profiler.final here: the batch is unstacked at exit
+            # -- the last probe's batched footprint already stands, and
+            # export_final republishes it via prom_families)
             if self.exporter is not None:
                 self.exporter.export_final(self)
         finally:
@@ -915,6 +931,14 @@ class ServeBatch:
         if int(self.cfg.get("TPU_METRICS", 0)):
             from avida_tpu.observability.exporter import ServeExporter
             self.exporter = ServeExporter(self)
+        # performance attribution plane, serve flavor: the batched
+        # probe + per-slot footprint (ghost overhead included -- the
+        # padding-cost number ROADMAP item 4 wants from serving)
+        self.profiler = None
+        from avida_tpu.observability import profiler as _profiler
+        if _profiler.enabled(self.cfg):
+            self.profiler = _profiler.ChunkProfiler(
+                self.data_dir, self.cfg, kind="serve")
 
     # the solo preemption contract verbatim (shared spelling)
     _install_preempt_handlers = World._install_preempt_handlers
@@ -1176,6 +1200,8 @@ class ServeBatch:
         u0 = jnp.asarray([0 if w is None else w.update
                           for w in self.slots], jnp.int32)
         from avida_tpu.utils import compilecache
+        if self.profiler is not None:
+            self.profiler.chunk_begin(k)
         pre = None
         if self._scrub_every > 0:
             self._chunk_no += 1
@@ -1201,6 +1227,12 @@ class ServeBatch:
         for i, w in self._live():
             w._pending_exec.append(executed[i])
             w.update += k
+        if self.profiler is not None:
+            live = self._live()
+            self.profiler.chunk_end_batched(
+                self, k, names=[self.names[i] for i, _ in live],
+                num_ghosts=self.num_ghosts,
+                update=max((w.update for _, w in live), default=0))
         if self._digest_on or pre is not None:
             # BEFORE the newborn drain: the shadow replay reproduces the
             # raw post-scan state (the drain zeroes nb_count afterwards)

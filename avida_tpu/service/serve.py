@@ -71,6 +71,11 @@ NONSTATIC_VARS = frozenset((
     # instrumentation too -- sampling cadence cannot split a class
     "TPU_METRICS_HIST", "TPU_METRICS_HIST_EVERY",
     "TPU_METRICS_HIST_MAX_BYTES",
+    # the performance attribution plane (observability/profiler.py)
+    # probes device-owned state COPIES only -- trajectories are
+    # bit-identical with it on or off, so its knobs cannot split a
+    # batchability class either
+    "TPU_PROFILE", "TPU_PROFILE_EVERY", "TPU_PROFILE_TRACE",
 ))
 
 # spec env vars that are per-job operational knobs, not program inputs
@@ -82,6 +87,7 @@ _NONSTATIC_ENV = frozenset((
     "TPU_COMPILE_CACHE", "TPU_COMPILE_CACHE_DIR",
     "TPU_METRICS_HIST", "TPU_METRICS_HIST_EVERY",
     "TPU_METRICS_HIST_MAX_BYTES", "TPU_ALERT_EVAL_SEC",
+    "TPU_PROFILE", "TPU_PROFILE_EVERY", "TPU_PROFILE_TRACE",
 ))
 
 

@@ -564,9 +564,46 @@ def call(jit_fn, tag: str, args: tuple, *, static_argnums=(0, 2),
     `log(**fields)` (World/ServeBatch pass a runlog emit_event shim)
     journals every load / store / fallback as a `compile_cache` event.
     Never lets a cache failure take down the run: the jit path is the
-    universal fallback."""
+    universal fallback.
+
+    Performance attribution (observability/profiler.py): when the
+    TPU_PROFILE plane is armed, every program construction -- fresh
+    compile, disk load, or the cache-disabled AOT flavor below --
+    reports its XLA cost/memory analysis to profiler.note_program,
+    keyed by this cache's signature.  Stores embed the report in the
+    entry manifest (`perf`), so a cached load reports numbers EQUAL to
+    the fresh compile that produced it."""
+    from avida_tpu.observability import profiler as _profiler
+
     if not enabled(cfg):
-        return jit_fn(*args)
+        if not _profiler.enabled(cfg):
+            return jit_fn(*args)
+        # cache disabled but profiling armed: take the AOT flavor of
+        # the plain jit path (lower().compile() builds the identical
+        # program jit itself would -- bit-exactness by construction,
+        # tests/test_compile_cache.py), memoized in _memo, so the
+        # jax.stages.Compiled handle is available for cost/memory
+        # capture without a double compile.  Key failures fall back to
+        # plain jit: attribution must never block the run.
+        statics = sorted(static_argnums)
+        dyn_args = tuple(a for i, a in enumerate(args)
+                         if i not in statics)
+        try:
+            key = cache_key(tag, args[statics[0]],
+                            args[statics[1]] if len(statics) > 1 else 0,
+                            dyn_args)
+        except Exception:
+            return jit_fn(*args)
+        compiled = _memo.get(key)
+        if compiled is None:
+            compiled = jit_fn.lower(*args).compile()
+            _memo[key] = compiled
+        # note on memo hits too (dedup inside): a program memoized
+        # BEFORE the plane's report was (re)armed must still appear
+        _profiler.note_program(
+            key, tag, args[statics[1]] if len(statics) > 1 else 0,
+            compiled, source="aot", cfg=cfg)
+        return compiled(*dyn_args)
 
     statics = sorted(static_argnums)
     params = args[statics[0]]
@@ -586,6 +623,8 @@ def call(jit_fn, tag: str, args: tuple, *, static_argnums=(0, 2),
 
     compiled = _memo.get(key)
     if compiled is not None:
+        _profiler.note_program(key, tag, chunk, compiled,
+                               source="memo", cfg=cfg)
         return compiled(*dyn_args)
 
     root = cache_dir(cfg)
@@ -622,6 +661,11 @@ def call(jit_fn, tag: str, args: tuple, *, static_argnums=(0, 2),
             pass
         _note(log, action="load", tag=tag, key=key, chunk=int(chunk),
               ms=round(ms, 1))
+        # attribution capture: the manifest's stored `perf` block (when
+        # the storing process was profiling) keeps cached == fresh
+        _profiler.note_program(key, tag, chunk, loaded,
+                               source="cache_load", cfg=cfg,
+                               manifest=_manifest)
         # EXECUTION stays outside the try: a runtime error from the
         # program itself must propagate exactly like the jit path's
         # (the donated inputs are consumed -- retrying against them
@@ -637,6 +681,8 @@ def call(jit_fn, tag: str, args: tuple, *, static_argnums=(0, 2),
     _memo[key] = compiled
     _note(log, action="compile", tag=tag, key=key, chunk=int(chunk),
           ms=round(compile_ms, 1))
+    _profiler.note_program(key, tag, chunk, compiled, source="compile",
+                           cfg=cfg)
 
     t0 = time.monotonic()
     try:
@@ -653,6 +699,11 @@ def call(jit_fn, tag: str, args: tuple, *, static_argnums=(0, 2),
         }
         if sig:
             meta["sig"] = sig
+        if _profiler.enabled(cfg):
+            # carry the cost/memory report in the manifest so a LOADED
+            # entry attributes identically to the fresh compile (the
+            # profiler's cached-vs-fresh equality contract)
+            meta["perf"] = _profiler.program_perf(compiled)
         write_entry(root, key, payload, trees, meta)
         _counters["store_ms"] += (time.monotonic() - t0) * 1000.0
         _note(log, action="store", tag=tag, key=key,

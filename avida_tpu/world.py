@@ -260,6 +260,19 @@ class World:
             from avida_tpu.observability.exporter import MetricsExporter
             self.exporter = MetricsExporter(self)
 
+        # device performance attribution plane (observability/
+        # profiler.py; README "Performance attribution"): per-chunk
+        # walls + sampled fenced phase/footprint probes on state
+        # COPIES.  Off (default): nothing is built and _scan_updates
+        # pays zero -- exporter files and trajectories byte-identical.
+        # Only meaningful on the scanned-chunk path; telemetry already
+        # fences every phase, so the plane stays unbuilt under it.
+        self.profiler = None
+        from avida_tpu.observability import profiler as _profiler
+        if _profiler.enabled(cfg) and self.telemetry is None:
+            self.profiler = _profiler.ChunkProfiler(
+                self.data_dir, cfg, kind="solo")
+
         # in-run analytics (analyze/pipeline.py): with TPU_ANALYTICS=1,
         # World.run refreshes an incremental phenotype census (+ the
         # dominant-lineage replay) at checkpoint boundaries and run
@@ -1008,6 +1021,8 @@ class World:
         (tests/test_native_checkpoint.py, tests/test_tracer.py)."""
         assert self.state is not None, "no population injected"
         from avida_tpu.utils import compilecache
+        if self.profiler is not None:
+            self.profiler.chunk_begin(k)
         pre = None
         if self._scrub_every > 0:
             self._chunk_no += 1
@@ -1030,6 +1045,10 @@ class World:
         self._deaths_this = deaths[-1]
         self._prev_alive = n_alive[-1]
         self._total_births = self._total_births + births.sum()
+        if self.profiler is not None:
+            # probe chunks fence + run the staged phase probe on
+            # copies; every other chunk this is two perf_counter calls
+            self.profiler.chunk_end_solo(self, k)
         if self._digest_on or pre is not None:
             self._integrity_boundary(k, pre)
         return executed
@@ -1598,6 +1617,10 @@ class World:
                 # exit census: the freshness contract holds through the
                 # end of the run (durable -- this is the last word)
                 self.analytics.refresh(self, durable=True)
+            if self.profiler is not None and self.state is not None:
+                # closing footprint + perf record BEFORE the final
+                # heartbeat so its exposition carries the exit numbers
+                self.profiler.final(self.state, self.update)
             if self.exporter is not None and self.state is not None:
                 self.exporter.export(self)    # final heartbeat (preempted=1)
         finally:

@@ -21,7 +21,12 @@ appends one beside each .prom snapshot when TPU_METRICS_HIST=1).
           the firing table; loops every --interval (default 5s) until
           interrupted, or evaluates once with --once.  Exit status
           with --once: 0 = nothing firing, 3 = at least one rule
-          firing (cron-able).
+          firing (cron-able).  Runs armed with TPU_PROFILE=1 also
+          publish the avida_perf_* attribution families
+          (observability/profiler.py: chunk walls, fenced probe
+          phases, per-program XLA cost, state footprint) -- query
+          digests them like any family, and watch appends a perf row
+          per ring that carries them.
   rules   print the effective rule set (after overrides) as JSON.
   prune   drop `.1` asides and trim live rings to a --keep-bytes tail
           (default 256 KiB), atomically.
@@ -145,6 +150,20 @@ def cmd_watch(args) -> int:
             shown = "-" if val is None else (f"{val:.4g}")
             lines.append(f"  {state} {name:<28} value {shown:<12} "
                          f"fired {plane.fired_total[name]}x")
+        # attribution-plane rider (TPU_PROFILE=1 runs): the latest
+        # sample's perf families, one row per ring that carries them
+        for rname in sorted(by_ring):
+            rows = by_ring[rname]
+            if not rows or "avida_perf_chunks_total" not in rows[-1]:
+                continue
+            s = rows[-1]
+            lines.append(
+                f"  perf    {rname:<28} chunk "
+                f"{s.get('avida_perf_chunk_wall_ms', 0.0):.1f}ms wall / "
+                f"{s.get('avida_perf_chunk_fenced_ms', 0.0):.1f}ms "
+                f"fenced, {int(s.get('avida_perf_probes_total', 0))} "
+                f"probes, state "
+                f"{s.get('avida_perf_state_bytes', 0.0) / 2**20:.1f}MiB")
         print("\n".join(lines))
         if args.once:
             return 3 if plane.firing else 0

@@ -26,14 +26,29 @@ Usage:
                                                            # so the 870s/1-core
                                                            # budget can be
                                                            # allocated from data
+    python scripts/run_suite.py --gate BENCH_r15.json      # after the sweep,
+                                                           # run bench.py fresh
+                                                           # and perf_tool-diff
+                                                           # it against the
+                                                           # committed artifact;
+                                                           # a >10% regression
+                                                           # fails the run
 
 --only PATTERN keeps test files whose name contains PATTERN (or matches
 it as an fnmatch glob); --slow selects the slow-marked tests instead of
 tier-1 -- together they are how the multi-hour slow legs are swept one
 file at a time on the 1-core host without editing this script.
 
-Exit status: 0 when every file passed, 1 otherwise.  The output file is
-written incrementally (a killed sweep keeps the files already run).
+--gate BASELINE.json appends the perf regression gate (README
+"Performance attribution"): one fresh `python bench.py` subprocess, its
+JSON line diffed against the committed baseline artifact via
+`scripts/perf_tool.py diff --gate` (provenance-checked: an artifact
+from different hardware/code refuses loudly instead of firing falsely).
+The gate's verdict folds into the exit status alongside the test sweep.
+
+Exit status: 0 when every file passed (and the gate, if requested,
+found no regression), 1 otherwise.  The output file is written
+incrementally (a killed sweep keeps the files already run).
 """
 
 from __future__ import annotations
@@ -103,6 +118,38 @@ def run_file(fname: str, marker: str | None, timeout: float) -> tuple:
     return ok, summary, dt
 
 
+def run_gate(baseline: str, timeout: float = 3600.0) -> int:
+    """The perf regression gate: one fresh bench.py child, diffed
+    against the committed baseline via perf_tool.  Returns an exit
+    status (0 = no regression; perf_tool's 3/4 pass through)."""
+    import tempfile
+
+    print(f"perf gate: running bench.py against {baseline} ...",
+          flush=True)
+    try:
+        proc = subprocess.run([sys.executable, "bench.py"], cwd=REPO,
+                              env=_env(), capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"perf gate: bench.py timed out after {timeout:.0f}s")
+        return 1
+    if proc.returncode != 0:
+        print(f"perf gate: bench.py failed (exit {proc.returncode}):\n"
+              f"{proc.stderr[-800:]}")
+        return 1
+    fd, tmp = tempfile.mkstemp(suffix=".json", prefix="bench-gate-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(proc.stdout.strip().splitlines()[-1] + "\n")
+        rc = subprocess.call(
+            [sys.executable, os.path.join("scripts", "perf_tool.py"),
+             "diff", baseline, tmp, "--gate"], cwd=REPO, env=_env())
+    finally:
+        os.unlink(tmp)
+    print(f"perf gate: {'OK' if rc == 0 else f'FAILED (exit {rc})'}")
+    return rc
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:]) if argv is None else list(argv)
     out_path = None
@@ -111,11 +158,15 @@ def main(argv=None) -> int:
     files = None
     only = None
     timings = False
+    gate = None
     i = 0
     while i < len(argv):
         a = argv[i]
         if a == "--out" and i + 1 < len(argv):
             out_path = argv[i + 1]
+            i += 2
+        elif a == "--gate" and i + 1 < len(argv):
+            gate = argv[i + 1]
             i += 2
         elif a == "--timings":
             timings = True
@@ -185,10 +236,18 @@ def main(argv=None) -> int:
     if timings:
         total += f", {wall_total:.0f}s wall"
     print(total)
+    gate_rc = 0
+    if gate is not None:
+        gate_rc = run_gate(gate)
+        line = f"PERF GATE vs {gate}: " \
+               + ("ok" if gate_rc == 0 else f"FAILED (exit {gate_rc})")
+        print(line)
+        if outf:
+            outf.write(line + "\n")
     if outf:
         outf.write(total + "\n")
         outf.close()
-    return 0 if failed == 0 else 1
+    return 0 if failed == 0 and gate_rc == 0 else 1
 
 
 if __name__ == "__main__":

@@ -16,7 +16,11 @@ firing->resolved alerts, a per-job `avida_update` counter track with
 chunk-boundary spans from the history ring, and instant events for
 injected faults, watchdog kills, rollbacks, SDC exits and breaker
 trips -- so a churn drill or an incident reads as a single correlated
-timeline instead of five journals diffed by hand.
+timeline instead of five journals diffed by hand.  Jobs armed with
+TPU_PROFILE=1 additionally get a `perf` row: each chunk interval is
+split proportionally into the avida_perf_phase_ms{phase=...} staged
+phases the history ring sampled (observability/profiler.py), so the
+attribution plane reads on the same wall-clock timeline.
 
 `to-chrome` renders a run's telemetry.jsonl -- the per-update phase
 wall-time records ({"record": "update"}, PR 1's Timeline) and the
@@ -381,6 +385,8 @@ def fleet_trace(spool: str) -> dict:
              "args": {"name": "alerts"}},
             {"name": "thread_name", "ph": "M", "pid": pid, "tid": 4,
              "args": {"name": "chunks"}},
+            {"name": "thread_name", "ph": "M", "pid": pid, "tid": 5,
+             "args": {"name": "perf"}},
         ]
         # admit -> terminal lifecycle span from the fleet journal
         if name in admit_t:
@@ -435,6 +441,23 @@ def fleet_trace(spool: str) -> dict:
             if prev is not None and t > prev[0] and u > prev[1]:
                 events.append(_span(f"chunk ->u{u}", pid, 4, prev[0], t,
                                     base, updates=u - prev[1]))
+                # attribution-plane sub-spans (TPU_PROFILE=1 runs): the
+                # chunk interval split proportionally by the staged
+                # phase breakdown the ring sampled at this boundary
+                phases = {k.split('phase="', 1)[1].rstrip('"}'): float(v)
+                          for k, v in rec.items()
+                          if isinstance(v, (int, float))
+                          and str(k).startswith('avida_perf_phase_ms{')}
+                total = sum(phases.values())
+                if total > 0:
+                    pt = prev[0]
+                    for ph, ms in sorted(phases.items(),
+                                         key=lambda kv: -kv[1]):
+                        pt1 = pt + (t - prev[0]) * (ms / total)
+                        events.append(_span(f"perf:{ph}", pid, 5, pt,
+                                            pt1, base,
+                                            probe_ms=round(ms, 3)))
+                        pt = pt1
             prev = (t, u)
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "otherData": {"spool": spool, "jobs": names,

@@ -29,6 +29,15 @@ os.environ["TPU_COMPILE_CACHE"] = os.environ.get(
 os.environ["TPU_STATE_DIGEST"] = "0"
 os.environ["TPU_SCRUB_EVERY"] = "0"
 
+# Hermeticity, same rule for the performance attribution plane
+# (observability/profiler.py): a developer shell with TPU_PROFILE
+# exported must not make every World in the suite pay fenced probes
+# (or drop perf.jsonl files into test dirs).  Dedicated tests
+# (tests/test_profiler.py) opt back in via config overrides, which the
+# plane's config-OR-env arming honors over these env pins.
+os.environ["TPU_PROFILE"] = "0"
+os.environ["TPU_PROFILE_TRACE"] = "0"
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,363 @@
+"""Device performance attribution plane (observability/profiler.py).
+
+Contract under test, layer by layer:
+
+  * off is FREE: no profiler object, no perf.jsonl, no avida_perf_*
+    families -- exporter output byte-compatible with the pre-plane
+    repo, module counters untouched;
+  * armed is INVISIBLE to physics: the evolved trajectory is
+    bit-identical with profiling on or off (probes run staged phases
+    on device-owned COPIES), and the traced update_step jaxpr digest
+    is unchanged with TPU_PROFILE=1 in the environment (subprocess
+    scripts/check_jaxpr.py -- the plane must never touch the program);
+  * armed solo end-to-end: avida_perf_* families land in metrics.prom,
+    {"record":"perf"} probe records in perf.jsonl, a perf block in
+    --status, and the state footprint's padded bytes equal nbytes
+    ground truth per leaf;
+  * cached == fresh: a program loaded from the persistent compile
+    cache reports cost/memory numbers EQUAL to the fresh compile that
+    stored them (the manifest `perf` block);
+  * multiworld armed: per-world footprint families on the batched
+    path;
+  * perf_tool: report renders, diff --gate passes identical artifacts,
+    fails an injected regression with exit 4, and refuses a
+    provenance mismatch with exit 3;
+  * campaign: one `--arms headline` artifact end-to-end on CPU (slow);
+  * the <2% recurring-overhead acceptance gauge via bench's
+    prof_overhead_fields (slow).
+
+Armed tests opt back IN via config overrides (tests/conftest.py pins
+the env half to 0 suite-wide for hermeticity), and every test resets
+the plane's process-level module state around itself.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from functools import partial
+
+import numpy as np
+import pytest
+
+from avida_tpu.observability import profiler
+from avida_tpu.utils import compilecache as cc
+from avida_tpu.world import World
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+U = 12
+ARMED = (("TPU_PROFILE", 1), ("TPU_PROFILE_EVERY", 2))
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """The plane's report is process-level (like compilecache): reset
+    around every test so an armed test's programs/footprint never leak
+    into another test's exporter output."""
+    profiler.reset_for_tests()
+    yield
+    profiler.reset_for_tests()
+
+
+def _world(data_dir, seed=11, extra=()):
+    ov = [("WORLD_X", 8), ("WORLD_Y", 8), ("RANDOM_SEED", seed),
+          ("TPU_SYSTEMATICS", 0), ("TPU_MAX_STRETCH", 4),
+          ("TPU_METRICS", 1)] + list(extra)
+    return World(overrides=ov, data_dir=str(data_dir))
+
+
+def _run(data_dir, seed=11, extra=()):
+    w = _world(data_dir, seed, extra)
+    w.run(max_updates=U)
+    return w
+
+
+# ---------------------------------------------------------------------------
+# off: byte-compatible and free
+# ---------------------------------------------------------------------------
+
+def test_off_is_byte_compatible_and_zero_cost(tmp_path):
+    w = _run(tmp_path / "off")
+    assert w.profiler is None
+    prom = (tmp_path / "off" / "metrics.prom").read_text()
+    assert "avida_perf" not in prom
+    assert not (tmp_path / "off" / profiler.PERF_FILE).exists()
+    assert profiler.prom_families() == []
+    assert all(v == 0 for v in profiler.counters().values())
+
+
+def test_arming_is_config_or_env(monkeypatch):
+    class Cfg(dict):
+        def get(self, n, d=None):
+            return super().get(n, d)
+    assert not profiler.enabled(Cfg())          # conftest pins env to 0
+    assert profiler.enabled(Cfg(TPU_PROFILE=1))
+    monkeypatch.setenv("TPU_PROFILE", "1")
+    assert profiler.enabled(Cfg())
+    # cadence is an operator knob: env wins over config
+    monkeypatch.setenv("TPU_PROFILE_EVERY", "5")
+    assert profiler.probe_every(Cfg(TPU_PROFILE_EVERY=99)) == 5
+    monkeypatch.delenv("TPU_PROFILE_EVERY")
+    assert profiler.probe_every(Cfg(TPU_PROFILE_EVERY=99)) == 99
+
+
+# ---------------------------------------------------------------------------
+# armed: invisible to physics
+# ---------------------------------------------------------------------------
+
+def test_trajectory_bit_identical_on_or_off(tmp_path):
+    w_off = _run(tmp_path / "a")
+    profiler.reset_for_tests()
+    w_on = _run(tmp_path / "b", extra=ARMED)
+    assert w_on.profiler is not None
+    for fname in w_off.state.__dataclass_fields__:
+        va = getattr(w_off.state, fname)
+        if va is None:
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(va), np.asarray(getattr(w_on.state, fname)),
+            err_msg=f"field {fname} diverged under TPU_PROFILE=1")
+    assert int(np.asarray(w_off._total_births)) \
+        == int(np.asarray(w_on._total_births))
+
+
+def test_jaxpr_digest_unchanged_when_armed():
+    """TPU_PROFILE=1 in the ENVIRONMENT must not perturb the traced
+    update program (the plane hooks chunk boundaries and copies, never
+    the jaxpr).  Subprocess: the snapshot gate under an armed env."""
+    env = dict(os.environ)
+    env["TPU_PROFILE"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join("scripts", "check_jaxpr.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# armed solo end-to-end
+# ---------------------------------------------------------------------------
+
+def test_armed_solo_end_to_end(tmp_path):
+    w = _run(tmp_path / "on", extra=ARMED)
+    prom = (tmp_path / "on" / "metrics.prom").read_text()
+    for fam in ("avida_perf_chunks_total 3", "avida_perf_updates_total 12",
+                "avida_perf_probes_total 2", "avida_perf_chunk_wall_ms",
+                "avida_perf_phase_ms{phase=", "avida_perf_state_bytes",
+                "avida_perf_state_leaf_bytes{leaf=\"genome\"}",
+                "avida_perf_programs_total"):
+        assert fam in prom, f"{fam} missing from metrics.prom"
+
+    # perf.jsonl: probe records at chunks 1 and 3 (EVERY=2) + final
+    recs = profiler.read_perf_records(str(tmp_path / "on"))
+    assert len(recs) == 3
+    assert [r["final"] for r in recs] == [False, False, True]
+    assert recs[-1]["update"] == U
+    assert all(r["record"] == "perf" and r["kind"] == "solo"
+               for r in recs)
+    assert recs[-1]["programs"] >= 1       # AOT capture, cache disabled
+
+    # --status block renders from the published families
+    from avida_tpu.observability.exporter import format_status, read_metrics
+    status = format_status(read_metrics(
+        str(tmp_path / "on" / "metrics.prom")))
+    assert "perf " in status and "probes" in status
+
+    # footprint: padded bytes are nbytes ground truth, leaf by leaf
+    fp = profiler.state_footprint(w.state)
+    for name, leaf in fp["leaves"].items():
+        arr = getattr(w.state, name)
+        assert leaf["bytes"] == np.asarray(arr).nbytes, name
+    assert fp["total_bytes"] == sum(lf["bytes"]
+                                    for lf in fp["leaves"].values())
+    assert 0.0 < fp["alive_frac"] <= 1.0
+    assert recs[-1]["state_bytes"] == fp["total_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# cached == fresh (the compile-cache manifest leg)
+# ---------------------------------------------------------------------------
+
+def _toy():
+    import jax
+
+    @partial(jax.jit, static_argnums=(0, 2), donate_argnums=(1,))
+    def toy(scale, x, steps, y):
+        def body(c, _):
+            c = c * scale + y
+            return c, c.sum()
+        return jax.lax.scan(body, x, None, length=steps)
+    return toy
+
+
+def _toy_args():
+    import jax.numpy as jnp
+    x = jnp.arange(8, dtype=jnp.float32)
+    y = jnp.full((8,), 0.5, jnp.float32)
+    return (3, x, 4, y)
+
+
+def test_program_report_cached_equals_fresh(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_COMPILE_CACHE", "1")
+    monkeypatch.setenv("TPU_COMPILE_CACHE_DIR", str(tmp_path / "cc"))
+    monkeypatch.setenv("TPU_PROFILE", "1")
+    cc.reset_for_tests()
+    try:
+        cc.call(_toy(), "toy", _toy_args())
+        fresh = profiler.program_reports()
+        assert len(fresh) == 1
+        (key, rep), = fresh.items()
+        assert rep["source"] == "compile"
+        assert rep["cost"].get("flops", 0) >= 0
+
+        # simulated fresh process: disk load must report EQUAL numbers
+        cc.reset_for_tests()
+        profiler.reset_for_tests()
+        cc.call(_toy(), "toy", _toy_args())
+        assert cc.cache_load_count() == 1
+        cached = profiler.program_reports()
+        assert set(cached) == {key}
+        assert cached[key]["source"] == "cache_load"
+        assert cached[key]["cost"] == fresh[key]["cost"]
+        assert cached[key]["memory"] == fresh[key]["memory"]
+    finally:
+        cc.reset_for_tests()
+
+
+def test_aot_capture_when_cache_disabled(monkeypatch):
+    """Cache off + plane armed: the plain-jit path takes the AOT
+    flavor so cost capture still happens, bit-exact by construction."""
+    monkeypatch.setenv("TPU_PROFILE", "1")
+    out, sums = cc.call(_toy(), "toy", _toy_args())
+    reps = profiler.program_reports()
+    assert len(reps) == 1
+    assert next(iter(reps.values()))["source"] == "aot"
+    out2, _ = _toy()(*_toy_args())
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+# ---------------------------------------------------------------------------
+# multiworld armed
+# ---------------------------------------------------------------------------
+
+def test_multiworld_armed_per_world_footprint(tmp_path):
+    from avida_tpu.parallel.multiworld import MultiWorld
+    mw = MultiWorld.from_seeds([11, 12], overrides=list(ARMED) + [
+        ("WORLD_X", 8), ("WORLD_Y", 8), ("TPU_SYSTEMATICS", 0),
+        ("TPU_MAX_STRETCH", 4), ("TPU_METRICS", 1)],
+        data_dir=str(tmp_path))
+    mw.run(max_updates=8)
+    prom = (tmp_path / "metrics.prom").read_text()
+    assert "avida_perf_chunks_total" in prom
+    assert "avida_perf_world_state_bytes" in prom
+    recs = profiler.read_perf_records(str(tmp_path))
+    assert recs and recs[-1]["kind"] == "multiworld"
+    assert recs[-1]["per_world_bytes"] * 2 == recs[-1]["state_bytes"]
+    # the batched probe attributes the world-folded stages
+    assert set(recs[-1]["phases"]) <= {"pre", "cycles", "post"}
+
+
+# ---------------------------------------------------------------------------
+# perf_tool: report / diff / campaign
+# ---------------------------------------------------------------------------
+
+_PROV = {"schema": "avida-bench-v1", "platform": "cpu",
+         "device_kind": "cpu", "device_count": 1, "x64": False,
+         "code": "abc123", "jax": "0", "jaxlib": "0", "env": {}}
+
+
+def _artifact(tmp_path, name, value, pack_ms, prov=None):
+    line = {"metric": "org_instructions_per_sec", "value": value,
+            "unit": "inst/s", "pack_ms": pack_ms,
+            "provenance": prov or _PROV}
+    p = tmp_path / name
+    p.write_text(json.dumps(line))
+    return str(p)
+
+
+def _perf_tool(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join("scripts", "perf_tool.py")]
+        + list(args), cwd=REPO, capture_output=True, text=True,
+        timeout=600)
+
+
+def test_perf_tool_diff_gate(tmp_path):
+    a = _artifact(tmp_path, "a.json", 1000.0, 5.0)
+    same = _artifact(tmp_path, "same.json", 1020.0, 5.1)
+    slow = _artifact(tmp_path, "slow.json", 850.0, 5.0)
+    other = _artifact(tmp_path, "other.json", 1000.0, 5.0,
+                      prov=dict(_PROV, code="zzz"))
+    assert _perf_tool("diff", a, same, "--gate").returncode == 0
+    p = _perf_tool("diff", a, slow, "--gate")
+    assert p.returncode == 4 and "REGRESSION" in p.stdout
+    # without --gate the regression is advisory (exit 0)
+    assert _perf_tool("diff", a, slow).returncode == 0
+    # provenance mismatch refuses loudly; --force compares anyway
+    p = _perf_tool("diff", a, other, "--gate")
+    assert p.returncode == 3 and "apples-to-oranges" in p.stderr
+    assert _perf_tool("diff", a, other, "--gate",
+                      "--force").returncode == 0
+    # lower-better direction: a *_ms field growing past tol regresses
+    slow_ms = _artifact(tmp_path, "slowms.json", 1000.0, 7.0)
+    assert _perf_tool("diff", a, slow_ms, "--gate").returncode == 4
+
+
+def test_perf_tool_report(tmp_path):
+    _run(tmp_path / "on", extra=ARMED)
+    p = _perf_tool("report", str(tmp_path / "on"))
+    assert p.returncode == 0, p.stderr
+    out = p.stdout
+    assert "fenced probes" in out and "phases (last probe)" in out
+    assert "probe timeline" in out and "state " in out
+    # an unarmed dir reports the arming hint instead
+    p = _perf_tool("report", str(tmp_path))
+    assert p.returncode == 1 and "TPU_PROFILE=1" in p.stdout
+
+
+def test_bench_provenance_strict_fields():
+    prov = profiler.bench_provenance(run_time=123.0)
+    for f in profiler.PROVENANCE_STRICT:
+        assert f in prov, f
+    assert prov["schema"] == profiler.PROVENANCE_SCHEMA
+    assert prov["code"] == cc.code_digest()
+    assert prov["generated_at"] == 123.0
+    assert profiler.provenance_mismatches(prov, dict(prov)) == []
+    assert profiler.provenance_mismatches(prov, {}) \
+        == [("provenance", "present", "absent")]
+
+
+@pytest.mark.slow
+def test_campaign_end_to_end(tmp_path):
+    """One `perf_tool campaign --arms headline` artifact on CPU: the
+    merged self-describing JSON a regression gate can diff against."""
+    env = dict(os.environ)
+    env["BENCH_PHASES"] = "0"            # headline only, no staged rows
+    out = str(tmp_path / "bench.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join("scripts", "perf_tool.py"),
+         "campaign", "--arms", "headline", "--side", "16",
+         "--out", out], cwd=REPO, env=env, capture_output=True,
+        text=True, timeout=1200)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(open(out).read())
+    assert doc["artifact"] == "avida-bench-campaign-v1"
+    assert doc["arms"]["headline"]["value"] > 0
+    for f in profiler.PROVENANCE_STRICT:
+        assert f in doc["provenance"]
+    # a campaign artifact diffs against itself cleanly, gated
+    assert _perf_tool("diff", out, out, "--gate").returncode == 0
+
+
+@pytest.mark.slow
+def test_prof_overhead_under_two_percent():
+    """The acceptance gauge: the plane's recurring per-chunk hook cost
+    stays under 2% of the plain chunk wall (bench.prof_overhead_fields
+    -- direct fenced attribution, BASELINE.md measurement rules)."""
+    sys.path.insert(0, REPO)
+    import bench
+    fields = bench.prof_overhead_fields(16, updates=16)
+    assert fields["prof_overhead_pct"] < 2.0, fields
+    assert fields["prof_probe_ms"] > 0.0
