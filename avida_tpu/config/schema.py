@@ -249,6 +249,35 @@ class AvidaConfig:
     # attacked in-kernel instead (TPU_KERNEL_ROWSKIP row-tile skipping +
     # the per-block while_loop early exit).
     TPU_PACKED_CHUNK: int = 1
+    # Fused packed-resident update (ops/packed_chunk.py; round 14): run
+    # the cheap per-update phases (schedule, bank, stats) as ROW-SPACE
+    # ops directly on the resident [rows, N] planes instead of
+    # rebuilding the full WorldState inside the scan body, so a chunk
+    # is pack-once -> scan{row phases + kernel + packed flush} ->
+    # unpack-once with no full-state unpack between updates.  1 = auto:
+    # engaged whenever the packed chunk itself is active and the flight
+    # recorder is off (packed_chunk.fused_ineligible_reason).  0 = the
+    # legacy row-space path that refreshes the canonical mirrors every
+    # update (round-6..13 engine, byte-identical trajectories either
+    # way -- the fused path is bit-exact by construction and gated by
+    # tests/test_packed_fused.py).  Program-affecting and STATIC: a
+    # serve batch must not mix values (see serve.NONSTATIC_VARS note).
+    TPU_PACKED_FUSED: int = 1
+    # Bit-packed resident genome plane (ops/pallas_cycles.py 5-bit
+    # codec; round 14): store the genome shadow plane as 5-bit opcodes
+    # packed 6-per-int32-word (ceil(L/6) rows) instead of 4 opcode
+    # bytes per word (L/4 rows) -- a ~34% cut in the genome plane's HBM
+    # residency at TPU_MAX_MEMORY=384 (256B -> 256B vs 384B per
+    # organism; see README plane-width table).  Only the genome shadow
+    # narrows: the kernel never reads it (tape/offspring planes keep
+    # the byte layout the kernel's SWAR decode indexes).  Requires the
+    # instruction set to fit 5-bit codes (num_insts <= 32 --
+    # packed_chunk.bits_ineligible_reason is loud otherwise).  Packing
+    # happens at chunk boundaries only; trajectories and checkpoint
+    # bytes are identical on or off (tests/test_packed_fused.py).
+    # Default off pending device-scale soak.  Program-affecting and
+    # STATIC, like TPU_PACKED_FUSED.
+    TPU_PACKED_BITS: int = 0
     # Persistent AOT program cache (utils/compilecache.py): 1 = the
     # engine's compiled scan programs (update_scan / multiworld_scan)
     # are AOT-serialized into an on-disk store and deserialized in

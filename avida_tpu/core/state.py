@@ -99,6 +99,15 @@ class WorldParams(struct.PyTreeNode):
     # it supersedes lane_perm_k: resident planes are cell-ordered
     # (lane_perm stays identity; see TPU_PACKED_CHUNK in config/schema)
     packed_chunk: int = struct.field(pytree_node=False, default=1)
+    # fused packed-resident update: run schedule/bank/stats as row-space
+    # ops on the resident planes, no full-state unpack inside the scan
+    # (1 = auto -- see TPU_PACKED_FUSED; 0 = refresh canonical mirrors
+    # every update, the round-6..13 row-space path)
+    packed_fused: int = struct.field(pytree_node=False, default=1)
+    # bit-packed genome shadow plane: 5-bit opcodes, 6 per int32 word
+    # (0 = off, byte planes everywhere; see TPU_PACKED_BITS -- needs
+    # num_insts <= 32, packed_chunk.bits_ineligible_reason)
+    packed_bits: int = struct.field(pytree_node=False, default=0)
     # energy model (cPhenotype energy store; cAvidaConfig.h:649-667)
     energy_enabled: bool = struct.field(pytree_node=False, default=False)
     energy_given_on_inject: float = struct.field(pytree_node=False, default=0.0)
@@ -356,6 +365,8 @@ def make_world_params(cfg, instset, environment) -> WorldParams:
         lane_perm_min_util=float(cfg.get("TPU_LANE_PERM_MIN_UTIL", 0.5)),
         kernel_shards=int(cfg.get("TPU_KERNEL_SHARDS", 0)),
         packed_chunk=int(cfg.get("TPU_PACKED_CHUNK", 1)),
+        packed_fused=int(cfg.get("TPU_PACKED_FUSED", 1)),
+        packed_bits=int(cfg.get("TPU_PACKED_BITS", 0)),
         num_demes=cfg.NUM_DEMES,
         demes_use_germline=cfg.DEMES_USE_GERMLINE,
         germline_copy_mut=cfg.GERMLINE_COPY_MUT,

@@ -237,6 +237,276 @@ def measure_multiworld_phases(params, sts, neighbors, keys, reps=3):
                                   reps=reps)
 
 
+# ---- packed-resident phase attribution (round 14) ----
+#
+# The packed engines (solo PackedChunk and stacked PackedWorlds) have
+# their own phase structure -- boundary-crossing pack/unpack plus the
+# in-scan row-space phases (schedule/kernel/bank/flush/stats) -- which
+# the staged per-update runner above cannot see (it measures the
+# engine the packed path REPLACED).  The measurers below stage the
+# packed update's own phases, each separately jitted and fenced, with
+# the in-scan ones prefixed "scan." so attribution distinguishes what
+# runs inside the resident scan from what only runs at chunk
+# boundaries.  Fused vs legacy row-space sub-path follows
+# packed_chunk.fused_active, so the probe measures whichever body the
+# production scan actually runs.
+
+_packed_stage_jits = None
+_packed_worlds_stage_jits = None
+
+
+def _packed_stages():
+    global _packed_stage_jits
+    if _packed_stage_jits is not None:
+        return _packed_stage_jits
+    from functools import partial
+
+    from avida_tpu.ops import birth as birth_ops
+    from avida_tpu.ops import packed_chunk as pch
+    from avida_tpu.ops import pallas_cycles, update as upd
+
+    def pack_fn(params, st):
+        return pch.pack_chunk(params, st)
+
+    def sched_fn(params, pc, key, update_no):
+        k_budget, k_steps, k_birth = jax.random.split(key, 3)
+        if pch.fused_active(params):
+            st = pc.st
+            alive_before = pch.alive_rows(pc.ivec).sum()
+            budgets, granted, _ = pch._schedule_rows(
+                params, pc.ivec, pc.fvec, st.budget_carry, k_budget)
+        else:
+            alive_before = pc.st.alive.sum()
+            st = upd.resource_phase(params, pc.st, key, update_no)
+            budgets, granted, _ = upd.schedule_phase(params, st, k_budget)
+        ivec = pc.ivec.at[pallas_cycles.IV_GRANTED].set(granted)
+        e0 = ivec[pallas_cycles.IV_INSTS_EXEC]
+        return (pc.replace(st=st, ivec=ivec),
+                (budgets, e0, alive_before, k_steps, k_birth))
+
+    def kernel_fn(params, pc, k_steps):
+        tape_t, off_t, ivec, fvec = pch._launch(
+            params, (pc.tape_t, pc.off_t, pc.ivec, pc.fvec), k_steps,
+            upd.static_cap(params))
+        return pc.replace(tape_t=tape_t, off_t=off_t, ivec=ivec,
+                          fvec=fvec)
+
+    def bank_fn(params, pc, budgets, e0):
+        st, executed_this = pch._bank_rows(params, pc.st, pc.ivec,
+                                           budgets, e0)
+        return pc.replace(st=st), executed_this.sum()
+
+    def flush_fn(params, pc, k_birth, update_no):
+        planes, st = birth_ops.flush_births_packed(
+            params, pc.st, k_birth,
+            (pc.tape_t, pc.off_t, pc.gen_t, pc.ivec, pc.fvec),
+            update_no, fresh_mirrors=not pch.fused_active(params))
+        tape_t, off_t, gen_t, ivec, fvec = planes
+        return pc.replace(st=st, tape_t=tape_t, off_t=off_t, gen_t=gen_t,
+                          ivec=ivec, fvec=fvec)
+
+    def stats_fn(params, pc, alive_before, update_no):
+        if pch.fused_active(params):
+            return pch.stats_rows(pc, alive_before, update_no)
+        return upd._update_stats(params, pc.st, alive_before, update_no)
+
+    def unpack_fn(params, pc):
+        return pch.unpack_chunk(params, pc)
+
+    jit0 = partial(jax.jit, static_argnums=0)
+    _packed_stage_jits = tuple(
+        jit0(f) for f in (pack_fn, sched_fn, kernel_fn, bank_fn,
+                          flush_fn, stats_fn, unpack_fn))
+    return _packed_stage_jits
+
+
+def measure_packed_phases(params, st, neighbors, key, reps=3,
+                          u0=1 << 22, warmup=True):
+    """Fenced per-phase attribution of the packed-resident update
+    (ops/packed_chunk.update_step_packed): boundary phases `pack` /
+    `unpack` and in-scan phases `scan.schedule` / `scan.kernel` /
+    `scan.bank` / `scan.flush` / `scan.stats`, each separately jitted
+    and fenced on device-owned state.  Routes through whichever sub-path
+    (fused row-space vs legacy) the production scan runs.  Returns
+    {phase_ms keys} or {} when the packed engine is not active for this
+    configuration (or the flight recorder is armed -- the staged mirror
+    does not reproduce the trace phases).
+
+    Caching-immune: every rep advances the evolved planes through the
+    full phase chain with a fresh update number.  NOTE the boundary
+    phases amortize over a whole chunk in production (pack/unpack once
+    per TPU_CHUNK updates); the in-scan phases are the per-update
+    cost."""
+    import time
+
+    from avida_tpu.ops import packed_chunk as pch
+
+    if not pch.active(params, st) or int(getattr(params, "trace_cap", 0)):
+        return {}
+    pack, sched, kernel, bank, flush, stats, unpack = _packed_stages()
+    names = ("pack", "scan.schedule", "scan.kernel", "scan.bank",
+             "scan.flush", "scan.stats", "unpack")
+    t = {n: 0.0 for n in names}
+    counted = 0
+    reps_total = reps + (1 if warmup else 0)
+    for r in range(reps_total):
+        u = jnp.int32(u0 + r)
+        k = jax.random.fold_in(key, u0 + r)
+        jax.block_until_ready(st)
+        marks = [time.perf_counter()]
+
+        def fence(x):
+            jax.block_until_ready(x)
+            marks.append(time.perf_counter())
+            return x
+
+        pc = fence(pack(params, st))
+        pc, (budgets, e0, alive_before, k_steps, k_birth) = fence(
+            sched(params, pc, k, u))
+        pc = fence(kernel(params, pc, k_steps))
+        pc, _executed = fence(bank(params, pc, budgets, e0))
+        pc = fence(flush(params, pc, k_birth, u))
+        fence(stats(params, pc, alive_before, u))
+        st = fence(unpack(params, pc))
+        if not warmup or r > 0:
+            for i, n in enumerate(names):
+                t[n] += marks[i + 1] - marks[i]
+            counted += 1
+    counted = counted or 1
+    return {f"{n}_ms": round(v * 1e3 / counted, 3)
+            for n, v in t.items()}
+
+
+def _packed_worlds_stages():
+    global _packed_worlds_stage_jits
+    if _packed_worlds_stage_jits is not None:
+        return _packed_worlds_stage_jits
+    from functools import partial
+
+    from avida_tpu.ops import birth as birth_ops
+    from avida_tpu.ops import packed_chunk as pch
+    from avida_tpu.ops import pallas_cycles, update as upd
+
+    def pack_fn(params, bst):
+        return pch.pack_worlds(params, bst)
+
+    def sched_fn(params, pw, keys, update_no):
+        un = jnp.broadcast_to(jnp.asarray(update_no, jnp.int32),
+                              (pw.bst.alive.shape[0],))
+        ks = jax.vmap(lambda k: jax.random.split(k, 3))(keys)
+        k_budget, k_steps, k_birth = ks[:, 0], ks[:, 1], ks[:, 2]
+        if pch.fused_active(params):
+            st = pw.bst
+            alive_before = pch.alive_rows(pw.ivec).sum(axis=1)
+            budgets, granted, _ = jax.vmap(
+                lambda iv, fv, bc, k: pch._schedule_rows(
+                    params, iv, fv, bc, k),
+                in_axes=(1, 1, 0, 0),
+            )(pw.ivec, pw.fvec, st.budget_carry, k_budget)
+        else:
+            alive_before = pw.bst.alive.sum(axis=1)
+            st = jax.vmap(
+                lambda s, k, u: upd.resource_phase(params, s, k, u)
+            )(pw.bst, keys, un)
+            budgets, granted, _ = jax.vmap(
+                lambda s, k: upd.schedule_phase(params, s, k)
+            )(st, k_budget)
+        ivec = pw.ivec.at[pallas_cycles.IV_GRANTED].set(granted)
+        e0 = ivec[pallas_cycles.IV_INSTS_EXEC]
+        return (pw.replace(bst=st, ivec=ivec),
+                (budgets, e0, alive_before, k_steps, k_birth, un))
+
+    def kernel_fn(params, pw, k_steps):
+        seeds = pallas_cycles.world_seed_bases(k_steps)
+        tape_t, off_t, ivec, fvec = pch._launch_worlds(
+            params, (pw.tape_t, pw.off_t, pw.ivec, pw.fvec), seeds,
+            upd.static_cap(params))
+        return pw.replace(tape_t=tape_t, off_t=off_t, ivec=ivec,
+                          fvec=fvec)
+
+    def bank_fn(params, pw, budgets, e0):
+        st, executed_this = pch._bank_rows(params, pw.bst, pw.ivec,
+                                           budgets, e0)
+        return pw.replace(bst=st), executed_this.sum(axis=1)
+
+    def flush_fn(params, pw, k_birth, un):
+        planes, st = birth_ops.flush_births_packed_worlds(
+            params, pw.bst, k_birth,
+            (pw.tape_t, pw.off_t, pw.gen_t, pw.ivec, pw.fvec),
+            un, fresh_mirrors=not pch.fused_active(params))
+        tape_t, off_t, gen_t, ivec, fvec = planes
+        return pw.replace(bst=st, tape_t=tape_t, off_t=off_t,
+                          gen_t=gen_t, ivec=ivec, fvec=fvec)
+
+    def stats_fn(params, pw, alive_before, un):
+        if pch.fused_active(params):
+            return pch.stats_rows_worlds(pw, alive_before, un)
+        return jax.vmap(
+            lambda s, ab, u: upd._update_stats(params, s, ab, u)
+        )(pw.bst, alive_before, un)
+
+    def unpack_fn(params, pw):
+        return pch.unpack_worlds(params, pw)
+
+    jit0 = partial(jax.jit, static_argnums=0)
+    _packed_worlds_stage_jits = tuple(
+        jit0(f) for f in (pack_fn, sched_fn, kernel_fn, bank_fn,
+                          flush_fn, stats_fn, unpack_fn))
+    return _packed_worlds_stage_jits
+
+
+def measure_packed_worlds_phases(params, bst, neighbors, bkeys, reps=3,
+                                 u0=1 << 22, warmup=True):
+    """measure_packed_phases for a W-stacked batch on the stacked
+    packed engine (ops/packed_chunk.update_step_packed_worlds): same
+    phase vocabulary (boundary pack/unpack + in-scan scan.* phases),
+    whole-batch ms per phase.  The live profiler's batched probe entry
+    point (observability/profiler.py _probe_batched) -- reps=1,
+    warmup=False once the stage programs are warm.  Returns {} when the
+    stacked packed engine is not active."""
+    import time
+
+    from avida_tpu.ops import packed_chunk as pch
+
+    if not pch.batch_active(params, bst) \
+            or int(getattr(params, "trace_cap", 0)):
+        return {}
+    pack, sched, kernel, bank, flush, stats, unpack = \
+        _packed_worlds_stages()
+    names = ("pack", "scan.schedule", "scan.kernel", "scan.bank",
+             "scan.flush", "scan.stats", "unpack")
+    t = {n: 0.0 for n in names}
+    counted = 0
+    reps_total = reps + (1 if warmup else 0)
+    for r in range(reps_total):
+        u = jnp.int32(u0 + r)
+        keys_r = jax.vmap(
+            lambda rk: jax.random.fold_in(rk, u0 + r))(bkeys)
+        jax.block_until_ready(bst)
+        marks = [time.perf_counter()]
+
+        def fence(x):
+            jax.block_until_ready(x)
+            marks.append(time.perf_counter())
+            return x
+
+        pw = fence(pack(params, bst))
+        pw, (budgets, e0, alive_before, k_steps, k_birth, un) = fence(
+            sched(params, pw, keys_r, u))
+        pw = fence(kernel(params, pw, k_steps))
+        pw, _executed = fence(bank(params, pw, budgets, e0))
+        pw = fence(flush(params, pw, k_birth, un))
+        fence(stats(params, pw, alive_before, un))
+        bst = fence(unpack(params, pw))
+        if not warmup or r > 0:
+            for i, n in enumerate(names):
+                t[n] += marks[i + 1] - marks[i]
+            counted += 1
+    counted = counted or 1
+    return {f"{n}_ms": round(v * 1e3 / counted, 3)
+            for n, v in t.items()}
+
+
 def measure_trace_drain(cap=4096, n_updates=16, reps=5):
     """Host cost (ms) of one flight-recorder chunk-boundary drain at its
     worst case: a FULL ring of `cap` events spread over `n_updates`

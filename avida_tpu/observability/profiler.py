@@ -205,7 +205,51 @@ def note_program(key: str, tag: str, chunk: int, compiled, source: str,
 # ---------------------------------------------------------------------------
 
 
-def state_footprint(st, names=None, num_ghosts: int = 0) -> dict:
+def packed_planes_footprint(params, N: int, W: int = 1) -> dict:
+    """Resident-plane byte accounting of the packed chunk engine
+    (ops/packed_chunk.py) for an N-cell world (x W batched worlds):
+    per-plane rows/bytes from the kernel layout, the bit-packed vs
+    unpacked genome-shadow comparison (the TPU_PACKED_BITS HBM-savings
+    number), and bytes-per-organism totals.  Pure shape math -- no
+    device transfer, callable without a live packed state."""
+    from avida_tpu.ops import packed_chunk, pallas_cycles
+
+    _, _, L = pallas_cycles._dims(params, N, int(params.max_memory))
+    NI, _, _, _ = pallas_cycles._layout(params, L)
+    LP = L // 4
+    L5 = pallas_cycles.words5(L)
+    bits = packed_chunk.bits_active(params)
+    gen_rows = L5 if bits else LP
+    lanes = int(N) * int(W)
+    planes = {
+        "tape_t": {"rows": LP, "bytes": 4 * LP * lanes},
+        "off_t": {"rows": LP, "bytes": 4 * LP * lanes},
+        "gen_t": {"rows": gen_rows, "bytes": 4 * gen_rows * lanes,
+                  "unpacked_bytes": 4 * LP * lanes},
+        "ivec": {"rows": int(NI), "bytes": 4 * int(NI) * lanes},
+        "fvec": {"rows": int(pallas_cycles.NF),
+                 "bytes": 4 * int(pallas_cycles.NF) * lanes},
+    }
+    total = sum(p["bytes"] for p in planes.values())
+    unpacked_total = total - planes["gen_t"]["bytes"] \
+        + planes["gen_t"]["unpacked_bytes"]
+    out = {
+        "packed_bits": int(bits),
+        "planes": planes,
+        "total_bytes": total,
+        "bytes_per_org": round(total / lanes, 2) if lanes else 0.0,
+        # the bits=0 comparator (equals total when the codec is off)
+        "unpacked_total_bytes": unpacked_total,
+        "saved_bytes": unpacked_total - total,
+    }
+    reason = packed_chunk.bits_ineligible_reason(params)
+    if reason and int(getattr(params, "packed_bits", 0)):
+        out["bits_fallback_reason"] = reason
+    return out
+
+
+def state_footprint(st, names=None, num_ghosts: int = 0,
+                    params=None) -> dict:
     """Padded vs live byte accounting of one PopulationState (or a
     [W]-stacked batch of them).
 
@@ -218,7 +262,13 @@ def state_footprint(st, names=None, num_ghosts: int = 0) -> dict:
     subsystems) are skipped like core/state.state_array_specs.
 
     Batched states ([W, N, ...]; `names`/`num_ghosts` from the driver)
-    additionally report per-world bytes and the ghost-slot overhead."""
+    additionally report per-world bytes and the ghost-slot overhead.
+
+    With `params` given and the packed chunk engine active, a
+    `packed_planes` block (packed_planes_footprint) reports what is
+    ACTUALLY resident mid-chunk -- the kernel planes, per world on
+    batched paths -- including the bit-packed vs unpacked genome-shadow
+    bytes under TPU_PACKED_BITS."""
     import numpy as np
 
     from avida_tpu.core.state import state_field_names
@@ -267,6 +317,13 @@ def state_footprint(st, names=None, num_ghosts: int = 0) -> dict:
         out["ghost_bytes"] = (total // W) * int(num_ghosts) if W else 0
         if names:
             out["world_names"] = list(names)
+    if params is not None:
+        from avida_tpu.ops import packed_chunk
+        if packed_chunk.active(params):
+            pp = packed_planes_footprint(params, N, W)
+            if batched and W:
+                pp["per_world_bytes"] = pp["total_bytes"] // W
+            out["packed_planes"] = pp
     return out
 
 
@@ -333,7 +390,7 @@ class ChunkProfiler:
             return
         t0 = time.perf_counter()
         phases = self._run_traced(self._probe_solo, world)
-        fp = state_footprint(world.state)
+        fp = state_footprint(world.state, params=world.params)
         self._finish_probe(phases, fp, int(world.update) + int(k), k)
         _chunk["probe_ms"] += (time.perf_counter() - t0) * 1e3
 
@@ -348,20 +405,23 @@ class ChunkProfiler:
         t0 = time.perf_counter()
         phases = self._run_traced(self._probe_batched, owner)
         fp = state_footprint(owner.bstate, names=names,
-                             num_ghosts=num_ghosts)
+                             num_ghosts=num_ghosts,
+                             params=getattr(owner, "params", None))
         if update is None:
             update = int(getattr(owner, "update", 0))
         self._finish_probe(phases, fp, int(update), k)
         _chunk["probe_ms"] += (time.perf_counter() - t0) * 1e3
 
-    def final(self, state, update: int, names=None, num_ghosts: int = 0):
+    def final(self, state, update: int, names=None, num_ghosts: int = 0,
+              params=None):
         """Exit-path refresh: the run is already synced, so the closing
         footprint + perf record are free readbacks (the final-heartbeat
         discipline)."""
         if state is None:
             return
         try:
-            fp = state_footprint(state, names=names, num_ghosts=num_ghosts)
+            fp = state_footprint(state, names=names, num_ghosts=num_ghosts,
+                                 params=params)
         except Exception:
             return
         self._finish_probe({}, fp, int(update), 0, final=True)
@@ -398,10 +458,25 @@ class ChunkProfiler:
     def _probe_solo(self, world) -> dict:
         import jax
 
+        from avida_tpu.ops import packed_chunk
+
+        if packed_chunk.active(world.params, world.state):
+            # the packed engine has its own phase structure (boundary
+            # pack/unpack + in-scan row-space phases) -- stage THOSE,
+            # not the per-update engine the packed path replaced
+            from avida_tpu.observability.harness import \
+                measure_packed_phases
+            st = jax.tree.map(jax.numpy.copy, world.state)
+            t = measure_packed_phases(
+                world.params, st, world.neighbors, world._run_key,
+                reps=1, warmup=self._staged is None)
+            self._staged = "packed"      # stage programs warm after 1st
+            return {k[:-3]: v for k, v in t.items() if k.endswith("_ms")}
+
         from avida_tpu.observability.staged import StagedUpdate
         from avida_tpu.observability.timeline import Timeline
 
-        if self._staged is None:
+        if self._staged is None or self._staged == "packed":
             self._staged = StagedUpdate(world.params, world.neighbors,
                                         collect_dispatch=False)
         st = jax.tree.map(jax.numpy.copy, world.state)
@@ -412,9 +487,26 @@ class ChunkProfiler:
 
     def _probe_batched(self, owner) -> dict:
         from avida_tpu.observability.harness import measure_batched_phases
+        from avida_tpu.ops import packed_chunk
         from avida_tpu.ops.update import use_pallas_path
 
         if use_pallas_path(owner.params):
+            if packed_chunk.batch_active(owner.params, owner.bstate):
+                # the stacked packed engine stages its own phases
+                # (boundary pack/unpack + in-scan scan.* row-space
+                # phases; observability/harness.py)
+                import jax
+
+                from avida_tpu.observability.harness import \
+                    measure_packed_worlds_phases
+                bst = jax.tree.map(jax.numpy.copy, owner.bstate)
+                warm = self._staged is None
+                self._staged = "packed-worlds"
+                t = measure_packed_worlds_phases(
+                    owner.params, bst, owner.neighbors, owner._run_keys,
+                    reps=1, warmup=warm)
+                return {k[:-3]: v for k, v in t.items()
+                        if k.endswith("_ms")}
             # the staged pre/cycles/post split only exists on the XLA
             # world-folded path; packed-kernel batches keep whole-chunk
             # attribution (fenced_ms) + the jax.profiler trace
@@ -466,6 +558,8 @@ class ChunkProfiler:
         for extra in ("per_world_bytes", "ghost_slots", "ghost_bytes"):
             if extra in fp:
                 rec[extra] = fp[extra]
+        if "packed_planes" in fp:
+            rec["packed_planes"] = fp["packed_planes"]
         append_perf_record(self.data_dir, rec)
 
 
@@ -580,6 +674,23 @@ def prom_families() -> list:
              {f'leaf="{n}"': rec["bytes"]
               for n, rec in fp["leaves"].items()}),
         ]
+        if "packed_planes" in fp:
+            pp = fp["packed_planes"]
+            fams.append(
+                ("avida_perf_packed_plane_bytes", "gauge",
+                 "resident packed-engine plane bytes (the mid-chunk HBM "
+                 "truth; gen_t narrows under TPU_PACKED_BITS)",
+                 {f'plane="{n}"': p["bytes"]
+                  for n, p in pp["planes"].items()}))
+            fams.append(
+                ("avida_perf_packed_bytes_per_org", "gauge",
+                 "resident packed-plane bytes per organism slot",
+                 pp["bytes_per_org"]))
+            if pp.get("saved_bytes"):
+                fams.append(
+                    ("avida_perf_packed_saved_bytes", "gauge",
+                     "plane bytes saved by the 5-bit genome codec vs "
+                     "the byte layout", pp["saved_bytes"]))
         if "per_world_bytes" in fp:
             fams.append(("avida_perf_world_state_bytes", "gauge",
                          "resident bytes per batched world slot",

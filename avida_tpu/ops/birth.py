@@ -1105,6 +1105,33 @@ def _pk_shift_l1(plane):
     return ((plane >> 8) & 0x00FFFFFF) | (down << 24)
 
 
+def _pk5_prefix_mask(L5, hi):
+    """int32[L5, N] 5-bit-field mask selecting positions [0, hi) of a
+    5-bit-packed [L5, N] plane (hi is a [N] position vector) -- the
+    codec counterpart of _pk_range_mask(LP, 0, hi).  Max 6 live fields
+    per word = 30 payload bits, so the full-word mask is 0x3FFFFFFF and
+    the shift never touches the sign bit."""
+    m = jnp.clip(hi[None, :]
+                 - jnp.arange(L5, dtype=jnp.int32)[:, None] * 6, 0, 6)
+    return (jnp.int32(1) << (5 * m)) - 1
+
+
+def _pk_to_plane5(plane, L5):
+    """Byte word plane int32[LP, N] (opcodes < 32 per byte) -> 5-bit
+    word plane int32[L5, N] (pallas_cycles._pack_words5 layout).  The
+    flush's bridge between the kernel's byte-layout offspring plane and
+    the bit-packed genome shadow under TPU_PACKED_BITS=1."""
+    LP, n = plane.shape
+    b = jnp.stack([(plane >> (8 * k)) & 0x1F for k in range(4)],
+                  axis=1).reshape(LP * 4, n)
+    pad = L5 * 6 - LP * 4
+    if pad > 0:
+        b = jnp.pad(b, ((0, pad), (0, 0)))
+    g = b[:L5 * 6].reshape(L5, 6, n)
+    sh = (jnp.arange(6, dtype=jnp.int32) * 5)[None, :, None]
+    return (g << sh).sum(axis=1).astype(jnp.int32)
+
+
 def _pk_roll2d(x, dy, dx, wx, wy):
     """Torus-shift along the LAST (cell/lane) axis: the [LP, N]-plane /
     [K, N]-matrix counterpart of _roll2d (same displacement semantics:
@@ -1211,19 +1238,27 @@ def _pk_extract_offspring(params, key, off_t, off_len, genome_len,
     return off, off_len
 
 
-def flush_births_packed(params, st, key, planes, update_no):
+def flush_births_packed(params, st, key, planes, update_no,
+                        fresh_mirrors=True):
     """flush_births' torus fast path on resident kernel planes.
 
     planes = (tape_t, off_t, gen_t, ivec, fvec): the [LP, N] opcode /
-    offspring / genome word planes plus the [NI, N] / [NF, N] scalar
-    planes, CELL-ordered (identity lane mapping -- packed residency
-    supersedes the budget-sort lane permutation; ops/packed_chunk.py).
+    offspring word planes, the genome shadow plane ([LP, N] bytes, or
+    [ceil(L/6), N] 5-bit fields under TPU_PACKED_BITS=1 --
+    packed_chunk.bits_active) plus the [NI, N] / [NF, N] scalar planes,
+    CELL-ordered (identity lane mapping -- packed residency supersedes
+    the budget-sort lane permutation; ops/packed_chunk.py).
     `st` is the canonical carrier whose [N, L] planes are stale between
-    chunk boundaries; this updates its cheap per-cell fields (alive /
-    merit / breed_true / parent_id / birth_update / genotype_id /
-    budget_carry / gestation_time / generation and, with the flight
-    recorder armed, the trace-visible mirrors) so scheduling, stats and
-    trace emission keep reading canonical fields mid-chunk.
+    chunk boundaries; this always updates the per-cell columns the
+    boundary unpack cannot rebuild (breed_true / parent_id /
+    birth_update / genotype_id / budget_carry / mating_type /
+    energy_spent).  `fresh_mirrors=True` (the legacy row-space body, and
+    any run with the flight recorder armed) additionally refreshes the
+    plane-backed mirrors (alive / merit / gestation_time / generation,
+    plus the trace-visible extras under TPU_TRACE) so mid-chunk readers
+    see canonical fields; the fused body (ops/packed_chunk.
+    fused_active) passes False and lets them go stale until the
+    chunk-boundary unpack rebuilds them.
 
     Returns (planes', st')."""
     from avida_tpu.core.state import make_cell_inputs
@@ -1256,8 +1291,18 @@ def flush_births_packed(params, st, key, planes, update_no):
         params, k_place, pending, alive, ivec[pc.IV_TIME_USED], merit)
 
     # breed-true: wordwise compare of the (mutated) offspring against the
-    # parent's birth genome, masked to the offspring's bytes
-    diff = (off_w ^ gen_t) & _pk_range_mask(LP, zeros_n, off_len)
+    # parent's birth genome, masked to the offspring's positions.  Under
+    # the 5-bit genome codec the offspring plane is bridged into codec
+    # layout first (opcodes < 32, so the 5-bit compare decides exactly
+    # the byte compare) and that bridged plane doubles as the newborn
+    # genome write below.
+    from avida_tpu.ops import packed_chunk as pk_chunk
+    bits5 = pk_chunk.bits_active(params)
+    if bits5:
+        off_w5 = _pk_to_plane5(off_w, gen_t.shape[0])
+        diff = (off_w5 ^ gen_t) & _pk5_prefix_mask(gen_t.shape[0], off_len)
+    else:
+        diff = (off_w ^ gen_t) & _pk_range_mask(LP, zeros_n, off_len)
     is_breed_true = (off_len == genome_len) & ~jnp.any(diff != 0, axis=0)
 
     max_exec = jnp.where(
@@ -1347,7 +1392,7 @@ def flush_births_packed(params, st, key, planes, update_no):
         jnp.where(b, mv_last_mb, fvec[pc.FV_LAST_MERIT_BASE]))
 
     tape_t = jnp.where(bi, mv_plane, tape_t)
-    gen_t = jnp.where(bi, mv_plane, gen_t)
+    gen_t = jnp.where(bi, by_parent(off_w5) if bits5 else mv_plane, gen_t)
     off_t = jnp.where(bi, 0, off_t)
 
     # flags: newborns get ALIVE only; winners/dead parents resume; the
@@ -1366,8 +1411,10 @@ def flush_births_packed(params, st, key, planes, update_no):
     ivec = ivec.at[pc.IV_OFF_SEX].set(
         jnp.where(cleared, off_sex_b, 0))
 
-    # canonical per-cell fields the packed chunk keeps FRESH on `st`
-    # (everything else canonical is rebuilt at the chunk-boundary unpack)
+    # canonical per-cell columns the packed chunk keeps FRESH on `st`:
+    # always the ones the chunk-boundary unpack cannot rebuild; the
+    # plane-backed mirrors only when a mid-chunk reader needs them
+    # (fresh_mirrors -- see the docstring)
     upd = dict(
         breed_true=jnp.where(b, mv_breed != 0, st.breed_true),
         parent_id=jnp.where(b, parent_idx, st.parent_id),
@@ -1376,11 +1423,14 @@ def flush_births_packed(params, st, key, planes, update_no):
         budget_carry=jnp.where(b, 0, st.budget_carry),
         mating_type=jnp.where(b, -1, st.mating_type),
         energy_spent=jnp.where(b, 0.0, st.energy_spent),
-        alive=alive_post,
-        merit=fvec[pc.FV_MERIT],
-        gestation_time=ivec[pc.IV_GEST_TIME],
-        generation=ivec[pc.IV_GENERATION],
     )
+    if fresh_mirrors:
+        upd.update(
+            alive=alive_post,
+            merit=fvec[pc.FV_MERIT],
+            gestation_time=ivec[pc.IV_GEST_TIME],
+            generation=ivec[pc.IV_GENERATION],
+        )
     if int(getattr(params, "trace_cap", 0)):
         # trace emission reads these canonical fields mid-chunk
         # (ops/update.trace_pre_phase / trace_post_phase)
@@ -1395,7 +1445,8 @@ def flush_births_packed(params, st, key, planes, update_no):
     return (tape_t, off_t, gen_t, ivec, fvec), st
 
 
-def flush_births_packed_worlds(params, bst, keys, planes, update_no):
+def flush_births_packed_worlds(params, bst, keys, planes, update_no,
+                               fresh_mirrors=True):
     """World-blocked packed birth flush for a stacked multi-world chunk
     (ops/packed_chunk.update_step_packed_worlds).
 
@@ -1414,8 +1465,8 @@ def flush_births_packed_worlds(params, bst, keys, planes, update_no):
     update_no = jnp.broadcast_to(jnp.asarray(update_no, jnp.int32),
                                  (bst.alive.shape[0],))
     return jax.vmap(
-        lambda st, key, pl5, un: flush_births_packed(params, st, key,
-                                                     pl5, un),
+        lambda st, key, pl5, un: flush_births_packed(
+            params, st, key, pl5, un, fresh_mirrors=fresh_mirrors),
         in_axes=(0, 0, 1, 0), out_axes=(1, 0),
     )(bst, keys, planes, update_no)
 

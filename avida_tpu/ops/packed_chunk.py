@@ -31,13 +31,37 @@ level-1 per-block while_loop early exit + level-2 row-tile skipping
 attribution in ops/scheduler.py.
 
 The canonical `st` rides along inside PackedChunk as a carrier: its
-world-level fields (resources, PRNG-independent tables, trace rings) and
-a small set of per-cell scalar mirrors (alive, merit, gestation_time,
-generation, birth_update, parent_id, genotype_id, breed_true,
-budget_carry -- plus heads/mem_len/task_exe_total when the flight
-recorder is armed) stay FRESH every update, so scheduling, light-stats
-and trace emission read canonical fields mid-chunk.  Its [N, L] planes
-are stale between boundaries and are rebuilt by unpack_chunk.
+world-level fields (resources, PRNG-independent tables, trace rings)
+stay canonical, its [N, L] planes are stale between boundaries and are
+rebuilt by unpack_chunk.  What happens to the per-cell scalar MIRRORS
+depends on the sub-path (round 14):
+
+  fused (TPU_PACKED_FUSED=1, default; fused_ineligible_reason):
+    schedule/bank/stats run in ROW space directly on the resident
+    ivec/fvec planes and the birth flush skips the mirror refresh --
+    the scan body never materializes an [N]-vector mirror it does not
+    strictly need.  Only the columns unpack_state cannot rebuild
+    (birth_update, parent_id, genotype_id, breed_true, budget_carry,
+    mating_type, energy_spent) stay canonically maintained; alive /
+    merit / gestation_time / generation go stale mid-chunk and are
+    rebuilt once at the boundary.
+  legacy row-space (TPU_PACKED_FUSED=0, or flight recorder armed):
+    the round-6..13 body -- the flush refreshes alive, merit,
+    gestation_time, generation (plus heads/mem_len/task_exe_total
+    under TPU_TRACE) every update so mid-chunk readers (trace
+    emission) see fresh mirrors.
+
+Both bodies consume the identical PRNG splits and write the identical
+planes, so trajectories are bit-exact across sub-paths
+(tests/test_packed_fused.py).
+
+Second round-14 axis, TPU_PACKED_BITS=1 (default off): the genome
+shadow plane drops from byte layout (int32[L/4, N], 4 opcodes/word) to
+a 5-bit codec (int32[ceil(L/6), N], 6 opcodes/word) -- a ~34% cut in
+that plane's HBM residency.  Only the shadow narrows: the kernel never
+reads it, and tape/offspring planes keep the byte layout the kernel's
+SWAR decode indexes.  Requires num_insts <= 32 (bits_ineligible_reason
+is loud otherwise).
 
 TPU_PACKED_CHUNK=0 disables the path entirely (the per-update
 pack/unpack path with lane packing is then exactly the round-5 engine).
@@ -125,6 +149,154 @@ def batch_active(params, bst) -> bool:
     return active(params, jax.tree.map(lambda x: x[0], bst))
 
 
+def fused_ineligible_reason(params) -> str | None:
+    """Why the packed scan body cannot run its cheap phases in ROW
+    space (None = eligible; only meaningful when the packed chunk
+    itself is active).  Fused means schedule/bank/stats read the
+    resident ivec/fvec rows directly and the birth flush skips the
+    per-update canonical-mirror refresh, so between chunk boundaries
+    the carrier's per-cell mirrors (alive, merit, gestation_time,
+    generation) go STALE -- anything that reads them mid-chunk
+    disqualifies the path."""
+    if int(getattr(params, "packed_fused", 1)) == 0:
+        return "TPU_PACKED_FUSED=0"
+    if int(getattr(params, "trace_cap", 0)):
+        return ("flight recorder armed (TPU_TRACE: trace emission reads "
+                "the canonical mirrors mid-chunk)")
+    return None
+
+
+def fused_active(params) -> bool:
+    """Static routing predicate for the fused (row-space phases, stale
+    mirrors) packed scan body.  Callers must already have checked
+    `active` -- this only answers WHICH packed body runs."""
+    return fused_ineligible_reason(params) is None
+
+
+def bits_ineligible_reason(params) -> str | None:
+    """Why the genome shadow plane cannot ride the 5-bit codec (None =
+    eligible; only meaningful when the packed chunk is active).  The
+    codec truncates every stored value to 5 bits, so the whole live
+    instruction set must fit."""
+    if int(getattr(params, "packed_bits", 0)) == 0:
+        return "TPU_PACKED_BITS=0"
+    if int(params.num_insts) > 32:
+        return ("opcode count > packable width (num_insts=%d does not "
+                "fit 5-bit codes)" % int(params.num_insts))
+    return None
+
+
+def bits_active(params) -> bool:
+    """Static routing predicate for the 5-bit genome shadow plane."""
+    return bits_ineligible_reason(params) is None
+
+
+def engine_report(params, nb_ring: bool = False) -> dict:
+    """One dict describing which packed sub-path this configuration
+    routes to -- the vocabulary `MultiWorld._report_engine` journals and
+    `--status` prints, so a silent fallback (fused -> legacy row-space,
+    bits armed but ineligible) is loud.  Keys:
+      engine: 'packed' | 'per-update'   (+ fallback_reason when the
+              latter)
+      sub_path: 'fused' | 'row-space'   (packed only; + fused_fallback_
+              reason when a fused-capable build fell back)
+      packed_bits: 0|1 (+ bits_fallback_reason when armed but refused)
+    """
+    reason = ineligible_reason(params, nb_ring)
+    if reason is not None:
+        return {"engine": "per-update", "fallback_reason": reason}
+    rep = {"engine": "packed"}
+    freason = fused_ineligible_reason(params)
+    if freason is None:
+        rep["sub_path"] = "fused"
+    else:
+        rep["sub_path"] = "row-space"
+        rep["fused_fallback_reason"] = freason
+    breason = bits_ineligible_reason(params)
+    rep["packed_bits"] = 0 if breason else 1
+    if breason and int(getattr(params, "packed_bits", 0)):
+        rep["bits_fallback_reason"] = breason    # armed but refused: loud
+    return rep
+
+
+# ---- fused row-space phases (round 14) ----
+#
+# With the flight recorder off, nothing inside the scan body needs the
+# canonical per-cell mirrors: schedule reads alive+merit (ivec flag row,
+# fvec merit row), bank reads insts_executed+alive (ivec rows), stats
+# reads alive/gestation/generation (ivec rows) + birth_update (a
+# canonical column the flush maintains because unpack_state cannot
+# rebuild it).  So the fused body runs those phases on the plane rows
+# and tells the flush to skip the mirror refresh entirely -- the
+# per-update XLA round-trip over the [N]-vector mirrors disappears, and
+# the mirrors are rebuilt exactly once at the chunk boundary by
+# unpack_chunk.  resource_phase is statically an identity under packed
+# eligibility (no global/spatial/deme pools, no gradient rows --
+# ineligible_reason gates them all out) and its PRNG is an internal
+# fold_in, not one of the update's three splits, so the fused body
+# skips it outright; bit-exactness is the existing packed-vs-XLA test
+# ladder plus tests/test_packed_fused.py.
+#
+# Fusing schedule INTO the Pallas kernel was evaluated and rejected:
+# budget sampling draws from jax.random's threefry stream
+# (slicing methods 1/2), which the kernel's per-lane PRNG cannot
+# reproduce bit-exactly, and granted budgets already enter the kernel
+# as a plane row (IV_GRANTED) -- there is no boundary crossing left to
+# save, only the [N]-elementwise carry/cap math, which XLA fuses into
+# the surrounding ops for free.
+
+
+def alive_rows(ivec):
+    """bool[..., N] alive mask straight off the resident flag row --
+    the fused path's replacement for the st.alive mirror (elementwise,
+    so it serves solo [NI, N] and stacked [NI, W, N] planes alike)."""
+    return (ivec[pallas_cycles.IV_FLAGS] & pallas_cycles.FLAG_ALIVE) != 0
+
+
+def _schedule_rows(params, ivec, fvec, budget_carry, k_budget):
+    """schedule_phase in row space: merit-proportional budgets from the
+    resident alive/merit rows + the carry/cap grant.  Same spelling as
+    ops/update.schedule_phase (via compute_budgets_from /
+    schedule_grant), so the sampled budgets are bit-identical to the
+    mirror-reading path."""
+    from avida_tpu.ops import scheduler as sched_ops
+    from avida_tpu.ops import update as upd
+    budgets = sched_ops.compute_budgets_from(
+        params, alive_rows(ivec), fvec[pallas_cycles.FV_MERIT], k_budget)
+    return upd.schedule_grant(params, budgets, budget_carry)
+
+
+def _stats_vals(ivec, birth_update, update_no):
+    """light_stats in row space (ops/update.light_stats_vals over the
+    resident rows + the canonical birth_update column the flush keeps
+    fresh)."""
+    from avida_tpu.ops import update as upd
+    return upd.light_stats_vals(
+        alive_rows(ivec), ivec[pallas_cycles.IV_GEST_TIME],
+        ivec[pallas_cycles.IV_GENERATION], birth_update, update_no)
+
+
+def stats_rows(pc: PackedChunk, alive_before, update_no):
+    """_update_stats for the fused scan body: the per-update host
+    bookkeeping tuple (births, deaths, dt, ave_gen, n_alive) computed
+    from resident rows instead of the (stale) canonical mirrors."""
+    from avida_tpu.ops import update as upd
+    return upd._update_stats_from(
+        _stats_vals(pc.ivec, pc.st.birth_update, update_no), alive_before)
+
+
+def stats_rows_worlds(pw: "PackedWorlds", alive_before, update_no):
+    """stats_rows over stacked [rows, W, N] planes: vmapped per world
+    (ivec world axis is axis 1; the canonical birth_update column and
+    alive_before lead with the world axis)."""
+    from avida_tpu.ops import update as upd
+    return jax.vmap(
+        lambda iv, bu, ab, un: upd._update_stats_from(
+            _stats_vals(iv, bu, un), ab),
+        in_axes=(1, 0, 0, 0),
+    )(pw.ivec, pw.bst.birth_update, alive_before, update_no)
+
+
 def pack_chunk(params, st) -> PackedChunk:
     """Canonical state -> resident planes (traced; once per chunk).
     Identity lane mapping by contract (see module docstring)."""
@@ -134,7 +306,16 @@ def pack_chunk(params, st) -> PackedChunk:
     tape_t, off_t, ivec, fvec = (p[:, :n] for p in quad)
     L = tape_t.shape[0] * 4
     genp = jnp.pad(st.genome.astype(jnp.uint8), ((0, 0), (0, L - L0)))
-    gen_t = pallas_cycles._pack_words(genp, L).T
+    if bits_active(params):
+        # 5-bit genome shadow: ceil(L/6) word rows instead of L/4.  The
+        # kernel never reads this plane, so only pack/flush/unpack
+        # speak the codec.  Lossless because every genome byte is an
+        # opcode < num_insts <= 32 (beyond-length bytes are zero by the
+        # extraction/injection invariant; tests/test_packed_fused.py
+        # checks the round trip on evolved states).
+        gen_t = pallas_cycles._pack_words5(genp, L).T
+    else:
+        gen_t = pallas_cycles._pack_words(genp, L).T
     return PackedChunk(st=st, tape_t=tape_t, off_t=off_t, gen_t=gen_t,
                        ivec=ivec, fvec=fvec)
 
@@ -148,8 +329,11 @@ def unpack_chunk(params, pc: PackedChunk):
     st = pallas_cycles.unpack_state(
         params, st, (pc.tape_t, pc.off_t, pc.ivec, pc.fvec),
         None, restore_ro=True)
-    L = pc.gen_t.shape[0] * 4
-    genome = pallas_cycles._unpack_words(pc.gen_t.T, L)[:, :L0]
+    L = pc.tape_t.shape[0] * 4      # gen_t rows differ under the codec
+    if bits_active(params):
+        genome = pallas_cycles._unpack_words5(pc.gen_t.T, L)[:, :L0]
+    else:
+        genome = pallas_cycles._unpack_words(pc.gen_t.T, L)[:, :L0]
     return st.replace(genome=genome.astype(jnp.int8))
 
 
@@ -203,12 +387,22 @@ def update_step_packed(params, pc: PackedChunk, key, neighbors, update_no):
 
     k_budget, k_steps, k_birth = jax.random.split(key, 3)
 
-    st = upd.resource_phase(params, pc.st, key, update_no)
-    budgets, granted, max_k = upd.schedule_phase(params, st, k_budget)
+    fused = fused_active(params)
+    if fused:
+        # row-space schedule straight off the resident planes;
+        # resource_phase is statically an identity under packed
+        # eligibility and its PRNG is an internal fold_in, so skipping
+        # it is bit-exact (see the fused block comment above)
+        st = pc.st
+        budgets, granted, max_k = _schedule_rows(
+            params, pc.ivec, pc.fvec, st.budget_carry, k_budget)
+    else:
+        st = upd.resource_phase(params, pc.st, key, update_no)
+        budgets, granted, max_k = upd.schedule_phase(params, st, k_budget)
     del max_k            # the kernel derives its own per-block ceiling
     ivec = pc.ivec.at[IV_GRANTED].set(granted)
 
-    if params.trace_cap:
+    if params.trace_cap:     # implies not fused (fused_ineligible_reason)
         st, tsnap = upd.trace_pre_phase(params, st, granted, update_no)
 
     executed0 = ivec[IV_INSTS]
@@ -221,7 +415,7 @@ def update_step_packed(params, pc: PackedChunk, key, neighbors, update_no):
 
     planes, st = birth_ops.flush_births_packed(
         params, st, k_birth, (tape_t, off_t, pc.gen_t, ivec, fvec),
-        update_no)
+        update_no, fresh_mirrors=not fused)
 
     if params.trace_cap:
         st = upd.trace_post_phase(params, st, tsnap, update_no)
@@ -325,11 +519,19 @@ def update_step_packed_worlds(params, pw: PackedWorlds, keys, neighbors,
     update_no = jnp.broadcast_to(jnp.asarray(update_no, jnp.int32),
                                  (pw.bst.alive.shape[0],))
 
-    st = jax.vmap(
-        lambda s, k, un: upd.resource_phase(params, s, k, un)
-    )(pw.bst, keys, update_no)
-    budgets, granted, max_k = jax.vmap(
-        lambda s, k: upd.schedule_phase(params, s, k))(st, k_budget)
+    fused = fused_active(params)
+    if fused:
+        st = pw.bst
+        budgets, granted, max_k = jax.vmap(
+            lambda iv, fv, bc, k: _schedule_rows(params, iv, fv, bc, k),
+            in_axes=(1, 1, 0, 0),
+        )(pw.ivec, pw.fvec, st.budget_carry, k_budget)
+    else:
+        st = jax.vmap(
+            lambda s, k, un: upd.resource_phase(params, s, k, un)
+        )(pw.bst, keys, update_no)
+        budgets, granted, max_k = jax.vmap(
+            lambda s, k: upd.schedule_phase(params, s, k))(st, k_budget)
     ivec = pw.ivec.at[IV_GRANTED].set(granted)
 
     if params.trace_cap:
@@ -348,7 +550,7 @@ def update_step_packed_worlds(params, pw: PackedWorlds, keys, neighbors,
 
     planes, st = birth_ops.flush_births_packed_worlds(
         params, st, k_birth, (tape_t, off_t, pw.gen_t, ivec, fvec),
-        update_no)
+        update_no, fresh_mirrors=not fused)
 
     if params.trace_cap:
         st = jax.vmap(

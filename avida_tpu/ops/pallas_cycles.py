@@ -1425,6 +1425,39 @@ def _unpack_words(words, L):
     return jax.lax.bitcast_convert_type(words, jnp.uint8).reshape(n, L)
 
 
+BITS5_PER_WORD = 6          # 5-bit opcodes packed 6 per int32 word
+
+
+def words5(L: int) -> int:
+    """Row count of a 5-bit-packed plane covering L opcode slots."""
+    return -(-L // BITS5_PER_WORD)
+
+
+def _pack_words5(tape, L):
+    """uint8[N, L] opcodes (< 32; TPU_PACKED_BITS requires num_insts
+    <= 32) -> int32[N, ceil(L/6)] with 5-bit field f of word w = position
+    6w+f.  30 payload bits per word, so every word is non-negative.  The
+    genome SHADOW plane's resident layout under TPU_PACKED_BITS=1 -- the
+    kernel never reads that plane, so only the host-side pack/flush/
+    unpack paths speak this codec (ops/packed_chunk.py, ops/birth.py)."""
+    n = tape.shape[0]
+    w5 = words5(L)
+    t = jnp.pad(tape.astype(jnp.int32) & 0x1F,
+                ((0, 0), (0, w5 * BITS5_PER_WORD - L)))
+    g = t.reshape(n, w5, BITS5_PER_WORD)
+    sh = jnp.arange(BITS5_PER_WORD, dtype=jnp.int32) * 5
+    return (g << sh[None, None, :]).sum(axis=2).astype(jnp.int32)
+
+
+def _unpack_words5(words, L):
+    """int32[N, ceil(L/6)] -> uint8[N, L] (inverse of _pack_words5)."""
+    n = words.shape[0]
+    sh = jnp.arange(BITS5_PER_WORD, dtype=jnp.int32) * 5
+    g = (words[:, :, None] >> sh[None, None, :]) & 0x1F
+    return g.reshape(n, words.shape[1] * BITS5_PER_WORD)[:, :L].astype(
+        jnp.uint8)
+
+
 def _flag_to_words(tape, bit, L):
     """Site flag `bit` (6 or 7) of uint8[N, L] -> int32[N, L//32] packed
     words (bit j of word w = flag of site 32w+j).

@@ -26,14 +26,22 @@ import jax.numpy as jnp
 
 def compute_budgets(params, st, key):
     """Returns int32[N] per-organism instruction budgets for one update."""
-    alive = st.alive
+    return compute_budgets_from(params, st.alive, st.merit, key)
+
+
+def compute_budgets_from(params, alive, st_merit, key):
+    """compute_budgets over bare (alive, merit) vectors -- the packed
+    engine's fused path feeds these straight off the resident planes
+    (alive from the ivec flag row, merit from the fvec row) without
+    materializing a WorldState.  Same spelling as compute_budgets so
+    both callers trace to the identical jaxpr."""
     num_orgs = alive.sum()
     ud_size = params.ave_time_slice * num_orgs
 
     if params.slicing_method == 0:
         return jnp.where(alive, params.ave_time_slice, 0).astype(jnp.int32)
 
-    merit = jnp.where(alive, jnp.maximum(st.merit, 0.0), 0.0)
+    merit = jnp.where(alive, jnp.maximum(st_merit, 0.0), 0.0)
     total = merit.sum()
     # all-zero merit degenerates to constant slicing (reference merit >= 1)
     p = jnp.where(total > 0, merit / jnp.maximum(total, 1e-30), 0.0)

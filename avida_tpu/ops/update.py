@@ -91,6 +91,14 @@ def schedule_phase(params, st, k_budget):
     Returns (budgets, granted, max_k); the cap itself is static
     (static_cap)."""
     budgets = sched_ops.compute_budgets(params, st, k_budget)
+    return schedule_grant(params, budgets, st.budget_carry)
+
+
+def schedule_grant(params, budgets, budget_carry):
+    """Carry + burst-cap half of schedule_phase, over bare vectors.  The
+    packed engine's fused path calls this directly with the carry row it
+    owns, skipping the WorldState mirror entirely; schedule_phase above
+    is the canonical spelling so both trace identically."""
     # Budget carry-over (TPU lockstep semantic, SURVEY §7 step 3).  By
     # DEFAULT (TPU_MAX_STEPS_PER_UPDATE = 0) every organism executes its
     # full merit-proportional budget within the update -- the reference's
@@ -104,7 +112,7 @@ def schedule_phase(params, st, k_budget):
     # re-granted next update -- bounded-burst stride scheduling that
     # preserves long-run merit proportionality but time-smears fixation
     # sweeps (documented deviation).
-    budgets = budgets + st.budget_carry
+    budgets = budgets + budget_carry
     cap = int(params.max_steps_per_update)
     if cap > 0:
         max_k = jnp.minimum(budgets.max(), cap)
@@ -466,7 +474,16 @@ def _update_stats(params, st, alive_before, update_no):
     the deaths balance and the avida-time delta.  One spelling, so a
     change to the deaths clamp or dt derivation applies to all four
     engines and cannot desynchronize solo vs batched bookkeeping."""
-    ave_gest, ave_gen, n_alive, births = light_stats(params, st, update_no)
+    return _update_stats_from(light_stats(params, st, update_no),
+                              alive_before)
+
+
+def _update_stats_from(vals, alive_before):
+    """_update_stats' deaths/dt derivation over a light_stats(_vals)
+    tuple -- shared with the packed engine's fused path, which computes
+    the tuple from resident plane rows (light_stats_vals) instead of a
+    WorldState."""
+    ave_gest, ave_gen, n_alive, births = vals
     deaths = jnp.maximum(alive_before + births - n_alive, 0)
     dt = jnp.where(ave_gest > 0, 1.0 / jnp.maximum(ave_gest, 1e-9), 0.0)
     return births, deaths, dt, ave_gen, n_alive
@@ -485,14 +502,25 @@ def update_scan_impl(params, st, chunk, run_key, neighbors, u0):
 
     if packed_chunk.active(params, st):
         pc = packed_chunk.pack_chunk(params, st)
+        fused = packed_chunk.fused_active(params)
 
         def pbody(pc, i):
             k = jax.random.fold_in(run_key, u0 + i)
-            alive_before = pc.st.alive.sum()
+            if fused:
+                # the alive mirror is STALE mid-chunk on the fused
+                # body -- read the resident flag row instead, and take
+                # stats off the planes (packed_chunk.stats_rows)
+                alive_before = packed_chunk.alive_rows(pc.ivec).sum()
+            else:
+                alive_before = pc.st.alive.sum()
             pc, executed = packed_chunk.update_step_packed(
                 params, pc, k, neighbors, u0 + i)
-            births, deaths, dt, ave_gen, n_alive = _update_stats(
-                params, pc.st, alive_before, u0 + i)
+            if fused:
+                births, deaths, dt, ave_gen, n_alive = \
+                    packed_chunk.stats_rows(pc, alive_before, u0 + i)
+            else:
+                births, deaths, dt, ave_gen, n_alive = _update_stats(
+                    params, pc.st, alive_before, u0 + i)
             return pc, (executed, births, deaths, dt, ave_gen, n_alive)
 
         pc, outs = jax.lax.scan(pbody, pc, jnp.arange(chunk))
@@ -716,16 +744,26 @@ def update_scan_batched(params, bst, chunk, run_keys, neighbors, u0):
 
     if packed_chunk.batch_active(params, bst):
         pw = packed_chunk.pack_worlds(params, bst)
+        fused = packed_chunk.fused_active(params)
 
         def pbody(pw, i):
             un = u0 + i
             keys = jax.vmap(jax.random.fold_in)(run_keys, un)
-            alive_before = pw.bst.alive.sum(axis=1)
+            if fused:
+                # stale alive mirrors mid-chunk on the fused body:
+                # read the stacked flag row ([NI, W, N] -> [W, N])
+                alive_before = packed_chunk.alive_rows(pw.ivec).sum(axis=1)
+            else:
+                alive_before = pw.bst.alive.sum(axis=1)
             pw, executed, trips = packed_chunk.update_step_packed_worlds(
                 params, pw, keys, neighbors, un)
-            births, deaths, dt, ave_gen, n_alive = jax.vmap(
-                lambda st, ab, u: _update_stats(params, st, ab, u)
-            )(pw.bst, alive_before, un)
+            if fused:
+                births, deaths, dt, ave_gen, n_alive = \
+                    packed_chunk.stats_rows_worlds(pw, alive_before, un)
+            else:
+                births, deaths, dt, ave_gen, n_alive = jax.vmap(
+                    lambda st, ab, u: _update_stats(params, st, ab, u)
+                )(pw.bst, alive_before, un)
             return pw, (executed, births, deaths, dt, ave_gen, n_alive,
                         trips)
 
@@ -834,12 +872,23 @@ def light_stats(params, st, update_no):
     """Tiny per-update reduction for host bookkeeping (avida time,
     generation triggers, birth/death counts) -- returns device scalars, no
     host sync implied.  update_no = the update that just completed."""
-    alive = st.alive
-    has = alive & (st.gestation_time > 0)
+    return light_stats_vals(st.alive, st.gestation_time, st.generation,
+                            st.birth_update, update_no)
+
+
+def light_stats_vals(alive, gestation_time, generation, birth_update,
+                     update_no):
+    """light_stats over the bare vectors it actually reads -- the packed
+    engine's fused path feeds these straight off the resident planes
+    (alive/gestation/generation from ivec rows, birth_update from the
+    canonical column the flush maintains) without unpacking a
+    WorldState.  One spelling with light_stats above, so the two
+    engines cannot drift."""
+    has = alive & (gestation_time > 0)
     gd = jnp.maximum(has.sum(), 1).astype(jnp.float32)
-    ave_gest = jnp.where(has, st.gestation_time, 0).sum().astype(jnp.float32) / gd
+    ave_gest = jnp.where(has, gestation_time, 0).sum().astype(jnp.float32) / gd
     n_alive = alive.sum()
     n = jnp.maximum(n_alive, 1).astype(jnp.float32)
-    ave_gen = jnp.where(alive, st.generation, 0).sum().astype(jnp.float32) / n
-    births = (alive & (st.birth_update == update_no)).sum()
+    ave_gen = jnp.where(alive, generation, 0).sum().astype(jnp.float32) / n
+    births = (alive & (birth_update == update_no)).sum()
     return ave_gest, ave_gen, n_alive, births

@@ -414,11 +414,18 @@ class MultiWorld:
     # ---- silent-corruption integrity plane (batched flavor) ----
 
     def _engine_label(self) -> str:
+        from avida_tpu.ops import packed_chunk
         from avida_tpu.ops.update import use_pallas_path
         if not use_pallas_path(self.params):
             return "xla-fold"
-        return ("pallas-packed-stacked" if self.engine == "packed-stacked"
-                else "pallas-stacked")
+        if self.engine != "packed-stacked":
+            return "pallas-stacked"
+        label = "pallas-packed-stacked"
+        if packed_chunk.fused_active(self.params):
+            label += "+fused"
+        if packed_chunk.bits_active(self.params):
+            label += "+bits5"
+        return label
 
     def _resolve_digests(self, pending):
         import time as _time
@@ -564,12 +571,20 @@ class MultiWorld:
         # params.nb_cap is the static source of the newborn-ring gate
         # (>0 iff TPU_SYSTEMATICS; the ring arrays are shaped from it),
         # so the report matches what batch_active actually routes on
-        reason = packed_chunk.ineligible_reason(self.params,
-                                                self.params.nb_cap > 0)
+        rep = packed_chunk.engine_report(self.params,
+                                         self.params.nb_cap > 0)
+        reason = rep.get("fallback_reason")
         self.engine = "packed-stacked" if reason is None else "per-update"
+        self.engine_report = rep
         fields = {"engine": self.engine, "worlds": len(self.worlds)}
-        if reason is not None:
-            fields["fallback_reason"] = reason
+        # sub-path vocabulary (fused vs legacy row-space vs per-update
+        # fallback, bits armed/refused) rides the same event, so a
+        # silent downgrade inside the packed engine is as loud as the
+        # packed->per-update one
+        for k in ("fallback_reason", "sub_path", "fused_fallback_reason",
+                  "packed_bits", "bits_fallback_reason"):
+            if k in rep:
+                fields[k] = rep[k]
         runlog.emit_event(w0, "multiworld_engine", **fields)
         return reason
 

@@ -77,6 +77,13 @@ NONSTATIC_VARS = frozenset((
     # batchability class either
     "TPU_PROFILE", "TPU_PROFILE_EVERY", "TPU_PROFILE_TRACE",
 ))
+# Reviewed and deliberately NOT listed: TPU_PACKED_CHUNK,
+# TPU_PACKED_FUSED, TPU_PACKED_BITS.  They are program-affecting
+# STATICS -- each selects a different compiled scan body / resident
+# plane layout (WorldParams.packed_chunk/packed_fused/packed_bits are
+# static fields; utils/compilecache.cache_key splits on them) -- so a
+# batch must not mix values.  They stay in the signature and split
+# batchability classes, exactly like TPU_USE_PALLAS.
 
 # spec env vars that are per-job operational knobs, not program inputs
 _NONSTATIC_ENV = frozenset((

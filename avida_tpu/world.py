@@ -1620,7 +1620,8 @@ class World:
             if self.profiler is not None and self.state is not None:
                 # closing footprint + perf record BEFORE the final
                 # heartbeat so its exposition carries the exit numbers
-                self.profiler.final(self.state, self.update)
+                self.profiler.final(self.state, self.update,
+                                    params=self.params)
             if self.exporter is not None and self.state is not None:
                 self.exporter.export(self)    # final heartbeat (preempted=1)
         finally:

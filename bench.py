@@ -264,6 +264,8 @@ def main():
                                   "120" if on_tpu else "20"))
         line.update(multiworld_fields(int(os.environ["BENCH_WORLDS"]),
                                       side, timed=4 if on_tpu else 3))
+    if os.environ.get("BENCH_PACKED_PHASES", "0") == "1":
+        line.update(packed_phase_fields(world if on_tpu else 20))
     if os.environ.get("BENCH_COMPILE", "0") == "1":
         line.update(compile_cache_fields())
     if os.environ.get("BENCH_SERVE", "0") == "1":
@@ -1258,6 +1260,142 @@ def trace_overhead_fields(world, updates=64, seed=100):
     return {"trace_drain_ms": round(measure_trace_drain(), 3),
             "trace_overhead_pct": pct(t_trace),
             "telemetry_overhead_pct": pct(t_tel)}
+
+
+def packed_phase_fields(world, seed=100):
+    """BENCH_PACKED_PHASES=1: direct attribution of the round-14
+    tentpole -- the fused packed-resident scan and the 5-bit genome
+    shadow.  Three variants of the SAME world at fixed N, each measured
+    two ways (the round-13 lesson: headline claims come from fenced
+    direct attribution, never from host-wall deltas):
+
+      packed_ms_per_update_{fused,legacy,fused_bits5}
+          end-to-end ms/update of a resident chunk (pack once + updates
+          on the planes + unpack once) per engine variant.  `legacy` is
+          TPU_PACKED_FUSED=0 (row-space phases but fresh canonical
+          mirrors every update); the fused-vs-legacy delta is the cost
+          the fused path removes from every in-scan update.
+      packed_phases_{fused,legacy,fused_bits5}
+          fenced per-phase ms (observability/harness.
+          measure_packed_phases): boundary `pack`/`unpack` vs in-scan
+          `scan.*` rows show WHERE that delta lives (legacy pays
+          mirror refresh inside scan.flush; bits5 moves cost to the
+          pack/unpack boundary).
+
+    Residency (the second tentpole axis, pure shape math -- exact on
+    any backend):
+
+      packed_bytes / packed_bytes_bits5
+          resident plane bytes at this N (profiler.
+          packed_planes_footprint): total, per organism, and bytes
+          saved by the 5-bit codec.
+      orgs_per_gb / orgs_per_gb_bits5
+          derived fit-at-fixed-HBM-budget: organisms per GB of
+          resident planes under each codec.
+
+    Max-resident probe (largest N that constructs AND runs a short
+    resident chunk, doubling the world side from the bench side):
+
+      max_resident_n / max_resident_n_bits5, with cap_hit=True when
+      the ladder stopped at the BENCH_PACKED_MAX_N env cap rather
+      than at an allocation failure -- on CPU hosts the cap, not HBM,
+      is the binding limit, and the artifact says so honestly."""
+    from avida_tpu.observability import profiler
+    from avida_tpu.observability.harness import (measure_packed_chunk,
+                                                 measure_packed_phases)
+    from avida_tpu.ops import packed_chunk
+
+    params, st, neighbors, key = build(world, world, 256, seed=seed)
+    out = {"packed_n": int(params.num_cells)}
+    if not packed_chunk.active(params, st) and params.use_pallas == 0:
+        # Off-TPU the auto route skips the kernel entirely; this arm
+        # exists to measure the packed engine, so force interpret mode
+        # (the test idiom) and say so in the artifact -- interpret-leg
+        # numbers gate RELATIVE regressions only, never the headline.
+        params = params.replace(use_pallas=1)
+        out["packed_forced_interpret"] = True
+    if not packed_chunk.active(params, st):
+        return {"packed_phases_skipped":
+                packed_chunk.ineligible_reason(params) or "inactive"}
+    variants = (("fused", params),
+                ("legacy", params.replace(packed_fused=0)),
+                ("fused_bits5", params.replace(packed_bits=1)))
+    on_tpu = jax.devices()[0].platform == "tpu"
+    for name, p in variants:
+        # update_scan donates its input state: each measurement gets
+        # its own copy so the variants stay independent
+        ms, _ = measure_packed_chunk(p, jax.tree.map(jnp.copy, st),
+                                     neighbors, jax.random.key(seed + 1),
+                                     updates=8 if on_tpu else 4,
+                                     reps=3 if on_tpu else 2)
+        if ms is not None:
+            out["packed_ms_per_update_%s" % name] = round(ms, 3)
+        ph = measure_packed_phases(p, jax.tree.map(jnp.copy, st),
+                                   neighbors, jax.random.key(seed + 2),
+                                   reps=2)
+        if ph:
+            out["packed_phases_%s" % name] = {
+                k: round(v, 3) for k, v in ph.items()}
+
+    for bits, tag in ((0, ""), (1, "_bits5")):
+        fp = profiler.packed_planes_footprint(
+            params.replace(packed_bits=bits), int(params.num_cells))
+        out["packed_bytes" + tag] = {
+            "total": fp["total_bytes"],
+            "per_org": round(fp["bytes_per_org"], 2),
+            "saved_vs_unpacked": fp["saved_bytes"],
+        }
+        out["orgs_per_gb" + tag] = int((1 << 30) // fp["bytes_per_org"])
+
+    for bits, tag in ((0, ""), (1, "_bits5")):
+        n, cap_hit = _packed_max_resident(world, bits, seed)
+        out["max_resident_n" + tag] = n
+        if cap_hit:
+            out["max_resident_cap_hit" + tag] = True
+    return out
+
+
+def _packed_max_resident(world, bits, seed, probe_updates=4):
+    """Doubling-side ladder: largest N whose resident planes construct
+    and survive a short packed scan.  Stops at allocation failure or at
+    the BENCH_PACKED_MAX_N cap (default modest on CPU hosts, where RAM
+    -- not HBM -- would otherwise absorb the ladder)."""
+    from avida_tpu.ops import packed_chunk
+    on_tpu = jax.devices()[0].platform == "tpu"
+    cap = int(os.environ.get("BENCH_PACKED_MAX_N",
+                             str(1 << 22) if on_tpu else "2048"))
+    best, cap_hit, side = 0, False, world
+    while True:
+        if side * side > cap:
+            cap_hit = True
+            break
+        try:
+            params, st, neighbors, key = build(side, side, 256, seed=seed)
+            if not packed_chunk.active(params, st) \
+                    and params.use_pallas == 0:
+                params = params.replace(use_pallas=1)
+            params = params.replace(packed_bits=bits)
+
+            @jax.jit
+            def run(st, key):
+                pc = packed_chunk.pack_chunk(params, st)
+
+                def pbody(carry, i):
+                    pc, key = carry
+                    key, k = jax.random.split(key)
+                    pc, ex = packed_chunk.update_step_packed(
+                        params, pc, k, neighbors, 1 + i)
+                    return (pc, key), ex
+                (pc, _), _ = jax.lax.scan(pbody, (pc, key),
+                                          jnp.arange(probe_updates))
+                return packed_chunk.unpack_chunk(params, pc)
+
+            jax.block_until_ready(run(st, key))
+            best = side * side
+        except Exception:
+            break
+        side *= 2
+    return best, cap_hit
 
 
 def phase_breakdown(world, reps=2, seed=100):

@@ -5,7 +5,7 @@ Usage:
     python scripts/perf_tool.py diff A.json B.json [--gate]
             [--tol 0.10] [--force]
     python scripts/perf_tool.py campaign [--out FILE]
-            [--arms headline,worlds,compile,obs,prof] [--side N]
+            [--arms headline,worlds,compile,obs,prof,packed] [--side N]
 
   report    one-page attribution summary of a run data dir: the
             avida_perf_* families from metrics.prom (programs with
@@ -295,6 +295,7 @@ ARMS = {
     "compile": {"BENCH_COMPILE": "1", "BENCH_PHASES": "0"},
     "obs": {"BENCH_OBS": "1", "BENCH_PHASES": "0"},
     "prof": {"BENCH_PROF": "1", "BENCH_PHASES": "0"},
+    "packed": {"BENCH_PACKED_PHASES": "1", "BENCH_PHASES": "0"},
 }
 
 
@@ -365,7 +366,7 @@ def main(argv=None) -> int:
 
     c = sub.add_parser("campaign", help="run bench arms, merge artifact")
     c.add_argument("--out", default=None)
-    c.add_argument("--arms", default="headline,worlds,compile,obs,prof")
+    c.add_argument("--arms", default="headline,worlds,compile,obs,prof,packed")
     c.add_argument("--side", type=int, default=None,
                    help="forward BENCH_SIDE to every arm")
     c.add_argument("--timeout", type=float, default=3600.0)

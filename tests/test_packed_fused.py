@@ -1,0 +1,294 @@
+"""Fused packed-resident scan body + 5-bit genome shadow (round 14).
+
+Two contracts ride on ops/packed_chunk.py's round-14 work:
+
+  1. FUSED: with the flight recorder off, the packed scan body runs
+     schedule/bank/stats in ROW space and the birth flush skips the
+     per-update canonical-mirror refresh -- the mirrors go stale
+     mid-chunk and are rebuilt once at the boundary.  The trajectory
+     must stay bit-exact vs the legacy row-space body
+     (TPU_PACKED_FUSED=0: fresh mirrors every update) and vs the XLA
+     micro-step engine.
+
+  2. BITS: TPU_PACKED_BITS=1 narrows the genome shadow plane to 5-bit
+     codes, six per int32 word (the kernel never reads gen_t, so only
+     pack/unpack and the flush's breed-true compare + newborn write
+     touch the codec).  Trajectories -- and therefore checkpoints,
+     which serialize the canonical state -- must be byte-identical
+     with the codec on or off.
+
+Fast tier: codec algebra, routing/reason strings, jaxpr-digest and
+compile-cache-key knob coverage, footprint accounting.  Slow tier:
+trajectory bit-exactness on solo and stacked-worlds legs (Pallas
+interpret mode, like tests/test_packed_chunk.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from avida_tpu.config import AvidaConfig
+from avida_tpu.ops import packed_chunk, pallas_cycles
+from avida_tpu.world import World
+
+from tests.test_packed_chunk import (_assert_states_equal, _mk_world,
+                                     _per_update)
+
+
+def _small_params(**over):
+    from avida_tpu.config.environment import default_logic9_environment
+    from avida_tpu.config.instset import default_instset
+    from avida_tpu.core.state import make_world_params
+
+    cfg = AvidaConfig()
+    cfg.WORLD_X = 6
+    cfg.WORLD_Y = 6
+    cfg.TPU_MAX_MEMORY = 64
+    p = make_world_params(cfg, default_instset(),
+                          default_logic9_environment())
+    return p.replace(**over) if over else p
+
+
+# ------------------------------------------------------------ fast tier
+
+def test_words5_roundtrip_ragged():
+    """The 5-bit codec is lossless on exactly the data the engine
+    stores: opcode bytes < 32, zero beyond the genome length -- over
+    ragged lengths, every packable opcode-count ceiling, and L values
+    straddling the six-codes-per-word boundary."""
+    rng = np.random.default_rng(14)
+    for L in (6, 37, 64, 91, 200, 384):
+        for num_insts in (2, 7, 26, 32):
+            n = 17
+            lens = rng.integers(0, L + 1, n)
+            by = rng.integers(0, num_insts, (n, L)).astype(np.uint8)
+            by[np.arange(L)[None, :] >= lens[:, None]] = 0
+            words = pallas_cycles._pack_words5(jnp.asarray(by), L)
+            assert words.shape == (n, pallas_cycles.words5(L))
+            got = np.asarray(pallas_cycles._unpack_words5(words, L))
+            np.testing.assert_array_equal(got, by)
+
+
+def test_pk5_plane_helpers_match_codec():
+    """The flush-side SWAR helpers agree with the codec: _pk_to_plane5
+    re-packs a byte plane into the 5-bit layout, and _pk5_prefix_mask
+    selects exactly the first `hi` codes of each lane."""
+    from avida_tpu.ops.birth import _pk5_prefix_mask, _pk_to_plane5
+    from avida_tpu.ops.pallas_cycles import _pack_words, _pack_words5
+
+    rng = np.random.default_rng(5)
+    n, L = 13, 88                       # LP=22 rows, L5=15 words
+    L5 = pallas_cycles.words5(L)
+    by = rng.integers(0, 32, (n, L)).astype(np.uint8)
+    plane = _pack_words(jnp.asarray(by), L).T        # byte layout [LP, n]
+    want = _pack_words5(jnp.asarray(by), L).T        # 5-bit layout [L5, n]
+    np.testing.assert_array_equal(np.asarray(_pk_to_plane5(plane, L5)),
+                                  np.asarray(want))
+
+    hi = jnp.asarray(rng.integers(0, L + 5, n), jnp.int32)
+    m = _pk5_prefix_mask(L5, hi)
+    got = np.asarray(pallas_cycles._unpack_words5((want & m).T, L))
+    keep = np.arange(L)[None, :] < np.asarray(hi)[:, None]
+    np.testing.assert_array_equal(got, np.where(keep, by, 0))
+
+
+def test_fused_and_bits_routing_reasons():
+    """Every fused/bits exclusion names itself, and engine_report
+    journals the sub-path the scan body will actually take -- including
+    the loud armed-but-refused bits case."""
+    p = _small_params()
+    assert packed_chunk.fused_active(p)
+    assert packed_chunk.fused_ineligible_reason(
+        p.replace(packed_fused=0)) == "TPU_PACKED_FUSED=0"
+    assert "flight recorder" in packed_chunk.fused_ineligible_reason(
+        p.replace(trace_cap=64))
+
+    assert packed_chunk.bits_ineligible_reason(p) == "TPU_PACKED_BITS=0"
+    assert packed_chunk.bits_active(p.replace(packed_bits=1))
+    big = p.replace(packed_bits=1, num_insts=33)
+    assert "num_insts=33" in packed_chunk.bits_ineligible_reason(big)
+
+    pe = p.replace(use_pallas=1)      # interpret mode: packed-eligible
+    rep = packed_chunk.engine_report(pe)
+    assert rep["engine"] == "packed" and rep["sub_path"] == "fused"
+    assert rep["packed_bits"] == 0 and "bits_fallback_reason" not in rep
+    rep = packed_chunk.engine_report(pe.replace(trace_cap=64))
+    assert rep["sub_path"] == "row-space"
+    assert "flight recorder" in rep["fused_fallback_reason"]
+    rep = packed_chunk.engine_report(pe.replace(packed_bits=1))
+    assert rep["packed_bits"] == 1
+    rep = packed_chunk.engine_report(pe.replace(packed_bits=1,
+                                                num_insts=33))
+    assert rep["packed_bits"] == 0
+    assert "num_insts=33" in rep["bits_fallback_reason"]
+    rep = packed_chunk.engine_report(pe.replace(packed_chunk=0))
+    assert rep["engine"] == "per-update"
+    assert rep["fallback_reason"] == "TPU_PACKED_CHUNK=0"
+
+
+def test_update_step_jaxpr_invariant_under_knobs():
+    """update_step never routes packed, so arming TPU_PACKED_FUSED /
+    TPU_PACKED_BITS must leave its traced program byte-identical --
+    the scripts/check_jaxpr.py gate cannot move with these knobs."""
+    import hashlib
+
+    from avida_tpu.core.state import zeros_population
+    from avida_tpu.ops import birth as birth_ops
+    from avida_tpu.ops.update import update_step
+
+    def digest(p):
+        st = zeros_population(p.num_cells, p.max_memory, p.num_reactions)
+        nb = jnp.asarray(birth_ops.neighbor_table(6, 6, p.geometry))
+        jx = str(jax.make_jaxpr(
+            lambda s, k, u: update_step(p, s, k, nb, u))(
+                st, jax.random.key(0), jnp.int32(0)))
+        return hashlib.sha256(jx.encode()).hexdigest()
+
+    base = digest(_small_params())
+    assert digest(_small_params(packed_fused=0)) == base
+    assert digest(_small_params(packed_bits=1)) == base
+    assert digest(_small_params(packed_fused=0, packed_bits=1)) == base
+
+
+def test_cache_key_covers_knobs():
+    """The AOT program-cache key must split on every program-affecting
+    static -- a cached fused program must never serve a legacy-body
+    request (or a bits=1 program a bits=0 one)."""
+    from avida_tpu.utils import compilecache
+
+    dyn = (jnp.zeros((4,), jnp.int32),)
+    keys = {compilecache.cache_key("chunk", _small_params(**ov), 25, dyn)
+            for ov in ({}, {"packed_fused": 0}, {"packed_bits": 1},
+                       {"packed_fused": 0, "packed_bits": 1})}
+    assert len(keys) == 4
+
+
+def test_packed_planes_footprint_accounting():
+    """The residency numbers the bench/profiler publish: the 5-bit
+    codec narrows ONLY gen_t (ceil(L/6) words vs L/4), saved_bytes is
+    the exact delta, and the bits-off comparator equals the bits-off
+    total.  An armed-but-refused config reports why."""
+    from avida_tpu.observability import profiler
+
+    p = _small_params(use_pallas=1)
+    n = int(p.num_cells)
+    off = profiler.packed_planes_footprint(p, n)
+    on = profiler.packed_planes_footprint(p.replace(packed_bits=1), n)
+    assert off["packed_bits"] == 0 and on["packed_bits"] == 1
+    assert off["saved_bytes"] == 0
+    assert off["total_bytes"] == off["unpacked_total_bytes"] \
+        == on["unpacked_total_bytes"]
+    assert on["saved_bytes"] == off["total_bytes"] - on["total_bytes"] > 0
+    for name in ("tape_t", "off_t", "ivec", "fvec"):
+        assert on["planes"][name] == off["planes"][name]
+    assert on["planes"]["gen_t"]["rows"] < off["planes"]["gen_t"]["rows"]
+    assert on["bytes_per_org"] < off["bytes_per_org"]
+
+    refused = profiler.packed_planes_footprint(
+        p.replace(packed_bits=1, num_insts=40), n)
+    assert refused["saved_bytes"] == 0
+    assert "num_insts=40" in refused["bits_fallback_reason"]
+
+
+def test_state_footprint_reports_packed_planes():
+    """state_footprint(params=...) carries the resident-plane block on
+    packed-eligible configs (what the run actually keeps in HBM during
+    a chunk), and omits it when the engine routes per-update."""
+    from avida_tpu.observability import profiler
+
+    w = _mk_world(seeds=(7,))
+    fp = profiler.state_footprint(w.state, params=w.params)
+    assert "packed_planes" in fp
+    assert fp["packed_planes"]["total_bytes"] > 0
+    fp = profiler.state_footprint(
+        w.state, params=w.params.replace(packed_chunk=0))
+    assert "packed_planes" not in fp
+
+
+# ------------------------------------------------------------ slow tier
+
+@pytest.mark.slow
+def test_fused_matches_legacy_and_per_update():
+    """THE round-14 contract: the fused body (row-space phases, stale
+    mirrors, flush skips the refresh) is bit-exact vs the legacy packed
+    body (TPU_PACKED_FUSED=0) and vs the per-update reference, full
+    default mutation battery on."""
+    from avida_tpu.ops.update import update_scan
+
+    w = _mk_world()
+    wl = _mk_world(overrides=(("TPU_PACKED_FUSED", 0),))
+    assert packed_chunk.fused_active(w.params)
+    assert not packed_chunk.fused_active(wl.params)
+    run_key = jax.random.key(123)
+    K = 10
+    ref = _per_update(w.params, w.state, w.neighbors, run_key, K)
+    got, _ = update_scan(w.params, jax.tree.map(jnp.copy, w.state), K,
+                         run_key, w.neighbors, jnp.int32(0))
+    leg, _ = update_scan(wl.params, jax.tree.map(jnp.copy, wl.state), K,
+                         run_key, wl.neighbors, jnp.int32(0))
+    _assert_states_equal(ref, got)
+    _assert_states_equal(leg, got)
+    assert int(np.asarray(ref.num_divides).sum()) > 0, \
+        "no divide -- the fused flush was never exercised"
+
+
+@pytest.mark.slow
+def test_bits5_scan_bit_exact():
+    """TPU_PACKED_BITS=1 changes ONLY the resident encoding: the
+    canonical trajectory -- and with it any checkpoint serialized from
+    it -- is byte-identical with the codec on or off, mutations on
+    (divide ins/del exercise the ragged prefix mask and the 5-bit
+    newborn write)."""
+    from avida_tpu.ops.update import update_scan
+
+    w0 = _mk_world()
+    w1 = _mk_world(overrides=(("TPU_PACKED_BITS", 1),))
+    assert packed_chunk.bits_active(w1.params)
+    assert not packed_chunk.bits_active(w0.params)
+    run_key = jax.random.key(77)
+    K = 12
+    a, _ = update_scan(w0.params, jax.tree.map(jnp.copy, w0.state), K,
+                       run_key, w0.neighbors, jnp.int32(0))
+    b, _ = update_scan(w1.params, jax.tree.map(jnp.copy, w1.state), K,
+                       run_key, w1.neighbors, jnp.int32(0))
+    _assert_states_equal(a, b)
+    assert int(np.asarray(a.num_divides).sum()) > 0, \
+        "no divide -- the 5-bit breed-true/newborn path was never hit"
+
+
+@pytest.mark.slow
+def test_fused_bits_worlds_stacked_bit_exact():
+    """Stacked-worlds leg: W=2 worlds through update_step_packed_worlds
+    with fused + bits5 armed equal each world's SOLO packed scan -- the
+    serve-batch shape of both round-14 axes."""
+    wa = _mk_world(seeds=(10, 11, 20), overrides=(("TPU_PACKED_BITS", 1),))
+    wb = _mk_world(seeds=(21, 27, 30), overrides=(("TPU_PACKED_BITS", 1),))
+    params, nb = wa.params, wa.neighbors
+    assert packed_chunk.fused_active(params)
+    assert packed_chunk.bits_active(params)
+    K = 6
+    base = [jax.random.key(900 + i) for i in range(2)]
+
+    def solo(st, bkey):
+        pc = packed_chunk.pack_chunk(params, st)
+        for u in range(K):
+            pc, _ = packed_chunk.update_step_packed(
+                params, pc, jax.random.fold_in(bkey, u), nb, jnp.int32(u))
+        return packed_chunk.unpack_chunk(params, pc)
+
+    refs = [solo(jax.tree.map(jnp.copy, w.state), k)
+            for w, k in zip((wa, wb), base)]
+
+    bst = jax.tree.map(lambda a, b: jnp.stack([a, b]), wa.state, wb.state)
+    pw = packed_chunk.pack_worlds(params, bst)
+    for u in range(K):
+        keys = jnp.stack([jax.random.fold_in(k, u) for k in base])
+        pw, _, _ = packed_chunk.update_step_packed_worlds(
+            params, pw, keys, nb, jnp.int32(u))
+    got = packed_chunk.unpack_worlds(params, pw)
+    for i, ref in enumerate(refs):
+        _assert_states_equal(ref, jax.tree.map(lambda x: x[i], got))
+    assert sum(int(np.asarray(r.num_divides).sum()) for r in refs) > 0
